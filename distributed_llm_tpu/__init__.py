@@ -1,0 +1,30 @@
+"""distributed_llm_tpu — a TPU-native distributed LLM serving framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+system ``clumpygum/distributed-llm`` (a query-routing chatbot dispatching
+prompts across heterogeneous LLM serving devices).  Where the reference
+outsources model execution to Ollama (llama.cpp) on LAN-separated Jetson
+boards, this framework owns the entire inference stack natively on TPU:
+
+- ``engine/``   tokenizer, XLA-compiled prefill + autoregressive decode with a
+                KV cache resident in HBM, sampling, lifecycle management.
+- ``models/``   pure-JAX (functional) LLaMA-style transformer definitions and
+                size presets for the two serving tiers ("nano" 1-chip,
+                "orin" multi-chip tensor-parallel).
+- ``ops/``      attention + sampling ops; Pallas TPU kernels for the hot paths.
+- ``parallel/`` device mesh / submesh utilities, tensor-parallel sharding
+                rules, ICI collectives (health allgather), ring attention for
+                sequence parallelism.
+- ``routing/``  the query-routing engine: five strategies, the predictive
+                routing cache, and token counting (reference parity:
+                src/query_router_engine.py, src/cache.py, src/token_counter.py).
+- ``serving/``  Router orchestration, the Flask ``/chat`` app, and the
+                per-tier ``/query`` + ``/health`` device API (reference parity:
+                src/router.py, src/app.py, src/devices/*_api.py,
+                src/models/{nano,orin}.py, src/models/server_manager.py).
+- ``bench/``    labeled query sets and the benchmark harness, CLI-compatible
+                with the reference's src/tests/routing_chatbot_tester.py.
+- ``training/`` sharded train step (dp x tp) for fine-tuning tier models.
+"""
+
+__version__ = "0.1.0"

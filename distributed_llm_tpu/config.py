@@ -1,0 +1,189 @@
+"""Typed configuration for the whole framework.
+
+The reference scatters configuration across plain dicts
+(src/query_router_engine.py:704-731 BENCHMARK_CFG / PRODUCTION_CFG,
+src/query_router_engine.py:517-553 QueryRouter._default_config, and call-site
+overrides in src/app.py:9-14).  We keep the *same key names* — the benchmark
+harness and Flask app pass them through verbatim — but add typed dataclasses
+for everything the reference hard-codes (device endpoints, model choice, TPU
+topology), so one config module covers router + engine + mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+# =============================================================================
+# Router-level canonical configs (reference parity)
+# =============================================================================
+
+# Benchmark: routing cache OFF so accuracy is measured cleanly per query
+# (reference: src/query_router_engine.py:704-719).
+BENCHMARK_CFG: Dict[str, Any] = {
+    "token_threshold": 1000,
+    "model": "tpu-native-byte-level",          # tokenizer identity, see engine/tokenizer.py
+    "embedding_model": "hashed-ngram-384",     # on-device embedder, see routing/embedder.py
+    "semantic_label_path": "",                 # resolved lazily to bench/semantic_labels.json
+    "semantic_margin_threshold": 0.03,
+    "semantic_min_similarity": 0.05,
+    "heuristic_long_chars": 800,               # ~200 tokens
+    "heuristic_multi_qmarks": 2,
+    "heuristic_code_markers_needed": 2,
+    "heuristic_context_chars": 3200,           # ~800 tokens — nano-tier sweet spot
+    "weights": {"token": 0.25, "semantic": 0.45, "heuristic": 0.30},
+    "cache_enabled": False,
+    "perf_window": 30,
+    "perf_fail_penalty": 3000.0,
+}
+
+# Production: predictive routing cache + response cache ON
+# (reference: src/query_router_engine.py:722-731).
+PRODUCTION_CFG: Dict[str, Any] = {
+    **BENCHMARK_CFG,
+    "cache_enabled": True,
+    "cache_ttl_seconds": 3600,
+    "cache_max_size": 500,
+    "cache_similarity_threshold": 0.85,
+    "use_semantic_cache": True,
+    "prediction_confidence_threshold": 0.70,
+    "enable_response_cache": True,
+}
+
+
+# =============================================================================
+# Model architecture presets
+# =============================================================================
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """LLaMA-style decoder-only transformer hyperparameters."""
+
+    name: str
+    vocab_size: int = 512          # byte-level vocab (256 bytes + specials), padded
+    hidden_size: int = 2048
+    num_layers: int = 16
+    num_heads: int = 16
+    num_kv_heads: int = 8          # grouped-query attention
+    ffn_size: int = 5632
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def param_count(self) -> int:
+        """Approximate parameter count (embeddings counted once, tied head)."""
+        h, f, l, v = self.hidden_size, self.ffn_size, self.num_layers, self.vocab_size
+        kv = self.num_kv_heads * self.head_dim
+        attn = h * h + 2 * h * kv + h * h          # q, k, v, o
+        mlp = 3 * h * f                            # gate, up, down
+        norms = 2 * h * l + h
+        return v * h + l * (attn + mlp) + norms
+
+
+# Tier presets.  The "full" presets mirror the north star (1B vs 8B class);
+# the "bench" presets are sized so both tiers fit one v5e chip (16 GB HBM)
+# at the same time, since the driver benches on a single real chip.  The
+# "test" presets keep CPU-mesh unit tests fast.
+MODEL_PRESETS: Dict[str, ModelConfig] = {
+    "nano_1b": ModelConfig(
+        name="nano_1b", hidden_size=2048, num_layers=16, num_heads=32,
+        num_kv_heads=8, ffn_size=8192, max_seq_len=8192,
+    ),
+    "orin_8b": ModelConfig(
+        name="orin_8b", hidden_size=4096, num_layers=32, num_heads=32,
+        num_kv_heads=8, ffn_size=14336, max_seq_len=8192,
+    ),
+    "nano_bench": ModelConfig(
+        name="nano_bench", hidden_size=1024, num_layers=8, num_heads=16,
+        num_kv_heads=8, ffn_size=4096, max_seq_len=2048,
+    ),
+    "orin_bench": ModelConfig(
+        name="orin_bench", hidden_size=2048, num_layers=16, num_heads=16,
+        num_kv_heads=8, ffn_size=8192, max_seq_len=2048,
+    ),
+    "nano_test": ModelConfig(
+        name="nano_test", hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, ffn_size=128, max_seq_len=256,
+    ),
+    "orin_test": ModelConfig(
+        name="orin_test", hidden_size=128, num_layers=2, num_heads=8,
+        num_kv_heads=4, ffn_size=256, max_seq_len=256,
+    ),
+}
+
+
+# =============================================================================
+# Tier / topology configuration
+# =============================================================================
+
+@dataclasses.dataclass(frozen=True)
+class TierConfig:
+    """One serving tier = one model resident on one device submesh.
+
+    Replaces the reference's hard-coded device endpoints
+    (src/models/nano.py:4-8, src/models/orin.py:6-10): instead of
+    ip/port/tunnel-port, a tier is defined by its model preset and the shape
+    of the chip submesh it owns.
+    """
+
+    name: str                       # "nano" | "orin" | ...
+    model_preset: str               # key into MODEL_PRESETS
+    tp: int = 1                     # tensor-parallel degree (submesh size)
+    max_new_tokens: int = 256       # decode cap (reference: num_predict, -1=unbounded)
+    temperature: float = 0.0        # greedy by default (src/devices/nano_api.py:21)
+    prefill_buckets: Tuple[int, ...] = (64, 128, 256, 512, 1024, 2048)
+    decode_batch: int = 1
+
+    def model(self) -> ModelConfig:
+        return MODEL_PRESETS[self.model_preset]
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterConfig:
+    """The two-tier deployment. Tier submeshes are carved from jax.devices()
+    in order: nano gets the first `nano.tp` chips, orin the next `orin.tp`.
+    If fewer devices exist than requested, tiers share / shrink gracefully
+    (single-chip dev boxes and the one-chip bench environment).
+    """
+
+    nano: TierConfig = dataclasses.field(
+        default_factory=lambda: TierConfig(name="nano", model_preset="nano_1b", tp=1))
+    orin: TierConfig = dataclasses.field(
+        default_factory=lambda: TierConfig(name="orin", model_preset="orin_8b", tp=4))
+    seed: int = 0
+
+    def tiers(self) -> Tuple[TierConfig, TierConfig]:
+        return (self.nano, self.orin)
+
+
+def bench_cluster() -> ClusterConfig:
+    """Cluster sized for the single-chip bench environment."""
+    return ClusterConfig(
+        nano=TierConfig(name="nano", model_preset="nano_bench", tp=1,
+                        max_new_tokens=64),
+        orin=TierConfig(name="orin", model_preset="orin_bench", tp=1,
+                        max_new_tokens=128),
+    )
+
+
+def test_cluster() -> ClusterConfig:
+    """Tiny cluster for CPU unit tests (8 virtual devices: 1 + 4 used)."""
+    return ClusterConfig(
+        nano=TierConfig(name="nano", model_preset="nano_test", tp=1,
+                        max_new_tokens=8, prefill_buckets=(16, 32, 64)),
+        orin=TierConfig(name="orin", model_preset="orin_test", tp=4,
+                        max_new_tokens=8, prefill_buckets=(16, 32, 64)),
+    )
+
+
+def resolve_config(config: Optional[Dict[str, Any]], benchmark_mode: bool) -> Dict[str, Any]:
+    """Explicit config wins; otherwise pick the canonical dict by mode
+    (reference: src/router.py:37-40)."""
+    if config is not None:
+        return config
+    return dict(BENCHMARK_CFG) if benchmark_mode else dict(PRODUCTION_CFG)

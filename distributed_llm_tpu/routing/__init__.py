@@ -1,0 +1,17 @@
+from .types import RoutingDecision
+from .cache import (CacheEntry, CacheLookupResult, QueryCache, RoutingRecord,
+                    PREDICTION_CONFIDENCE_THRESHOLD, RECENCY_DECAY)
+from .embedder import HashedNgramEmbedder, default_embedder
+from .engine import QueryRouter
+from .strategies import (AVAILABLE_STRATEGIES, HeuristicStrategy, HybridStrategy,
+                         PerfStrategy, SemanticStrategy, TokenStrategy)
+from .token_counter import TokenCounter, approx_token_count
+
+__all__ = [
+    "RoutingDecision", "CacheEntry", "CacheLookupResult", "QueryCache",
+    "RoutingRecord", "PREDICTION_CONFIDENCE_THRESHOLD", "RECENCY_DECAY",
+    "HashedNgramEmbedder", "default_embedder", "QueryRouter",
+    "AVAILABLE_STRATEGIES", "HeuristicStrategy", "HybridStrategy",
+    "PerfStrategy", "SemanticStrategy", "TokenStrategy",
+    "TokenCounter", "approx_token_count",
+]

@@ -1,0 +1,131 @@
+"""Self-contained text embedder with the device doing the math.
+
+Replaces the reference's host-CPU SentenceTransformer ("all-MiniLM-L6-v2",
+src/query_router_engine.py:508-511) for both the semantic routing strategy and
+the semantic cache.  A pretrained MiniLM cannot be downloaded in this
+environment (zero egress), so embeddings are built from *hashed lexical
+features* — word unigrams/bigrams plus character trigrams, signed-hashed into
+a sparse vector — then projected to a dense low-dimensional space by a fixed
+random Gaussian matrix and L2-normalized.  Random projection approximately
+preserves inner products (Johnson–Lindenstrauss), so cosine similarity ranks
+lexically similar texts just like the cache's 0.85-threshold scan expects.
+
+The projection (the FLOPs) runs as a jitted matmul on the default JAX device,
+satisfying the north star's "on-device semantic-cache embeddings"
+(BASELINE.json).  Feature hashing stays on host (string processing is not
+jittable).  Drift from the reference is documented: MiniLM captures semantics
+beyond lexical overlap; centroid routing still separates simple/complex
+queries because their vocabularies differ.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import zlib
+from typing import List, Sequence
+
+import numpy as np
+
+_WORD_RE = re.compile(r"[a-z0-9']+")
+
+FEATURE_DIM = 16384
+EMBED_DIM = 384
+_SEED = 20260729
+
+# Function words carry little routing signal; down-weighting them calibrates
+# paraphrase cosine similarity to the cache's 0.85 threshold (two phrasings of
+# the same question share content words but differ in function words).
+_STOPWORDS = frozenset(
+    "a an and are as at be but by can could did do does for from had has have "
+    "he her his how i if in is it its may me my of on or our she should so "
+    "that the their them they this to us was we were what when where which "
+    "who why will with would you your".split())
+_STOP_WEIGHT = 0.15
+_BIGRAM_WEIGHT = 0.4
+_TRIGRAM_WEIGHT = 0.15
+
+
+def _hash(token: str) -> int:
+    return zlib.crc32(token.encode("utf-8"))
+
+
+def _features(text: str) -> np.ndarray:
+    """Signed hashed bag of word 1/2-grams + char trigrams, content-weighted."""
+    vec = np.zeros(FEATURE_DIM, dtype=np.float32)
+    # Strip possessive/contraction suffixes so "what's" matches "what".
+    words = [w[:-2] if w.endswith("'s") else w.replace("'", "")
+             for w in _WORD_RE.findall(text.lower())]
+
+    def bump(token: str, weight: float) -> None:
+        h = _hash(token)
+        sign = 1.0 if (h >> 16) & 1 else -1.0
+        vec[h % FEATURE_DIM] += sign * weight
+
+    for w in words:
+        bump("u:" + w, _STOP_WEIGHT if w in _STOPWORDS else 1.0)
+    for a, b in zip(words, words[1:]):
+        w = _BIGRAM_WEIGHT
+        if a in _STOPWORDS and b in _STOPWORDS:
+            w *= _STOP_WEIGHT
+        bump("b:" + a + "_" + b, w)
+    squashed = "".join(w for w in words if w not in _STOPWORDS)
+    for i in range(len(squashed) - 2):
+        bump("c:" + squashed[i:i + 3], _TRIGRAM_WEIGHT)
+    return vec
+
+
+class HashedNgramEmbedder:
+    """Drop-in for the reference's SentenceTransformer usage:
+    ``encode(list[str]) -> np.ndarray [n, EMBED_DIM]``."""
+
+    def __init__(self, dim: int = EMBED_DIM, seed: int = _SEED):
+        self.dim = dim
+        rng = np.random.default_rng(seed)
+        # Fixed projection; scaled so projected norms are O(1).
+        self._proj = rng.standard_normal((FEATURE_DIM, dim)).astype(np.float32)
+        self._proj /= np.sqrt(dim)
+        self._device_proj = None  # lazily placed on device
+
+    def _project(self, feats: np.ndarray) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+
+        if self._device_proj is None:
+            # Order matters for concurrent first use: publish the jitted fn
+            # before _device_proj, which gates entry to this branch.
+            self._project_jit = jax.jit(
+                lambda f, p: _l2_normalize(jnp.dot(f, p)))
+            self._device_proj = jax.device_put(self._proj)
+        return np.asarray(self._project_jit(feats, self._device_proj))
+
+    def encode(self, texts: Sequence[str]) -> np.ndarray:
+        if isinstance(texts, str):
+            texts = [texts]
+        feats = np.stack([_features(t) for t in texts])
+        return self._project(feats)
+
+
+def _l2_normalize(x):
+    import jax.numpy as jnp
+    return x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-9)
+
+
+_default: HashedNgramEmbedder | None = None
+_default_lock = threading.Lock()
+
+
+def default_embedder() -> HashedNgramEmbedder:
+    """Shared singleton (the projection matrix is 24 MB; build it once)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = HashedNgramEmbedder()
+    return _default
+
+
+def cosine(a: np.ndarray, b: np.ndarray) -> float:
+    na, nb = float(np.linalg.norm(a)), float(np.linalg.norm(b))
+    if na < 1e-9 or nb < 1e-9:
+        return 0.0
+    return float(np.dot(a, b) / (na * nb))

@@ -1,0 +1,178 @@
+"""QueryRouter — strategy selector backed by the predictive QueryCache.
+
+Reference parity: src/query_router_engine.py:465-691.  Cache-hit logic:
+
+1. Heavy context + cached "nano" prediction → re-route with the live strategy
+   (a long conversation can make a previously-simple query complex).
+2. Low prediction confidence (mixed routing history) → re-route live.
+3. Otherwise return the history-predicted device directly.
+
+``change_strategy`` swaps the strategy object but keeps the cache and perf
+state (the Flask app relies on this, src/app.py:46-53).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import BENCHMARK_CFG
+from .cache import QueryCache
+from .embedder import default_embedder
+from .strategies import AVAILABLE_STRATEGIES, HybridStrategy, SemanticStrategy
+from .types import RoutingDecision
+
+logger = logging.getLogger(__name__)
+
+
+class QueryRouter:
+    AVAILABLE_STRATEGIES = AVAILABLE_STRATEGIES
+
+    def __init__(self, strategy: str = "token", config: Optional[Dict[str, Any]] = None):
+        self.config = dict(config) if config is not None else dict(BENCHMARK_CFG)
+
+        if strategy not in AVAILABLE_STRATEGIES:
+            raise ValueError(
+                f"Unknown strategy={strategy}. Available={list(AVAILABLE_STRATEGIES)}")
+
+        self.strategy_name = strategy
+        self.cache_enabled = bool(self.config.get("cache_enabled", True))
+
+        self._cache = QueryCache(
+            max_size=int(self.config.get("cache_max_size", 500)),
+            ttl_seconds=int(self.config.get("cache_ttl_seconds", 3600)),
+            similarity_threshold=float(self.config.get("cache_similarity_threshold", 0.85)),
+            use_semantic=bool(self.config.get("use_semantic_cache", True)),
+            prediction_confidence_threshold=float(
+                self.config.get("prediction_confidence_threshold", 0.70)),
+        )
+
+        # One shared embedder: encodes each query once, reused for the
+        # semantic strategy, cache lookup, and cache insert
+        # (reference: query_router_engine.py:508-511 uses a second
+        # SentenceTransformer instance; we share a singleton instead).
+        self.cache_embedder = None
+        if self.config.get("use_semantic_cache", True):
+            self.cache_embedder = default_embedder()
+
+        self.router = self._build_strategy(strategy)
+
+    def _build_strategy(self, strategy: str):
+        cls = AVAILABLE_STRATEGIES[strategy]
+        if cls in (SemanticStrategy, HybridStrategy):
+            return cls(self.config, embedder=self.cache_embedder or default_embedder())
+        return cls(self.config)
+
+    @property
+    def strategy(self) -> str:
+        return self.strategy_name
+
+    # ------------------------------------------------------------------
+
+    def route_query(
+        self,
+        query: str,
+        context: Optional[str] = None,
+        context_key: Optional[str] = None,
+    ) -> RoutingDecision:
+        ctx_key = context_key or "default"
+
+        q_emb: Optional[np.ndarray] = None
+        if self.cache_enabled and self.cache_embedder is not None:
+            try:
+                q_emb = self.cache_embedder.encode([query])[0]
+            except Exception as exc:
+                logger.warning("cache embedding failed, continuing uncached: %s", exc)
+
+        if self.cache_enabled:
+            hit = self._cache.lookup(query, ctx_key, q_emb)
+            if hit is not None:
+                context_len = len(context) if context else 0
+                context_threshold = int(self.config.get("heuristic_context_chars", 800))
+
+                context_override = (context_len >= context_threshold
+                                    and hit.predicted_device == "nano")
+                low_confidence = hit.use_hybrid_fallback
+
+                if context_override or low_confidence:
+                    reason = (
+                        f"context_len={context_len}>={context_threshold} overrides cached nano"
+                        if context_override
+                        else f"low prediction confidence={hit.predicted_confidence:.2f}"
+                    )
+                    decision = self.router.route(query, context)
+                    self._cache.insert(
+                        query, ctx_key,
+                        device=decision.device,
+                        confidence=decision.confidence,
+                        method=decision.method,
+                        q_emb=q_emb,
+                    )
+                    decision.reasoning = (
+                        f"cache hit (hybrid re-route: {reason}) | " + decision.reasoning)
+                    decision.cache_hit = True
+                    return decision
+
+                age = int(time.time() - hit.entry.timestamp)
+                return RoutingDecision(
+                    device=hit.predicted_device,
+                    confidence=hit.predicted_confidence,
+                    method=f"{self.strategy_name}_cached",
+                    reasoning=(
+                        f"cache hit age={age}s hits={hit.entry.hit_count} "
+                        f"predicted={hit.predicted_device} "
+                        f"conf={hit.predicted_confidence:.2f} "
+                        f"context_len={context_len} "
+                        f"history={len(hit.entry.routing_history)}"
+                    ),
+                    cache_hit=True,
+                )
+
+        decision = self.router.route(query, context)
+
+        if self.cache_enabled:
+            self._cache.insert(
+                query, ctx_key,
+                device=decision.device,
+                confidence=decision.confidence,
+                method=decision.method,
+                q_emb=q_emb,
+            )
+
+        return decision
+
+    # -- cache passthroughs (reference: query_router_engine.py:651-677) ----
+
+    def warm_up_cache(self, pairs: List[Tuple[str, str, str]]) -> None:
+        self._cache.warm_up(pairs, embedder=self.cache_embedder)
+
+    def save_cache(self, path: str) -> None:
+        self._cache.save(path)
+
+    def load_cache(self, path: str) -> int:
+        return self._cache.load(path)
+
+    def invalidate_cache(self, context_key: Optional[str] = None,
+                         query_pattern: Optional[str] = None) -> int:
+        return self._cache.invalidate(context_key=context_key, query_pattern=query_pattern)
+
+    def get_cache_stats(self) -> Dict[str, Any]:
+        return self._cache.stats()
+
+    def clear_cache(self) -> None:
+        self._cache.clear()
+
+    # -- perf feedback + strategy switching --------------------------------
+
+    def update_perf(self, device: str, latency_ms: float, tokens: int, ok: bool = True) -> None:
+        if hasattr(self.router, "update"):
+            self.router.update(device=device, latency_ms=latency_ms, tokens=tokens, ok=ok)
+
+    def change_strategy(self, strategy: str) -> None:
+        if strategy not in AVAILABLE_STRATEGIES:
+            raise ValueError(f"Unknown strategy={strategy}")
+        self.strategy_name = strategy
+        self.router = self._build_strategy(strategy)

@@ -1,0 +1,44 @@
+"""Token counting for routing thresholds and response accounting.
+
+Reference parity: src/token_counter.py (litellm ``token_counter`` with model
+"ollama/phi3") and the token strategy's fallback approximation ``len // 4``
+(src/query_router_engine.py:96).  litellm is unavailable here and the routing
+thresholds (token_threshold=1000 etc.) were tuned against a BPE tokenizer at
+roughly 4 characters/token — NOT against the engine's byte-level model
+tokenizer, which would inflate counts ~4x and break every threshold.  So the
+counter uses a BPE-calibrated estimate: word pieces of ~4 chars plus
+punctuation, which tracks the reference's fallback closely while being a
+little more faithful on code/punctuation-heavy text.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List
+
+_TOKEN_RE = re.compile(r"[A-Za-z0-9]+|[^\sA-Za-z0-9]")
+
+
+def approx_token_count(text: str) -> int:
+    """Estimate BPE token count: each run of 4 alphanumeric chars or single
+    punctuation mark counts as one token.  Empty text counts as 1 (the
+    reference floor, src/query_router_engine.py:96)."""
+    if not text:
+        return 1
+    count = 0
+    for piece in _TOKEN_RE.findall(text):
+        if piece[0].isalnum():
+            count += max(1, (len(piece) + 3) // 4)
+        else:
+            count += 1
+    return max(1, count)
+
+
+class TokenCounter:
+    """Same surface as the reference's TokenCounter (src/token_counter.py:4-12)."""
+
+    def count_tokens(self, message: Dict[str, Any]) -> int:
+        return approx_token_count(str(message.get("content", "")))
+
+    def get_context_size(self, history: List[Dict[str, Any]]) -> int:
+        return sum(self.count_tokens(m) for m in history if isinstance(m, dict))

@@ -1,0 +1,24 @@
+"""Core routing datatypes.
+
+Reference parity: ``RoutingDecision`` (src/query_router_engine.py:55-62) is
+the clean seam between the routing layer and the execution layer — the whole
+serving stack below it was replaced with TPU submesh engines without touching
+anything above it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+DEVICES = ("nano", "orin")
+
+
+@dataclasses.dataclass
+class RoutingDecision:
+    device: str                                # "nano" | "orin"
+    confidence: float
+    method: str
+    reasoning: str
+    complexity_score: Optional[float] = None
+    cache_hit: bool = False

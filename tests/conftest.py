@@ -1,0 +1,30 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+The reference has no device-free test story (SURVEY.md §4.6); we do better —
+multi-chip sharding is validated on host CPU via
+``--xla_force_host_platform_device_count`` so the whole suite runs without a
+TPU.  Must be set before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+# Force (not setdefault): the dev/bench environment exports
+# JAX_PLATFORMS=axon globally, and the single tunneled TPU chip must never be
+# claimed by the test suite — concurrent claims wedge every python process.
+# The env hook alone is NOT enough: sitecustomize imports jax at interpreter
+# start (before this file runs), so jax has already snapshotted
+# JAX_PLATFORMS=axon — override via jax.config as well.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    # Read by the CPU backend at first use, which hasn't happened yet.
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

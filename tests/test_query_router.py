@@ -1,0 +1,135 @@
+"""QueryRouter + cache-hit override logic (reference parity:
+src/query_router_engine.py:465-691) and embedder behavior."""
+
+import numpy as np
+import pytest
+
+from distributed_llm_tpu.config import BENCHMARK_CFG, PRODUCTION_CFG
+from distributed_llm_tpu.routing.embedder import HashedNgramEmbedder
+from distributed_llm_tpu.routing.engine import QueryRouter
+
+
+def prod_cfg(**kw):
+    cfg = dict(PRODUCTION_CFG)
+    cfg.update(kw)
+    return cfg
+
+
+# -- embedder ---------------------------------------------------------------
+
+def test_embedder_deterministic_and_normalized():
+    e1, e2 = HashedNgramEmbedder(), HashedNgramEmbedder()
+    a = e1.encode(["hello world"])[0]
+    b = e2.encode(["hello world"])[0]
+    np.testing.assert_allclose(a, b, rtol=1e-5)
+    assert np.linalg.norm(a) == pytest.approx(1.0, abs=1e-4)
+
+
+def test_embedder_similarity_ordering():
+    e = HashedNgramEmbedder()
+    base, near, far = e.encode([
+        "how do I improve my sleep quality",
+        "tips to improve sleep quality",
+        "implement a red-black tree in rust",
+    ])
+    assert float(base @ near) > float(base @ far)
+
+
+# -- QueryRouter ------------------------------------------------------------
+
+def test_unknown_strategy_rejected():
+    with pytest.raises(ValueError):
+        QueryRouter(strategy="nope", config=dict(BENCHMARK_CFG))
+
+
+def test_cache_disabled_no_cache_traffic():
+    qr = QueryRouter(strategy="token", config=dict(BENCHMARK_CFG))
+    d = qr.route_query("hello")
+    assert d.cache_hit is False
+    assert qr.get_cache_stats()["size"] == 0
+
+
+def test_cache_miss_then_predictive_hit():
+    qr = QueryRouter(strategy="heuristic", config=prod_cfg())
+    first = qr.route_query("What is the capital of France", context_key="k")
+    assert first.cache_hit is False
+    second = qr.route_query("What is the capital of France", context_key="k")
+    assert second.cache_hit is True
+    assert second.method == "heuristic_cached"
+    assert second.device == first.device
+
+
+def test_context_override_reroutes_cached_nano():
+    qr = QueryRouter(strategy="heuristic",
+                     config=prod_cfg(heuristic_context_chars=50))
+    qr.route_query("What is the capital of France", context_key="k")
+    heavy_ctx = "x" * 100
+    d = qr.route_query("What is the capital of France", context=heavy_ctx,
+                       context_key="k")
+    assert d.cache_hit is True
+    assert "hybrid re-route" in d.reasoning
+    assert d.device == "orin"   # heuristic re-route sees the heavy context
+
+
+def test_low_confidence_reroutes():
+    qr = QueryRouter(strategy="heuristic", config=prod_cfg())
+    # Build a mixed history by hand → low vote share
+    for dev in ("nano", "orin") * 3:
+        qr._cache.insert("tie question", "k", device=dev, confidence=1.0)
+    d = qr.route_query("tie question", context_key="k")
+    assert d.cache_hit is True
+    assert "low prediction confidence" in d.reasoning
+
+
+def test_change_strategy_keeps_cache():
+    qr = QueryRouter(strategy="token", config=prod_cfg())
+    qr.route_query("What is the capital of France", context_key="k")
+    size_before = qr.get_cache_stats()["size"]
+    qr.change_strategy("heuristic")
+    assert qr.strategy == "heuristic"
+    assert qr.get_cache_stats()["size"] == size_before
+    d = qr.route_query("What is the capital of France", context_key="k")
+    assert d.method == "heuristic_cached"
+
+
+def test_update_perf_reaches_perf_strategy():
+    qr = QueryRouter(strategy="perf", config=dict(BENCHMARK_CFG))
+    assert qr.route_query("q").device == "nano"    # no stats yet
+    qr.update_perf("orin", latency_ms=100, tokens=100, ok=True)
+    qr.update_perf("nano", latency_ms=5000, tokens=10, ok=True)
+    assert qr.route_query("q").device == "orin"
+
+
+def test_update_perf_noop_for_other_strategies():
+    qr = QueryRouter(strategy="token", config=dict(BENCHMARK_CFG))
+    qr.update_perf("nano", 1.0, 1)   # must not raise
+
+
+def test_warm_up_save_load(tmp_path):
+    qr = QueryRouter(strategy="hybrid", config=prod_cfg())
+    qr.warm_up_cache([("hello", "demo", "nano"), ("what is 2+2", "demo", "nano")])
+    assert qr.get_cache_stats()["size"] == 2
+    d = qr.route_query("hello", context_key="demo")
+    assert d.cache_hit is True
+
+    path = str(tmp_path / "cache.json")
+    qr.save_cache(path)
+    qr2 = QueryRouter(strategy="hybrid", config=prod_cfg())
+    assert qr2.load_cache(path) == 2
+    assert qr2.invalidate_cache(context_key="demo") == 2
+    qr2.clear_cache()
+    assert qr2.get_cache_stats()["size"] == 0
+
+
+def test_smoke_flow_mirrors_reference_demo():
+    """Mirror of the reference's __main__ smoke test
+    (src/query_router_engine.py:734-764), runnable with no devices."""
+    qr = QueryRouter(strategy="hybrid", config=prod_cfg())
+    tests = ["hello", "what is 2+2",
+             "Explain quantum computing and its implications for cryptography"]
+    first = [qr.route_query(t, context_key="demo") for t in tests]
+    assert all(d.cache_hit is False for d in first)
+    second = [qr.route_query(t, context_key="demo") for t in tests]
+    assert all(d.cache_hit for d in second)
+    stats = qr.get_cache_stats()
+    assert stats["size"] == 3 and stats["hits"] >= 3

@@ -1,0 +1,197 @@
+"""QueryCache semantics (reference parity: src/cache.py)."""
+
+import numpy as np
+import pytest
+
+from distributed_llm_tpu.routing.cache import (
+    CacheEntry, QueryCache, PREDICTION_CONFIDENCE_THRESHOLD, RECENCY_DECAY)
+
+
+def make_cache(**kw):
+    defaults = dict(max_size=4, ttl_seconds=3600, similarity_threshold=0.85,
+                    use_semantic=True)
+    defaults.update(kw)
+    return QueryCache(**defaults)
+
+
+def test_exact_hit_and_miss():
+    c = make_cache()
+    assert c.lookup("hello", "ctx") is None
+    c.insert("hello", "ctx", device="nano", confidence=0.9, method="token")
+    hit = c.lookup("hello", "ctx")
+    assert hit is not None
+    assert hit.entry.query == "hello"
+    assert hit.predicted_device == "nano"
+    # hash is case/whitespace-normalized on the query
+    assert c.lookup("  HELLO ", "ctx") is not None
+
+
+def test_context_key_separation():
+    c = make_cache()
+    c.insert("hello", "ctx-a", device="nano")
+    assert c.lookup("hello", "ctx-b") is None
+
+
+def test_ttl_expiry(monkeypatch):
+    c = make_cache(ttl_seconds=10)
+    c.insert("q", "ctx", device="nano")
+    import distributed_llm_tpu.routing.cache as cache_mod
+    real_now = cache_mod._utcnow()
+    monkeypatch.setattr(cache_mod, "_utcnow", lambda: real_now + 11)
+    assert c.lookup("q", "ctx") is None
+    assert c.stats()["evictions"] >= 1
+
+
+def test_lru_eviction_prefers_stale(monkeypatch):
+    import distributed_llm_tpu.routing.cache as cache_mod
+    t = [1000.0]
+    monkeypatch.setattr(cache_mod, "_utcnow", lambda: t[0])
+    c = make_cache(max_size=2, ttl_seconds=50)
+    c.insert("old", "ctx", device="nano")
+    t[0] += 100              # "old" is now stale
+    c.insert("fresh", "ctx", device="nano")
+    c.insert("newest", "ctx", device="nano")   # at capacity: stale evicted first
+    assert c.lookup("fresh", "ctx") is not None
+    assert c.lookup("old", "ctx") is None
+
+
+def test_lru_eviction_falls_back_to_lru():
+    c = make_cache(max_size=2)
+    c.insert("a", "ctx", device="nano")
+    c.insert("b", "ctx", device="nano")
+    c.lookup("a", "ctx")                 # promote "a"
+    c.insert("c", "ctx", device="nano")  # evicts LRU = "b"
+    assert c.lookup("b", "ctx") is None
+    assert c.lookup("a", "ctx") is not None
+
+
+def test_insert_refreshes_in_place():
+    c = make_cache()
+    c.insert("q", "ctx", device="nano", confidence=0.9, method="token")
+    c.insert("q", "ctx", device="orin", confidence=0.8, method="hybrid")
+    assert c.stats()["size"] == 1
+    hit = c.lookup("q", "ctx")
+    assert len(hit.entry.routing_history) == 2
+    assert hit.entry.device_used == "orin"
+
+
+def test_predict_device_recency_decay():
+    e = CacheEntry(query="q", query_hash="h", context_key="c", embedding=None,
+                   timestamp=0.0, device_used="nano")
+    # Old strong nano votes, newest orin vote: decay keeps nano ahead
+    for _ in range(5):
+        e.record_routing("nano", 1.0, "token")
+    e.record_routing("orin", 1.0, "hybrid")
+    dev, conf = e.predict_device()
+    assert dev == "nano"
+    # Weights: orin=1.0; nano = d + d^2 + ... + d^5
+    d = RECENCY_DECAY
+    nano_w = sum(d ** i for i in range(1, 6))
+    assert conf == pytest.approx(nano_w / (1.0 + nano_w), abs=1e-6)
+
+
+def test_predict_device_tie_goes_to_orin():
+    e = CacheEntry(query="q", query_hash="h", context_key="c", embedding=None,
+                   timestamp=0.0, device_used="nano")
+    e.record_routing("orin", 1.0, "m")   # single record → full share, orin
+    dev, conf = e.predict_device()
+    assert dev == "orin" and conf == 1.0
+
+
+def test_predict_device_empty_history():
+    e = CacheEntry(query="q", query_hash="h", context_key="c", embedding=None,
+                   timestamp=0.0, device_used="orin")
+    assert e.predict_device() == ("orin", 0.5)
+
+
+def test_history_capped_at_20():
+    e = CacheEntry(query="q", query_hash="h", context_key="c", embedding=None,
+                   timestamp=0.0, device_used="nano")
+    for _ in range(30):
+        e.record_routing("nano", 1.0, "m")
+    assert len(e.routing_history) == 20
+
+
+def test_hybrid_fallback_flag_on_mixed_history():
+    c = make_cache(prediction_confidence_threshold=0.70)
+    # alternate devices → winning share near 0.5 < 0.70
+    for dev in ["nano", "orin"] * 5:
+        c.insert("q", "ctx", device=dev, confidence=1.0)
+    hit = c.lookup("q", "ctx")
+    assert hit.use_hybrid_fallback
+    assert c.stats()["hybrid_fallbacks"] == 1
+
+
+def test_semantic_lookup():
+    c = make_cache(similarity_threshold=0.9)
+    emb = np.array([1.0, 0.0, 0.0], dtype=np.float32)
+    c.insert("original question", "ctx", device="orin", q_emb=emb)
+    near = np.array([0.99, 0.1, 0.0], dtype=np.float32)
+    hit = c.lookup("different wording", "ctx", q_emb=near)
+    assert hit is not None and hit.entry.query == "original question"
+    far = np.array([0.0, 1.0, 0.0], dtype=np.float32)
+    assert c.lookup("unrelated", "ctx", q_emb=far) is None
+    # semantic scan never crosses context keys
+    assert c.lookup("different wording", "other-ctx", q_emb=near) is None
+
+
+def test_semantic_disabled_without_embedding():
+    c = make_cache(use_semantic=False)
+    c.insert("original", "ctx", device="nano",
+             q_emb=np.ones(3, dtype=np.float32))
+    assert c.lookup("reworded", "ctx", q_emb=np.ones(3, dtype=np.float32)) is None
+
+
+def test_invalidate_by_context_pattern_and_all():
+    c = make_cache(max_size=10)
+    c.insert("alpha query", "c1", device="nano")
+    c.insert("beta query", "c1", device="nano")
+    c.insert("alpha query", "c2", device="nano")
+    assert c.invalidate(context_key="c1", query_pattern="ALPHA") == 1
+    assert c.invalidate(context_key="c2") == 1
+    assert c.invalidate() == 1
+    assert c.stats()["size"] == 0
+
+
+def test_save_load_roundtrip(tmp_path):
+    c = make_cache()
+    emb = np.array([0.5, 0.5], dtype=np.float32)
+    c.insert("persisted", "ctx", device="orin", confidence=0.8,
+             method="hybrid", q_emb=emb)
+    path = str(tmp_path / "cache.json")
+    c.save(path)
+
+    c2 = make_cache()
+    assert c2.load(path) == 1
+    hit = c2.lookup("persisted", "ctx")
+    assert hit.predicted_device == "orin"
+    np.testing.assert_allclose(hit.entry.embedding, emb)
+    assert c2.load(str(tmp_path / "missing.json")) == 0
+
+
+def test_stats_shape():
+    c = make_cache()
+    c.insert("q1", "ctx", device="nano")
+    c.lookup("q1", "ctx")
+    c.lookup("q2", "ctx")
+    s = c.stats()
+    assert s["size"] == 1 and s["valid"] == 1 and s["stale"] == 0
+    assert s["hits"] == 1 and s["attempts"] == 2 and s["hit_rate"] == 0.5
+    assert s["top_queries"][0]["query"] == "q1"
+    for key in ("evictions", "hybrid_fallbacks", "max_size"):
+        assert key in s
+
+
+def test_warm_up_and_clear():
+    c = make_cache(max_size=10)
+
+    class FakeEmbedder:
+        def encode(self, texts):
+            return [np.ones(4, dtype=np.float32) for _ in texts]
+
+    c.warm_up([("a", "ctx", "nano"), ("b", "ctx", "orin")], embedder=FakeEmbedder())
+    assert c.stats()["size"] == 2
+    assert c.lookup("a", "ctx").entry.embedding is not None
+    c.clear()
+    s = c.stats()
+    assert s["size"] == 0 and s["attempts"] == 0 and s["hits"] == 0

@@ -1,0 +1,216 @@
+"""Routing strategy semantics (reference parity: src/query_router_engine.py)."""
+
+import pytest
+
+from distributed_llm_tpu.config import BENCHMARK_CFG
+from distributed_llm_tpu.routing.strategies import (
+    HeuristicStrategy, HybridStrategy, PerfStrategy, SemanticStrategy,
+    TokenStrategy)
+from distributed_llm_tpu.routing.token_counter import TokenCounter, approx_token_count
+
+
+CFG = dict(BENCHMARK_CFG)
+
+
+# -- token counter ----------------------------------------------------------
+
+def test_token_count_tracks_4_chars_per_token():
+    text = "hello world this is a simple sentence about nothing much"
+    est = approx_token_count(text)
+    assert abs(est - len(text) / 4) / (len(text) / 4) < 0.35
+    assert approx_token_count("") == 1
+
+
+def test_token_counter_over_history():
+    tc = TokenCounter()
+    hist = [{"role": "user", "content": "hello there"},
+            {"role": "assistant", "content": "hi, how can I help?"}]
+    assert tc.get_context_size(hist) == sum(tc.count_tokens(m) for m in hist)
+
+
+# -- token strategy ---------------------------------------------------------
+
+def test_token_strategy_threshold():
+    r = TokenStrategy({**CFG, "token_threshold": 10})
+    small = r.route("hi")
+    assert small.device == "nano" and small.method == "token"
+    big = r.route("word " * 200)
+    assert big.device == "orin"
+    assert big.confidence == pytest.approx(
+        min(abs(big.complexity_score - 10) / 10, 1.0))
+
+
+def test_token_strategy_includes_context():
+    r = TokenStrategy({**CFG, "token_threshold": 10})
+    assert r.route("hi", context="lots of context " * 50).device == "orin"
+
+
+# -- heuristic strategy -----------------------------------------------------
+
+def test_heuristic_complex_pattern():
+    r = HeuristicStrategy(CFG)
+    d = r.route("Please implement a function for knapsack")
+    assert d.device == "orin" and d.confidence == 0.92 and d.method == "heuristic"
+
+
+def test_heuristic_long_query():
+    r = HeuristicStrategy({**CFG, "heuristic_long_chars": 50})
+    d = r.route("purple elephant banana " * 6)   # avoid pattern buckets
+    assert d.device == "orin" and d.confidence == 0.80
+    assert "long query" in d.reasoning
+
+
+def test_heuristic_multi_question():
+    r = HeuristicStrategy(CFG)   # canonical multi_qmarks = 2
+    d = r.route("Elephants? Giraffes?")
+    assert d.device == "orin" and "multi-question" in d.reasoning
+
+
+def test_heuristic_code_markers():
+    r = HeuristicStrategy(CFG)
+    d = r.route("my snippet { x == y; }")
+    assert d.device == "orin" and d.confidence == 0.88
+
+
+def test_heuristic_heavy_context():
+    r = HeuristicStrategy({**CFG, "heuristic_context_chars": 100})
+    d = r.route("short bland sentence", context="c" * 150)
+    assert d.device == "orin" and d.confidence == 0.75
+
+
+def test_heuristic_simple_pattern():
+    r = HeuristicStrategy(CFG)
+    d = r.route("What is the capital of France")
+    assert d.device == "nano" and d.confidence == 0.90
+
+
+def test_heuristic_short_everyday():
+    r = HeuristicStrategy(CFG)
+    d = r.route("purple elephant banana again")
+    assert d.device == "nano" and d.confidence == 0.75
+
+
+def test_heuristic_fallback_half_confidence():
+    r = HeuristicStrategy({**CFG, "token_threshold": 10})
+    # >15 words, >100 chars, no pattern buckets
+    q = ("zebra quartz melon violet " * 6)
+    d = r.route(q)
+    assert d.method == "heuristic_fallback"
+    token_d = TokenStrategy({**CFG, "token_threshold": 10}).route(q)
+    assert d.confidence == pytest.approx(token_d.confidence * 0.5)
+
+
+def test_heuristic_rule_order_complex_beats_long():
+    r = HeuristicStrategy({**CFG, "heuristic_long_chars": 10})
+    d = r.route("implement a function that is long enough to be long")
+    assert "complex pattern" in d.reasoning   # complex checked before length
+
+
+# -- semantic strategy ------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def semantic():
+    return SemanticStrategy(dict(CFG))
+
+
+def test_semantic_routes_simple_to_nano(semantic):
+    d = semantic.route("What is the capital of Italy?")
+    assert d.device == "nano"
+
+
+def test_semantic_routes_complex_to_orin(semantic):
+    d = semantic.route(
+        "Write a comprehensive research proposal with methodology and an "
+        "evaluation plan for optimizing inference on edge devices.")
+    assert d.device == "orin"
+
+
+def test_semantic_fallback_irrelevant():
+    s = SemanticStrategy({**CFG, "semantic_min_similarity": 1.1})
+    d = s.route("anything at all")
+    assert d.method == "semantic_fallback_irrelevant"
+    token_d = TokenStrategy(CFG).route("anything at all")
+    assert d.confidence == pytest.approx(token_d.confidence * 0.5)
+
+
+def test_semantic_fallback_ambiguous():
+    s = SemanticStrategy({**CFG, "semantic_margin_threshold": 2.0,
+                          "semantic_min_similarity": -2.0})
+    d = s.route("hello")
+    assert d.method == "semantic_fallback_ambiguous"
+    assert 0.0 <= d.confidence < 2.0
+
+
+def test_semantic_requires_3_labels_per_class(tmp_path):
+    import json
+    path = tmp_path / "labels.json"
+    path.write_text(json.dumps([{"text": "a", "label": "nano"}]))
+    with pytest.raises(ValueError):
+        SemanticStrategy({**CFG, "semantic_label_path": str(path)})
+
+
+# -- hybrid strategy --------------------------------------------------------
+
+def test_hybrid_weighted_vote():
+    h = HybridStrategy(dict(CFG))
+    assert set(h.members) == {"token", "semantic", "heuristic"}
+    d = h.route("Implement a distributed system architecture with a "
+                "comprehensive design document and trade-off analysis.")
+    assert d.device == "orin" and d.method == "hybrid"
+    assert "nano_score=" in d.reasoning and "orin_score=" in d.reasoning
+
+
+def test_hybrid_confidence_is_margin_over_total():
+    h = HybridStrategy(dict(CFG))
+    d = h.route("hello")
+    assert 0.0 <= d.confidence <= 1.0
+
+
+def test_hybrid_respects_weights():
+    # All weight on heuristic → hybrid mirrors the heuristic vote
+    h = HybridStrategy({**CFG, "weights": {"token": 0.0, "semantic": 0.0,
+                                           "heuristic": 1.0}})
+    d = h.route("What is the capital of France")
+    assert d.device == "nano" and d.confidence == pytest.approx(1.0)
+
+
+# -- perf strategy ----------------------------------------------------------
+
+def test_perf_default_nano_when_no_stats():
+    p = PerfStrategy(CFG)
+    d = p.route("anything")
+    assert d.device == "nano" and d.confidence == 0.2
+
+
+def test_perf_prefers_lower_latency_per_token():
+    p = PerfStrategy(CFG)
+    p.update("nano", latency_ms=1000, tokens=10, ok=True)    # 100 ms/tok
+    p.update("orin", latency_ms=1000, tokens=100, ok=True)   # 10 ms/tok
+    d = p.route("q")
+    assert d.device == "orin" and d.confidence == 0.70
+
+
+def test_perf_failure_penalty_steers_away():
+    p = PerfStrategy({**CFG, "perf_fail_penalty": 3000.0})
+    p.update("orin", latency_ms=100, tokens=100, ok=False)   # 1 + 3000
+    p.update("nano", latency_ms=1000, tokens=10, ok=True)    # 100
+    assert p.route("q").device == "nano"
+
+
+def test_perf_single_sided_stats():
+    p = PerfStrategy(CFG)
+    p.update("orin", latency_ms=100, tokens=100, ok=True)
+    assert p.route("q").device == "orin"   # inf on nano side loses
+
+
+def test_perf_window_bounded():
+    p = PerfStrategy({**CFG, "perf_window": 5})
+    for _ in range(50):
+        p.update("nano", 100, 10, True)
+    assert len(p.samples["nano"]) == 5
+
+
+def test_perf_zero_tokens_uses_mean_latency():
+    p = PerfStrategy(CFG)
+    p.update("nano", latency_ms=500, tokens=0, ok=True)
+    assert p._score("nano") == pytest.approx(500.0)
