@@ -171,7 +171,7 @@ def bench_cluster() -> ClusterConfig:
     )
 
 
-def test_cluster() -> ClusterConfig:
+def tiny_cluster() -> ClusterConfig:
     """Tiny cluster for CPU unit tests (8 virtual devices: 1 + 4 used)."""
     return ClusterConfig(
         nano=TierConfig(name="nano", model_preset="nano_test", tp=1,

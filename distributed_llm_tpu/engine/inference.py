@@ -1,0 +1,259 @@
+"""The TPU inference engine: compiled prefill + autoregressive decode.
+
+This replaces the reference's entire Ollama dependency (the "/api/generate"
+hot loop, SURVEY.md §3.1): tokenize → bucketed prefill → XLA-compiled
+``lax.while_loop`` decode with the KV cache resident in HBM → detokenize.
+
+Compilation strategy (the part the reference never had to think about):
+
+- **Prefill** is jitted once per (batch, bucket) shape.  Prompts are
+  right-padded up to the nearest bucket so arbitrary prompt lengths reuse a
+  handful of compiled programs instead of recompiling per length.
+- **Decode** is ONE jitted ``lax.while_loop`` over a fixed-size KV cache
+  (cfg.max_seq_len), compiled once per engine regardless of bucket: the
+  whole multi-token generation is a single device call, with data-dependent
+  early exit on EOS — no per-token host round-trips.
+- The prefill call also seeds the cache and samples the first token, so
+  TTFT == one device call after tokenize.
+
+Timing: TTFT and total latency are measured around the two device calls,
+feeding the perf routing strategy and the req/s + p50 TTFT headline metric
+(BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import TierConfig
+from ..models import transformer
+from ..ops.sampling import sample_token_dynamic
+from .tokenizer import ByteTokenizer
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    text: str
+    token_ids: List[int]
+    prompt_tokens: int
+    gen_tokens: int
+    ttft_ms: float
+    total_ms: float
+
+    @property
+    def tokens_per_s(self) -> float:
+        if self.total_ms <= 0 or self.gen_tokens == 0:
+            return 0.0
+        return 1000.0 * self.gen_tokens / self.total_ms
+
+
+class InferenceEngine:
+    """Single-tier engine: one model, one (sub)mesh, synchronous generate().
+
+    ``shardings`` (optional) carries NamedShardings for params/cache built by
+    parallel/sharding.py; without it everything lives on one device.
+    """
+
+    def __init__(
+        self,
+        tier: TierConfig,
+        seed: int = 0,
+        params: Optional[Dict[str, Any]] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ):
+        self.tier = tier
+        self.cfg = tier.model()
+        self.tokenizer = ByteTokenizer()
+        self.mesh = mesh
+        self._rng = jax.random.PRNGKey(seed ^ 0x5EED)
+
+        if devices is None and mesh is not None:
+            devices = list(mesh.devices.flat)
+        self.devices = devices
+
+        if params is None:
+            params = self._init_params(seed)
+        self.params = params
+
+        self._prefill_fns: Dict[int, Any] = {}
+        self._decode_fn = None
+        self._max_seq = self.cfg.max_seq_len
+
+    # ------------------------------------------------------------------
+
+    def _init_params(self, seed: int) -> Dict[str, Any]:
+        init = jax.jit(partial(transformer.init_params, self.cfg),
+                       static_argnames=("seed",))
+        if self.mesh is not None:
+            from ..parallel.sharding import param_shardings
+            shardings = param_shardings(self.cfg, self.mesh)
+            init = jax.jit(partial(transformer.init_params, self.cfg),
+                           static_argnames=("seed",), out_shardings=shardings)
+        elif self.devices:
+            init = jax.jit(partial(transformer.init_params, self.cfg),
+                           static_argnames=("seed",),
+                           out_shardings=jax.sharding.SingleDeviceSharding(self.devices[0]))
+        return init(seed=seed)
+
+    # -- compiled stages ---------------------------------------------------
+
+    def _prefill_fn(self, bucket: int):
+        """Jitted per bucket: embed+forward the padded prompt, seed the
+        fixed-size KV cache, sample the first token."""
+        if bucket in self._prefill_fns:
+            return self._prefill_fns[bucket]
+
+        cfg = self.cfg
+
+        def run(params, tokens, true_len, rng, temperature):
+            b, s = tokens.shape
+            positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+            hidden, (k_all, v_all) = transformer.prefill(cfg, params, tokens, positions)
+            # logits only at each sequence's last real position
+            last = hidden[jnp.arange(b), true_len - 1]
+            logits = transformer.logits_from_hidden(params, last)
+            first = sample_token_dynamic(logits, rng, temperature)
+
+            cache = transformer.init_kv_cache(cfg, b, self._max_seq)
+            cache = {
+                "k": jax.lax.dynamic_update_slice(
+                    cache["k"], k_all, (0, 0, 0, 0, 0)),
+                "v": jax.lax.dynamic_update_slice(
+                    cache["v"], v_all, (0, 0, 0, 0, 0)),
+            }
+            return first, cache
+
+        fn = jax.jit(run)
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    def _decode_loop(self):
+        """Jitted once: the full generation loop as one device call."""
+        if self._decode_fn is not None:
+            return self._decode_fn
+
+        cfg = self.cfg
+        eos = self.tokenizer.eos_id
+        pad = self.tokenizer.pad_id
+        max_new = self.tier.max_new_tokens   # static cap: sizes the buffer
+
+        def run(params, cache, first_token, prompt_len, rng, temperature,
+                token_budget):
+            # ``token_budget`` is a runtime operand (≤ max_new): per-request
+            # num_predict overrides exit the loop early instead of decoding
+            # the full tier cap and trimming on host.
+            b = first_token.shape[0]
+            out = jnp.full((b, max_new), pad, jnp.int32)
+            out = out.at[:, 0].set(first_token)
+            done = first_token == eos
+
+            def cond(state):
+                step, _, _, done, _ = state
+                return (step < token_budget) & ~jnp.all(done)
+
+            def body(state):
+                step, out, cache, done, rng = state
+                cur = out[:, step - 1]
+                pos = prompt_len + step - 1       # position of `cur`
+                logits, cache = transformer.decode_step(cfg, params, cur, pos, cache)
+                rng, sub = jax.random.split(rng)
+                nxt = sample_token_dynamic(logits, sub, temperature)
+                nxt = jnp.where(done, pad, nxt)
+                out = out.at[:, step].set(nxt)
+                done = done | (nxt == eos)
+                return step + 1, out, cache, done, rng
+
+            step, out, cache, done, rng = jax.lax.while_loop(
+                cond, body, (jnp.int32(1), out, cache, done, rng))
+            return out, step
+
+        # Donate the KV cache so the loop updates it in place in HBM.
+        # (CPU can't donate these buffers and warns, so gate on backend.)
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        self._decode_fn = jax.jit(run, donate_argnums=donate)
+        return self._decode_fn
+
+    # -- host orchestration ------------------------------------------------
+
+    def _pick_bucket(self, n: int) -> int:
+        for b in self.tier.prefill_buckets:
+            if n <= b and b <= self._max_seq:
+                return b
+        return min(max(self.tier.prefill_buckets), self._max_seq)
+
+    def generate(
+        self,
+        history: Union[str, Sequence[Dict[str, Any]]],
+        max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+    ) -> GenerationResult:
+        """Synchronous generation from a prompt string or chat history.
+
+        ``max_new_tokens`` may only shrink below the tier's compiled cap
+        (the loop exits early), mirroring the reference's per-request
+        ``num_predict`` override (src/devices/nano_api.py:62).
+        ``temperature`` likewise overrides the tier default per request;
+        both are runtime operands — no recompilation.
+        """
+        t0 = time.perf_counter()
+        ids = self.tokenizer.encode_history(history)
+
+        # Budget: prompt must leave room to generate; keep the TAIL (most
+        # recent turns) like the reference's silent context truncation.
+        max_prompt = self._max_seq - self.tier.max_new_tokens
+        if len(ids) > max_prompt:
+            ids = ids[-max_prompt:]
+        bucket = self._pick_bucket(len(ids))
+        if len(ids) > bucket:
+            ids = ids[-bucket:]
+
+        n = len(ids)
+        tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+        tokens[0, :n] = ids
+        true_len = np.array([n], np.int32)
+
+        self._rng, rng1, rng2 = jax.random.split(self._rng, 3)
+        temp = jnp.float32(
+            self.tier.temperature if temperature is None else temperature)
+        budget = self.tier.max_new_tokens
+        if max_new_tokens and max_new_tokens > 0:
+            budget = min(budget, max_new_tokens)
+
+        first, cache = self._prefill_fn(bucket)(
+            self.params, jnp.asarray(tokens), jnp.asarray(true_len), rng1, temp)
+        first = jax.block_until_ready(first)
+        ttft_ms = (time.perf_counter() - t0) * 1000.0
+
+        out, steps = self._decode_loop()(
+            self.params, cache, first, jnp.asarray(true_len), rng2, temp,
+            jnp.int32(budget))
+        out = np.asarray(jax.block_until_ready(out))[0]
+        total_ms = (time.perf_counter() - t0) * 1000.0
+
+        # Trim at EOS / padding
+        gen_ids: List[int] = []
+        for t in out.tolist()[:budget]:
+            if t == self.tokenizer.eos_id or t == self.tokenizer.pad_id:
+                break
+            gen_ids.append(t)
+
+        return GenerationResult(
+            text=self.tokenizer.decode(gen_ids),
+            token_ids=gen_ids,
+            prompt_tokens=n,
+            gen_tokens=len(gen_ids),
+            ttft_ms=ttft_ms,
+            total_ms=total_ms,
+        )
+
+    def warmup(self) -> None:
+        """Compile the smallest prefill bucket + the decode loop."""
+        self.generate("warmup", max_new_tokens=1)

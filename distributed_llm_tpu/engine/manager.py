@@ -1,0 +1,101 @@
+"""Engine lifecycle manager — the in-process ServerManager.
+
+The reference's ServerManager (src/models/server_manager.py) SSH-bootstraps a
+remote Flask process, opens a tunnel, and polls TCP + /health before
+declaring readiness.  With tiers as in-process engines on chip submeshes
+there is no remote process, but the *capability* survives with the same
+surface: ``start_server`` (build + compile + warm the engine; idempotent),
+``stop_server`` (drop the engine, releasing its HBM), ``is_server_running``,
+and a ``health()`` snapshot equivalent to the device servers' GET /health.
+The benchmark harness drives exactly this surface between experiment configs
+(reference: routing_chatbot_tester.py:388-394, 491-498).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+
+from ..config import TierConfig
+from .inference import InferenceEngine
+
+logger = logging.getLogger(__name__)
+
+
+class EngineManager:
+    def __init__(
+        self,
+        tier: TierConfig,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+        seed: int = 0,
+        warmup_on_start: bool = True,
+    ):
+        self.tier = tier
+        self.mesh = mesh
+        self.devices = devices
+        self.seed = seed
+        self.warmup_on_start = warmup_on_start
+        self._engine: Optional[InferenceEngine] = None
+        self._lock = threading.RLock()
+        self._started_at: Optional[float] = None
+
+    # -- lifecycle (ServerManager surface) ---------------------------------
+
+    def start_server(self) -> None:
+        """Idempotent: build the engine and compile/warm the hot paths."""
+        with self._lock:
+            if self._engine is not None:
+                return
+            t0 = time.perf_counter()
+            engine = InferenceEngine(
+                self.tier, seed=self.seed, mesh=self.mesh, devices=self.devices)
+            if self.warmup_on_start:
+                engine.warmup()
+            self._engine = engine
+            self._started_at = time.time()
+            logger.info("tier %s up in %.1fs (model=%s, devices=%s)",
+                        self.tier.name, time.perf_counter() - t0,
+                        self.tier.model_preset,
+                        [d.id for d in (self.devices or
+                                        (mesh_devs(self.mesh) or [jax.devices()[0]]))])
+
+    def stop_server(self) -> None:
+        """Drop the engine; params/KV buffers are freed with it."""
+        with self._lock:
+            self._engine = None
+            self._started_at = None
+
+    def is_server_running(self) -> bool:
+        with self._lock:
+            return self._engine is not None
+
+    def engine(self) -> InferenceEngine:
+        """Lazy-start accessor (reference: Nano.process auto-start,
+        src/models/nano.py:19-21)."""
+        with self._lock:
+            if self._engine is None:
+                self.start_server()
+            return self._engine
+
+    # -- health (device-server GET /health surface) ------------------------
+
+    def health(self) -> Dict[str, Any]:
+        with self._lock:
+            running = self._engine is not None
+            return {
+                "ok": running,
+                "tier": self.tier.name,
+                "model": self.tier.model_preset,
+                "uptime_s": (time.time() - self._started_at) if running else 0.0,
+                "devices": ([d.id for d in self.mesh.devices.flat]
+                            if self.mesh is not None else None),
+            }
+
+
+def mesh_devs(mesh):
+    return list(mesh.devices.flat) if mesh is not None else None

@@ -1,0 +1,53 @@
+"""Byte-level tokenizer.
+
+The reference gets tokenization for free from Ollama/llama.cpp; in this
+zero-egress environment no pretrained BPE vocabulary can be fetched, so the
+engine uses a self-contained byte-level scheme: ids 0-255 are raw UTF-8
+bytes, followed by PAD/BOS/EOS specials, padded to a 512 vocab so the
+embedding table tiles the MXU's 128-lane layout cleanly.
+
+Routing-threshold token counts deliberately do NOT use this tokenizer —
+byte-level counts run ~4x BPE and would break the reference-tuned thresholds;
+see routing/token_counter.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, List, Sequence, Union
+
+PAD_ID = 256
+BOS_ID = 257
+EOS_ID = 258
+VOCAB_SIZE = 512
+
+
+@dataclasses.dataclass(frozen=True)
+class ByteTokenizer:
+    pad_id: int = PAD_ID
+    bos_id: int = BOS_ID
+    eos_id: int = EOS_ID
+    vocab_size: int = VOCAB_SIZE
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        return [self.bos_id] + ids if add_bos else ids
+
+    def decode(self, ids: Iterable[int]) -> str:
+        data = bytes(i for i in ids if 0 <= int(i) < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def format_history(self, history: Union[str, Sequence[Dict[str, Any]]]) -> str:
+        """Conversation history -> prompt string, matching the reference's
+        device-server formatting: one "role: content" line per message
+        (src/devices/nano_api.py:49-56)."""
+        if isinstance(history, str):
+            return history.strip()
+        lines = [
+            f"{m.get('role', 'user')}: {m.get('content', '')}"
+            for m in history
+        ]
+        return "\n".join(lines).strip()
+
+    def encode_history(self, history: Union[str, Sequence[Dict[str, Any]]]) -> List[int]:
+        return self.encode(self.format_history(history))

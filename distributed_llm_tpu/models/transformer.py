@@ -1,0 +1,188 @@
+"""Pure-JAX LLaMA-style decoder-only transformer.
+
+This is the native model-execution core that the reference delegates to
+Ollama/llama.cpp (SURVEY.md §2.1): RMSNorm, rotary position embeddings,
+grouped-query attention, SwiGLU MLP, tied LM head.  Design choices are
+TPU-first:
+
+- **Scanned layers**: per-layer parameters are stacked along a leading [L]
+  axis and the forward pass is a single ``lax.scan`` over layers, so compile
+  time is O(1) in depth and XLA sees one fused block body.
+- **Functional params pytree** (no framework Module): makes pjit/shard_map
+  sharding annotations trivial (parallel/sharding.py maps each leaf to a
+  PartitionSpec) and keeps everything donate-able.
+- **bfloat16 params/activations** with float32 softmax/norm accumulators —
+  the MXU-native layout.
+- Static shapes everywhere; the decode step is one token per call and is
+  driven by a compiled ``lax.while_loop`` (engine/inference.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+from ..ops.attention import causal_attention, decode_attention
+
+Params = Dict[str, Any]
+KVCache = Dict[str, jax.Array]   # {"k": [L,B,S,N_kv,D], "v": [L,B,S,N_kv,D]}
+
+
+# =============================================================================
+# Init
+# =============================================================================
+
+def init_params(cfg: ModelConfig, seed: int = 0) -> Params:
+    """Deterministic random init (no pretrained weights exist in this
+    zero-egress environment; quality of text is not the contract, the
+    execution engine is)."""
+    key = jax.random.PRNGKey(seed)
+    dtype = jnp.dtype(cfg.dtype)
+    h, f, l = cfg.hidden_size, cfg.ffn_size, cfg.num_layers
+    d = cfg.head_dim
+    nq, nkv = cfg.num_heads, cfg.num_kv_heads
+
+    def normal(key, shape, scale=0.02):
+        return (scale * jax.random.normal(key, shape, jnp.float32)).astype(dtype)
+
+    ks = jax.random.split(key, 8)
+    return {
+        "embed": normal(ks[0], (cfg.vocab_size, h)),
+        "layers": {
+            "ln1": jnp.ones((l, h), dtype),
+            "wq": normal(ks[1], (l, h, nq * d)),
+            "wk": normal(ks[2], (l, h, nkv * d)),
+            "wv": normal(ks[3], (l, h, nkv * d)),
+            "wo": normal(ks[4], (l, nq * d, h)),
+            "ln2": jnp.ones((l, h), dtype),
+            "w_gate": normal(ks[5], (l, h, f)),
+            "w_up": normal(ks[6], (l, h, f)),
+            "w_down": normal(ks[7], (l, f, h)),
+        },
+        "final_ln": jnp.ones((h,), dtype),
+    }
+
+
+# =============================================================================
+# Building blocks
+# =============================================================================
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale).astype(x.dtype) * w
+
+
+def rope_sincos(positions: jax.Array, head_dim: int, theta: float
+                ) -> Tuple[jax.Array, jax.Array]:
+    """positions [...,] -> (sin, cos) each [..., head_dim/2], float32."""
+    freqs = theta ** (-jnp.arange(0, head_dim // 2, dtype=jnp.float32)
+                      / (head_dim // 2))
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
+    """Rotate-half RoPE. x: [..., N, D]; sin/cos: [..., D/2] (broadcast over N)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    sin, cos = sin[..., None, :], cos[..., None, :]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def _swiglu(x: jax.Array, gate: jax.Array, up: jax.Array, down: jax.Array) -> jax.Array:
+    return (jax.nn.silu(x @ gate) * (x @ up)) @ down
+
+
+# =============================================================================
+# Prefill (full-sequence forward)
+# =============================================================================
+
+def prefill(cfg: ModelConfig, params: Params, tokens: jax.Array,
+            positions: jax.Array) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+    """Process a full (right-padded) prompt.
+
+    tokens/positions: [B, S].  Returns (hidden [B,S,H],
+    (k_all, v_all) each [L,B,S,N_kv,D]) — the per-layer K/V to seed the cache.
+    """
+    b, s = tokens.shape
+    d = cfg.head_dim
+    x = params["embed"][tokens]                       # [B,S,H]
+    sin, cos = rope_sincos(positions, d, cfg.rope_theta)
+
+    def layer(x, lp):
+        h_in = rms_norm(x, lp["ln1"], cfg.norm_eps)
+        q = (h_in @ lp["wq"]).reshape(b, s, cfg.num_heads, d)
+        k = (h_in @ lp["wk"]).reshape(b, s, cfg.num_kv_heads, d)
+        v = (h_in @ lp["wv"]).reshape(b, s, cfg.num_kv_heads, d)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        attn = causal_attention(q, k, v).reshape(b, s, cfg.num_heads * d)
+        x = x + attn @ lp["wo"]
+        x = x + _swiglu(rms_norm(x, lp["ln2"], cfg.norm_eps),
+                        lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, (k, v)
+
+    x, (k_all, v_all) = jax.lax.scan(layer, x, params["layers"])
+    return rms_norm(x, params["final_ln"], cfg.norm_eps), (k_all, v_all)
+
+
+def logits_from_hidden(params: Params, hidden: jax.Array) -> jax.Array:
+    """Tied LM head: [..., H] -> [..., V] in float32."""
+    return (hidden @ params["embed"].T).astype(jnp.float32)
+
+
+# =============================================================================
+# Decode step (one token, KV cache)
+# =============================================================================
+
+def decode_step(cfg: ModelConfig, params: Params, token: jax.Array,
+                pos: jax.Array, kv: KVCache
+                ) -> Tuple[jax.Array, KVCache]:
+    """One autoregressive step for every sequence in the batch.
+
+    token: [B] current input token; pos: [B] its position (0-based);
+    kv: cache with [L,B,S_max,N_kv,D] arrays, written in-place at ``pos``.
+    Returns (logits [B,V] float32, updated cache).
+    """
+    b = token.shape[0]
+    d = cfg.head_dim
+    x = params["embed"][token]                        # [B,H]
+    sin, cos = rope_sincos(pos, d, cfg.rope_theta)    # [B, D/2]
+
+    def layer(x, scanned):
+        lp, k_cache, v_cache = scanned
+        h_in = rms_norm(x, lp["ln1"], cfg.norm_eps)
+        q = (h_in @ lp["wq"]).reshape(b, cfg.num_heads, d)
+        k = (h_in @ lp["wk"]).reshape(b, cfg.num_kv_heads, d)
+        v = (h_in @ lp["wv"]).reshape(b, cfg.num_kv_heads, d)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+
+        # Write this step's K/V at each sequence's own position.
+        def write(cache, new):
+            def one(c, n, p):
+                return jax.lax.dynamic_update_slice(c, n[None], (p, 0, 0))
+            return jax.vmap(one)(cache, new, pos)
+        k_cache = write(k_cache, k)
+        v_cache = write(v_cache, v)
+
+        attn = decode_attention(q, k_cache, v_cache, pos)
+        x = x + attn.reshape(b, cfg.num_heads * d) @ lp["wo"]
+        x = x + _swiglu(rms_norm(x, lp["ln2"], cfg.norm_eps),
+                        lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, (params["layers"], kv["k"], kv["v"]))
+    hidden = rms_norm(x, params["final_ln"], cfg.norm_eps)
+    return logits_from_hidden(params, hidden), {"k": k_new, "v": v_new}
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int, max_seq: int) -> KVCache:
+    shape = (cfg.num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_dim)
+    dtype = jnp.dtype(cfg.dtype)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}

@@ -1,0 +1,77 @@
+"""Attention ops for prefill and single-step decode.
+
+Pure-XLA implementations (einsum + softmax) that GSPMD can shard over a 'tp'
+mesh axis (heads dimension).  The Pallas flash-attention kernel in
+``pallas_attention.py`` replaces the prefill path on TPU when enabled; these
+remain the portable fallback and the reference semantics.
+
+Shapes follow the KV-cache layout [B, S, N_kv, D] (batch, sequence, kv-heads,
+head_dim); queries are [B, S, N_q, D] with N_q a multiple of N_kv (GQA).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _expand_kv(x: jax.Array, groups: int) -> jax.Array:
+    """[B, S, N_kv, D] -> [B, S, N_kv*groups, D] by repeating each kv head."""
+    if groups == 1:
+        return x
+    b, s, n_kv, d = x.shape
+    return jnp.broadcast_to(
+        x[:, :, :, None, :], (b, s, n_kv, groups, d)
+    ).reshape(b, s, n_kv * groups, d)
+
+
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Full-sequence causal attention (prefill).
+
+    q: [B, S, N_q, D], k/v: [B, S, N_kv, D] -> [B, S, N_q, D].
+    Softmax accumulates in float32 regardless of input dtype.
+    """
+    groups = q.shape[2] // k.shape[2]
+    k = _expand_kv(k, groups)
+    v = _expand_kv(v, groups)
+
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqnd,bknd->bnqk", q, k).astype(jnp.float32) * scale
+
+    s = q.shape[1]
+    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+    logits = jnp.where(causal[None, None], logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bnqk,bknd->bqnd", probs, v)
+
+
+def decode_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    pos: jax.Array,
+) -> jax.Array:
+    """One-token decode attention against the full KV cache.
+
+    q: [B, N_q, D] (the single new query position per sequence)
+    k_cache/v_cache: [B, S_max, N_kv, D]
+    pos: [B] current position of the query token (0-based); keys at indices
+         > pos are masked (cache slots not yet written).
+    Returns [B, N_q, D].
+    """
+    groups = q.shape[1] // k_cache.shape[2]
+    k = _expand_kv(k_cache, groups)
+    v = _expand_kv(v_cache, groups)
+
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bnd,bknd->bnk", q, k).astype(jnp.float32) * scale
+
+    s_max = k.shape[1]
+    valid = jnp.arange(s_max)[None, :] <= pos[:, None]          # [B, S_max]
+    logits = jnp.where(valid[:, None, :], logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bnk,bknd->bnd", probs, v)

@@ -1,0 +1,58 @@
+"""Token sampling as jittable functions.
+
+The reference serves greedily (temperature 0.0, src/devices/nano_api.py:21);
+temperature / top-k / top-p are provided for production parity with what an
+Ollama backend accepts via its options dict.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_token(
+    logits: jax.Array,
+    rng: jax.Array,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """logits: [B, V] -> token ids [B].  temperature<=0 means greedy.
+
+    temperature/top_k/top_p are python-static (baked into the compiled
+    decode loop per tier config), so the branches resolve at trace time.
+    """
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+
+    logits = logits.astype(jnp.float32) / temperature
+
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with cumulative mass >= top_p (always
+        # keeping the top token); cutoff is that prefix's last logit.
+        keep = cum - probs < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def sample_token_dynamic(logits: jax.Array, rng: jax.Array,
+                         temperature: jax.Array) -> jax.Array:
+    """Sampling with a *runtime* temperature operand (no recompile per
+    request): computes both greedy and categorical picks and selects by
+    ``temperature > 0``.  Used by the serving engine so per-request
+    temperature overrides (the reference's Ollama options dict,
+    src/devices/nano_api.py:70) hit the same compiled loop."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(temperature > 0.0, sampled, greedy)

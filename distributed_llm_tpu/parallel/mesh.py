@@ -1,0 +1,82 @@
+"""Device mesh and tier-submesh utilities.
+
+The reference's notion of a "device" is a physical Jetson board reached over
+SSH (src/models/server_manager.py).  Here a device tier is a **submesh of TPU
+chips** carved out of the process's device list: the nano tier gets a 1-chip
+mesh, the orin tier a ``tp``-chip mesh whose chips are ICI neighbors, and both
+models are resident simultaneously on disjoint submeshes of one pod (the JAX
+global-device default is deliberately avoided — every engine computation is
+pinned to its tier's mesh).
+
+When fewer chips exist than requested (a 1-chip dev box, the single-chip
+bench tunnel), tiers shrink gracefully and may share chips — the framework
+still runs, with tiers distinguished by model size alone.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..config import ClusterConfig, TierConfig
+
+
+def tp_mesh(devices: Sequence[jax.Device], tp: int,
+            axis_name: str = "tp") -> jax.sharding.Mesh:
+    """A 1-D tensor-parallel mesh over the first ``tp`` devices."""
+    chosen = np.array(list(devices[:tp]))
+    return jax.sharding.Mesh(chosen, (axis_name,))
+
+
+def carve_tier_meshes(
+    cluster: ClusterConfig,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Dict[str, jax.sharding.Mesh]:
+    """Assign disjoint chip submeshes to tiers, in declaration order.
+
+    Allocation: nano claims its ``tp`` chips first, orin the next ``tp``.
+    Shortfall policy (in order):
+      1. shrink a tier's tp to the largest divisor of its head counts that
+         still fits the remaining chips;
+      2. if nothing remains, share from the start of the device list.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+
+    meshes: Dict[str, jax.sharding.Mesh] = {}
+    cursor = 0
+    for tier in cluster.tiers():
+        remaining = len(devices) - cursor
+        tp = _fit_tp(tier, max(remaining, 0))
+        if tp == 0:
+            # Nothing left — share chips from the front (single-chip box).
+            tp = _fit_tp(tier, len(devices))
+            tp = max(tp, 1)
+            meshes[tier.name] = tp_mesh(devices, tp)
+            continue
+        meshes[tier.name] = tp_mesh(devices[cursor:cursor + tp], tp)
+        cursor += tp
+    return meshes
+
+
+def _fit_tp(tier: TierConfig, available: int) -> int:
+    """Largest feasible tensor-parallel degree ≤ requested, dividing the
+    model's kv-head count (GQA shards whole kv heads)."""
+    if available <= 0:
+        return 0
+    cfg = tier.model()
+    tp = min(tier.tp, available)
+    while tp > 1 and (cfg.num_kv_heads % tp or cfg.num_heads % tp):
+        tp -= 1
+    return max(tp, 1)
+
+
+def describe_meshes(meshes: Dict[str, jax.sharding.Mesh]) -> str:
+    parts = []
+    for name, mesh in meshes.items():
+        ids = [d.id for d in mesh.devices.flat]
+        parts.append(f"{name}: {len(ids)} device(s) {ids}")
+    return "; ".join(parts)

@@ -1,0 +1,70 @@
+"""Tensor-parallel sharding rules for the transformer parameter pytree.
+
+Megatron-style TP expressed as GSPMD sharding annotations — no hand-written
+collectives in the model: Q/K/V and MLP up/gate projections are
+column-parallel (output features sharded over the 'tp' axis), attention
+output and MLP down projections are row-parallel (input features sharded), so
+XLA inserts exactly one all-reduce after attention and one after the MLP,
+riding ICI.  The (tiny, 512-row byte-level) embedding and the norms are
+replicated.
+
+The same rules serve inference (engine on a tier submesh) and training
+(mesh with ('dp','tp') axes — pass ``data_axis`` so batch dims shard over dp).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import ModelConfig
+
+
+def param_specs(cfg: ModelConfig, tp_axis: str = "tp") -> Dict[str, Any]:
+    """PartitionSpec pytree matching transformer.init_params' structure."""
+    t = tp_axis
+    return {
+        "embed": P(None, None),
+        "layers": {
+            "ln1": P(None, None),
+            "wq": P(None, None, t),      # column parallel (heads)
+            "wk": P(None, None, t),
+            "wv": P(None, None, t),
+            "wo": P(None, t, None),      # row parallel
+            "ln2": P(None, None),
+            "w_gate": P(None, None, t),  # column parallel (ffn)
+            "w_up": P(None, None, t),
+            "w_down": P(None, t, None),  # row parallel
+        },
+        "final_ln": P(None),
+    }
+
+
+def param_shardings(cfg: ModelConfig, mesh: Mesh,
+                    tp_axis: str = "tp") -> Dict[str, Any]:
+    """NamedSharding pytree for placing params on a tier mesh."""
+    if cfg.num_heads % mesh.shape[tp_axis] or cfg.num_kv_heads % mesh.shape[tp_axis]:
+        raise ValueError(
+            f"tp={mesh.shape[tp_axis]} must divide heads "
+            f"({cfg.num_heads}/{cfg.num_kv_heads}) for {cfg.name}")
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(cfg, tp_axis),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def kv_cache_specs(tp_axis: str = "tp") -> Dict[str, P]:
+    """KV cache [L, B, S, N_kv, D]: shard the kv-head axis over tp."""
+    return {"k": P(None, None, None, tp_axis, None),
+            "v": P(None, None, None, tp_axis, None)}
+
+
+def kv_cache_shardings(mesh: Mesh, tp_axis: str = "tp") -> Dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, s) for k, s in kv_cache_specs(tp_axis).items()}
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

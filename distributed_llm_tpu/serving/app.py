@@ -1,0 +1,156 @@
+"""Flask chat API — reference contract preserved verbatim.
+
+Reference parity: src/app.py.  Endpoints and JSON fields are identical so
+the reference's React frontend points at this server unchanged:
+
+  POST /chat       {message, strategy, session_id} ->
+                   {reply, device, reasoning, method, confidence,
+                    cache_hit, tokens}
+  GET  /history    ?session_id=...   -> [messages]
+  DELETE /history  ?session_id=...   -> {"cleared": session_id}
+
+Behavioral details kept: UI strategy name "token-counting" maps to "token"
+(app.py:37-38); strategy switches go through QueryRouter.change_strategy so
+cache + perf state survive (app.py:46-53); per-session history capped at the
+last 10 messages (app.py:23); the just-appended user message is rolled back
+if routing raises (app.py:96-97).  Fixed (documented drift): session state
+lives behind a lock — the reference's bare globals are a known hazard under
+a threaded server (SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..config import ClusterConfig
+from ..utils.http_compat import Flask, enable_cors, jsonify, request
+from .router import Router
+
+logger = logging.getLogger(__name__)
+
+HISTORY_LIMIT = 10
+
+# Same defaults the reference app passes (src/app.py:9-14).
+BASE_CONFIG: Dict[str, Any] = {
+    "cache_enabled": True,
+    "enable_response_cache": True,
+    "enable_failover": True,
+    "weights": {"token": 0.25, "semantic": 0.45, "heuristic": 0.30},
+}
+
+
+def create_app(router: Optional[Router] = None,
+               cluster: Optional[ClusterConfig] = None) -> Flask:
+    app = Flask("distributed_llm_tpu")
+    enable_cors(app)
+
+    state_lock = threading.Lock()
+    if router is None:
+        router = Router(strategy="hybrid", config=dict(BASE_CONFIG),
+                        cluster=cluster)
+    state = {
+        "router": router,
+        "strategy": router.query_router.strategy,
+        "histories": {},      # session_id -> List[message]
+    }
+    app.extensions["dllm_state"] = state
+
+    @app.route("/chat", methods=["POST"])
+    def chat():
+        data = request.get_json(silent=True) or {}
+        user_input = data.get("message", "")
+        requested = data.get("strategy", "hybrid")
+        session_id = data.get("session_id", "default")
+
+        if requested == "token-counting":   # UI dropdown name
+            requested = "token"
+
+        if not user_input.strip():
+            return jsonify({"error": "No message provided"}), 400
+
+        with state_lock:
+            if requested != state["strategy"]:
+                logger.info("Switching strategy: %s -> %s",
+                            state["strategy"], requested)
+                try:
+                    state["router"].query_router.change_strategy(requested)
+                    state["strategy"] = requested
+                except Exception as exc:
+                    return jsonify(
+                        {"error": f"Failed to switch strategy: {exc}"}), 500
+
+            history: List[Dict[str, str]] = state["histories"].setdefault(
+                session_id, [])
+            history.append({"role": "user", "content": user_input})
+
+        try:
+            response_data, tokens, device = state["router"].route_query(history)
+
+            if isinstance(response_data, dict):
+                reply = response_data.get("response", "")
+                reasoning = response_data.get(
+                    "routing_reasoning", f"Method: {requested}")
+                method = response_data.get("routing_method", requested)
+                confidence = response_data.get("routing_confidence", 0.0)
+                cache_hit = response_data.get("cache_hit", False)
+            else:
+                reply = str(response_data)
+                reasoning, method = "Direct response", requested
+                confidence, cache_hit = 0.0, False
+
+            with state_lock:
+                history.append({"role": "assistant", "content": reply})
+                state["histories"][session_id] = history[-HISTORY_LIMIT:]
+
+            return jsonify({
+                "reply": reply,
+                "device": device,
+                "reasoning": reasoning,
+                "method": method,
+                "confidence": confidence,
+                "cache_hit": cache_hit,
+                "tokens": tokens,
+            })
+
+        except Exception as exc:
+            logger.exception("Error during routing")
+            with state_lock:
+                if history and history[-1]["role"] == "user":
+                    history.pop()
+            return jsonify({
+                "reply": "System Error: The router encountered an issue.",
+                "device": "error",
+                "reasoning": str(exc),
+                "method": requested,
+                "confidence": 0.0,
+                "cache_hit": False,
+                "tokens": 0,
+            }), 500
+
+    @app.route("/history", methods=["GET"])
+    def get_history():
+        session_id = request.args.get("session_id", "default")
+        with state_lock:
+            return jsonify(state["histories"].get(session_id, []))
+
+    @app.route("/history", methods=["DELETE"])
+    def clear_history():
+        session_id = request.args.get("session_id", "default")
+        with state_lock:
+            state["histories"].pop(session_id, None)
+        return jsonify({"cleared": session_id})
+
+    return app
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    app = create_app()
+    print("🚀 API running on http://0.0.0.0:8000")
+    app.run(host="0.0.0.0", port=8000, threaded=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Interactive CLI chatbot — reference parity: src/main.py.
+
+A REPL over the Router; "exit"/"quit" stops both tier engines (the
+reference's only clean-shutdown path, src/main.py:16-18)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from ..config import PRODUCTION_CFG
+from .router import Router
+
+
+class Chatbot:
+    def __init__(self, strategy: str = "semantic",
+                 config: Optional[Dict[str, Any]] = None,
+                 router: Optional[Router] = None):
+        self.router = router or Router(strategy=strategy, config=config)
+        self.history: List[Dict[str, str]] = []
+
+    def add_message(self, role: str, content: str) -> None:
+        self.history.append({"role": role, "content": content})
+
+    def ask(self, text: str) -> str:
+        """One turn: append, route, record the reply."""
+        self.add_message("user", text)
+        response, _tokens, device = self.router.route_query(self.history)
+        reply = (response.get("response", "") if isinstance(response, dict)
+                 else str(response))
+        self.add_message("assistant", reply)
+        return f"[{device}] {reply}"
+
+    def shutdown(self) -> None:
+        self.router.nano.server_manager.stop_server()
+        self.router.orin.server_manager.stop_server()
+
+    def chat(self) -> None:
+        print("Chatbot ready — type 'exit' or 'quit' to stop.")
+        while True:
+            try:
+                text = input("> ").strip()
+            except (EOFError, KeyboardInterrupt):
+                text = "exit"
+            if text.lower() in ("exit", "quit"):
+                self.shutdown()
+                print("Tier engines stopped. Bye.")
+                return
+            if text:
+                print(self.ask(text))
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.WARNING)
+    Chatbot(strategy="semantic", config=dict(PRODUCTION_CFG)).chat()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,256 @@
+"""Router — the serving orchestration pipeline.
+
+Reference parity: src/router.py.  Same constructor signature, same
+``route_query(history) -> (response_dict, tokens, device)`` contract, same
+response-dict keys, and the same pipeline stages:
+
+  0) response-cache check (production mode only; key = strategy + query text,
+     deliberately context-independent — reference: src/router.py:57-59,179)
+  1) routing decision via QueryRouter, with context-size threshold fallback
+     if the routing engine raises (src/router.py:258-270)
+  2) tier inference + one-shot failover to the other tier on an error-shaped
+     response (src/router.py:277-282)
+  3) text normalization + token count
+  4) perf feedback into the perf strategy (src/router.py:292-295)
+  5) response-cache store
+
+What changed underneath: tiers are in-process TPU engines on chip submeshes
+(serving/tiers.py) instead of SSH-tunneled Jetson boards, so `_run_device`
+is a function call, not an HTTP POST.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from ..config import (ClusterConfig, bench_cluster, resolve_config,
+                      tiny_cluster)
+from ..routing.engine import QueryRouter
+from ..routing.token_counter import TokenCounter
+from ..utils.faults import FaultInjector
+from .tiers import TierClient, build_tiers
+
+logger = logging.getLogger(__name__)
+
+
+def default_cluster() -> ClusterConfig:
+    """Bench-sized tiers on an accelerator; tiny tiers on host CPU."""
+    return tiny_cluster() if jax.default_backend() == "cpu" else bench_cluster()
+
+
+class Router:
+    def __init__(
+        self,
+        strategy: str = "hybrid",
+        config: Optional[Dict[str, Any]] = None,
+        threshold_fallback: int = 100,
+        benchmark_mode: bool = False,
+        cluster: Optional[ClusterConfig] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+        fault_injector: Optional[FaultInjector] = None,
+    ):
+        """strategy: "token" | "semantic" | "heuristic" | "hybrid" | "perf"
+        benchmark_mode: True → BENCHMARK_CFG (cache off), False →
+        PRODUCTION_CFG, unless ``config`` overrides (src/router.py:37-40)."""
+        self.token_counter = TokenCounter()
+        self.threshold_fallback = threshold_fallback
+        self.benchmark_mode = benchmark_mode
+        self.config = resolve_config(config, benchmark_mode)
+
+        self.cluster = cluster or default_cluster()
+        self.faults = fault_injector
+        self.tiers: Dict[str, TierClient] = build_tiers(
+            self.cluster, devices=devices, fault_injector=fault_injector)
+        # Reference attribute surface (tester uses router.nano.server_manager)
+        self.nano = self.tiers["nano"]
+        self.orin = self.tiers["orin"]
+
+        self.query_router = QueryRouter(strategy=strategy, config=self.config)
+
+        self.enable_response_cache = (
+            not benchmark_mode
+            and bool(self.config.get("enable_response_cache", False)))
+        self.cache_last_k = int(self.config.get("cache_last_k", 6))
+        self.enable_failover = bool(self.config.get("enable_failover", True))
+        self._response_store: Dict[str, Dict[str, Any]] = {}
+
+    # -- back-compat (src/router.py:65-67) ---------------------------------
+
+    def set_threshold(self, threshold: int) -> None:
+        self.threshold_fallback = threshold
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _extract_text(response: Any) -> Optional[str]:
+        """Normalize any tier response shape to a plain string
+        (src/router.py:73-102)."""
+        if response is None:
+            return None
+        if isinstance(response, str):
+            return response.strip() or None
+        if isinstance(response, dict):
+            for key in ("response", "content", "message"):
+                val = response.get(key)
+                if isinstance(val, str) and val.strip():
+                    return val.strip()
+                if isinstance(val, dict):
+                    inner = val.get("content")
+                    if isinstance(inner, str) and inner.strip():
+                        return inner.strip()
+            if "error" in response:
+                parts = [str(response.get(k, "")).strip()
+                         for k in ("error", "detail", "body")]
+                combined = " ".join(p for p in parts if p)
+                return combined[:300] if combined else None
+        return None
+
+    def _history_to_query_and_context(
+        self, history: List[Dict[str, Any]]
+    ) -> Tuple[str, Optional[str], str]:
+        """Split history into (last user query, prior-turn context string,
+        sha256[:16] hash of the last-k turns) — src/router.py:104-147."""
+        if not history:
+            return "", None, "nohist"
+
+        last_user = None
+        for i in range(len(history) - 1, -1, -1):
+            m = history[i]
+            if isinstance(m, dict) and m.get("role") == "user":
+                last_user = i
+                break
+
+        if last_user is None:
+            query, ctx_msgs = "", history
+        else:
+            query = (history[last_user].get("content") or "").strip()
+            ctx_msgs = history[:last_user]
+
+        lines = [
+            f"{(m.get('role') or '').strip()}: {(m.get('content') or '').strip()}"
+            for m in ctx_msgs
+            if isinstance(m, dict) and (m.get("content") or "").strip()
+        ]
+        context = "\n".join(lines) if lines else None
+
+        compact = "\n".join(
+            f"{m.get('role', '')}:{(m.get('content') or '').strip()}"
+            for m in ctx_msgs[-self.cache_last_k:]
+            if isinstance(m, dict))
+        ctx_hash = hashlib.sha256(compact.encode("utf-8")).hexdigest()[:16]
+        return query, context, ctx_hash
+
+    @staticmethod
+    def _is_error(raw: Any) -> bool:
+        return isinstance(raw, dict) and "error" in raw
+
+    def _run_device(self, device: str,
+                    history: List[Dict[str, Any]]) -> Tuple[Any, str, float]:
+        tier = self.tiers.get(device, self.nano)
+        logger.info("Processing query on %s", tier.name)
+        t0 = time.perf_counter()
+        raw = tier.process(history)
+        return raw, tier.name, (time.perf_counter() - t0) * 1000.0
+
+    # -- response cache (src/router.py:179-193) ----------------------------
+
+    def _response_cache_key(self, ctx_hash: str, query: str) -> str:
+        # Deliberately context-independent (reference intent, router.py:57-59)
+        return f"{self.query_router.strategy}|{query.lower().strip()}"
+
+    # -- main pipeline -----------------------------------------------------
+
+    def route_query(self, history: List[Dict[str, Any]]
+                    ) -> Tuple[Dict[str, Any], int, str]:
+        query, context, ctx_hash = self._history_to_query_and_context(history)
+
+        # 0) response cache
+        if self.enable_response_cache:
+            cached = self._response_store.get(
+                self._response_cache_key(ctx_hash, query))
+            if cached is not None:
+                text = cached.get("text", "")
+                which = cached.get("device", "nano")
+                tokens = self.token_counter.count_tokens(
+                    {"role": "assistant", "content": text})
+                return {
+                    "response": text,
+                    "raw": cached.get("raw"),
+                    "cache_hit": True,
+                    "routing_method": "response_cache",
+                    "routing_confidence": 1.0,
+                    "routing_reasoning": f"response cache hit -> {which}",
+                    "routing_overhead_ms": 0.0,
+                    "ok": True,
+                }, tokens, which
+
+        # 1) routing decision
+        t0 = time.perf_counter()
+        device = "nano"
+        method, confidence, reasoning = "unknown", 0.0, ""
+        try:
+            decision = self.query_router.route_query(
+                query=query, context=context, context_key=ctx_hash)
+            device = decision.device
+            method = decision.method
+            confidence = float(decision.confidence)
+            reasoning = decision.reasoning
+            logger.info("[%s] routing: %s | method=%s conf=%.3f",
+                        "BENCH" if self.benchmark_mode else "PROD",
+                        device.upper(), method, confidence)
+        except Exception as exc:
+            ctx_size = self.token_counter.get_context_size(history)
+            device = "orin" if ctx_size > self.threshold_fallback else "nano"
+            method = "fallback_ctx_size"
+            confidence = 0.2
+            reasoning = (f"router failed: {exc}; ctx_size={ctx_size}, "
+                         f"threshold_fallback={self.threshold_fallback}")
+            logger.warning("routing failed (%s); ctx fallback -> %s", exc, device)
+        overhead_ms = (time.perf_counter() - t0) * 1000.0
+
+        # 2) inference + failover
+        raw, which, lat_ms = self._run_device(device, history)
+        if self.enable_failover and self._is_error(raw):
+            other = "orin" if which == "nano" else "nano"
+            logger.warning("%s failed — failing over to %s", which, other)
+            raw2, which2, lat2 = self._run_device(other, history)
+            if not self._is_error(raw2):
+                raw, which, lat_ms = raw2, which2, lat2
+
+        # 3) normalize + count
+        text = self._extract_text(raw) or "No response available"
+        tokens = self.token_counter.count_tokens(
+            {"role": "assistant", "content": text})
+        ok = not self._is_error(raw)
+
+        # 4) perf feedback
+        try:
+            self.query_router.update_perf(which, lat_ms, tokens, ok=ok)
+        except Exception:
+            pass
+
+        # 5) response-cache store
+        if self.enable_response_cache:
+            self._response_store[self._response_cache_key(ctx_hash, query)] = {
+                "text": text,
+                "raw": raw,
+                "device": which,
+                "routing_confidence": round(confidence, 4),
+            }
+
+        return {
+            "response": text,
+            "raw": raw,
+            "cache_hit": False,
+            "benchmark_mode": self.benchmark_mode,
+            "routing_overhead_ms": round(overhead_ms, 2),
+            "routing_method": method,
+            "routing_confidence": round(confidence, 4),
+            "routing_reasoning": reasoning,
+            "ok": ok,
+        }, tokens, which

@@ -1,0 +1,87 @@
+"""Tier clients — the device-client layer over in-process TPU engines.
+
+Reference parity: src/models/nano.py / src/models/orin.py.  A TierClient has
+the same surface (``.process(history)`` returning {"response": text} or an
+error dict, plus ``.server_manager``) but dispatches to an InferenceEngine on
+a chip submesh instead of POSTing through an SSH tunnel.  A registry replaces
+the reference's two hard-coded classes, so tiers are config, not code.
+
+Error-dict shapes match the reference client exactly (src/models/nano.py:
+30-40) so Router failover and `_is_error` behave identically; faults come
+from the injectable fault model (utils/faults.py) since there is no network
+to fail naturally.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+
+from ..config import ClusterConfig, TierConfig
+from ..engine.inference import GenerationResult
+from ..engine.manager import EngineManager
+from ..parallel.mesh import carve_tier_meshes
+from ..utils.faults import FaultInjector
+
+logger = logging.getLogger(__name__)
+
+History = Union[str, List[Dict[str, Any]]]
+
+
+class TierClient:
+    def __init__(
+        self,
+        tier: TierConfig,
+        manager: EngineManager,
+        fault_injector: Optional[FaultInjector] = None,
+    ):
+        self.tier = tier
+        self.name = tier.name
+        self.server_manager = manager          # name matches reference surface
+        self.faults = fault_injector
+        self.last_result: Optional[GenerationResult] = None
+
+    def process(self, history: History) -> Dict[str, Any]:
+        """Run inference; error dicts mirror the reference client shapes."""
+        if self.faults is not None:
+            fault = self.faults.intercept(self.name)
+            if fault is not None:
+                return fault
+
+        try:
+            if not self.server_manager.is_server_running():
+                logger.info("No running %s engine found, starting...", self.name)
+                self.server_manager.start_server()
+            result = self.server_manager.engine().generate(history)
+        except Exception as exc:   # engine failure → reference error shape
+            return {"error": f"Request failed: {exc}"}
+
+        self.last_result = result
+        return {"response": result.text}
+
+
+def build_tiers(
+    cluster: ClusterConfig,
+    devices: Optional[Sequence[jax.Device]] = None,
+    fault_injector: Optional[FaultInjector] = None,
+    warmup_on_start: bool = True,
+) -> Dict[str, TierClient]:
+    """Carve submeshes and wire a client per tier (registry, not classes)."""
+    meshes = carve_tier_meshes(cluster, devices=devices)
+    tiers: Dict[str, TierClient] = {}
+    for tier in cluster.tiers():
+        mesh = meshes[tier.name]
+        # A 1-device mesh adds partitioning overhead for no benefit: pin to
+        # the single device instead.
+        if mesh.size == 1:
+            manager = EngineManager(
+                tier, devices=list(mesh.devices.flat), seed=cluster.seed,
+                warmup_on_start=warmup_on_start)
+        else:
+            manager = EngineManager(
+                tier, mesh=mesh, seed=cluster.seed,
+                warmup_on_start=warmup_on_start)
+        tiers[tier.name] = TierClient(tier, manager, fault_injector)
+    return tiers

@@ -1,0 +1,106 @@
+"""Per-tier device-server HTTP surface — the `tpu_api.py` of the north star.
+
+Reference parity: src/devices/nano_api.py and src/devices/orin_api.py (the
+Flask servers that ran ON the Jetsons, fronting Ollama).  In-process dispatch
+makes this layer optional for the TPU framework, but the surface is preserved
+so deployments that want network-separated tiers (e.g. tiers on different
+hosts of a pod, reached over DCN) keep the exact contract:
+
+  GET  /         liveness text
+  GET  /health   {"ok": true}
+  POST /query    {"query": list[{role,content}] | str,
+                  "num_predict": int (optional, -1 = tier default cap),
+                  "temperature": float (optional)}   -> {"response": text}
+                  errors: 400 bad input, 500 engine failure, 504 timeout
+
+One factory replaces the two copy-pasted per-device files; the tier is
+config (`--tier nano|orin`), not a fork of the source.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from typing import Any, Dict, Optional
+
+from ..config import ClusterConfig
+from ..utils.http_compat import Flask, jsonify, request
+from ..engine.manager import EngineManager
+from .router import default_cluster
+from .tiers import build_tiers
+
+logger = logging.getLogger(__name__)
+
+# Reference defaults (src/devices/nano_api.py:18-21).
+DEFAULT_NUM_PREDICT = -1
+DEFAULT_TEMPERATURE = 0.0
+
+TIER_PORTS = {"nano": 5001, "orin": 5000}   # reference ports
+
+
+def create_tier_app(tier_name: str,
+                    cluster: Optional[ClusterConfig] = None,
+                    manager: Optional[EngineManager] = None) -> Flask:
+    app = Flask(f"dllm_tpu_{tier_name}")
+
+    if manager is None:
+        tiers = build_tiers(cluster or default_cluster(),
+                            warmup_on_start=False)
+        if tier_name not in tiers:
+            raise ValueError(f"unknown tier {tier_name!r}")
+        manager = tiers[tier_name].server_manager
+    app.extensions["dllm_manager"] = manager
+
+    @app.route("/")
+    def home():
+        return "Server is running!\n", 200
+
+    @app.route("/health", methods=["GET"])
+    def health():
+        return jsonify({"ok": True}), 200
+
+    @app.route("/query", methods=["POST"])
+    def process_query():
+        data: Dict[str, Any] = request.get_json(silent=True) or {}
+        query = data.get("query")
+
+        if not query:
+            return jsonify({"error": "No query provided"}), 400
+        if not isinstance(query, (list, str)):
+            return jsonify({"error": "Invalid query format. "
+                                     "Expect list[role/content] or string."}), 400
+
+        try:
+            num_predict = int(data.get("num_predict") or DEFAULT_NUM_PREDICT)
+            temperature = float(data.get("temperature") or DEFAULT_TEMPERATURE)
+        except (TypeError, ValueError):
+            return jsonify({"error": "num_predict/temperature must be numeric"}), 400
+        max_new = num_predict if num_predict > 0 else None
+
+        try:
+            result = manager.engine().generate(
+                query, max_new_tokens=max_new, temperature=temperature)
+            return jsonify({"response": result.text.strip()})
+        except TimeoutError:
+            return jsonify({"error": "Inference timed out"}), 504
+        except Exception as exc:
+            logger.exception("inference failed")
+            return jsonify({"error": f"Inference failed: {exc}"}), 500
+
+    return app
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--tier", choices=sorted(TIER_PORTS), default="nano")
+    parser.add_argument("--port", type=int, default=None)
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    app = create_tier_app(args.tier)
+    port = args.port if args.port is not None else TIER_PORTS[args.tier]
+    app.run(host="0.0.0.0", port=port, threaded=True)
+
+
+if __name__ == "__main__":
+    main()

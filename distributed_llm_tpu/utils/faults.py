@@ -1,0 +1,76 @@
+"""Injectable fault model for tier engines.
+
+The reference's failure semantics arise naturally from its network stack —
+SSH tunnels drop, Flask returns non-JSON, Ollama times out — producing
+error-dict shapes like {"error": "Request timed out on Nano (...)"}
+(src/models/nano.py:30-40).  An in-process TPU engine has no network layer to
+fail, so failover, the perf strategy's fail-penalty, and the health plumbing
+need a fault model to stay testable (SURVEY.md §7 hard part 5).
+
+``FaultInjector`` scripts failures per tier: one-shot error queues, sticky
+outage flags, and artificial latency.  Error payload shapes mirror the
+reference client exactly so `Router._is_error` and failover behave
+identically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Any, Dict, Optional
+
+
+class FaultInjector:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._one_shot: Dict[str, deque] = defaultdict(deque)
+        self._down: Dict[str, Optional[Dict[str, Any]]] = {}
+        self._delay_s: Dict[str, float] = {}
+
+    # -- scripting ---------------------------------------------------------
+
+    def fail_next(self, tier: str, error: str = "injected fault") -> None:
+        """Queue a one-shot failure for the next request to ``tier``."""
+        with self._lock:
+            self._one_shot[tier].append({"error": error})
+
+    def timeout_next(self, tier: str) -> None:
+        """One-shot timeout with the reference's client error shape
+        (src/models/nano.py:38)."""
+        self.fail_next(
+            tier, f"Request timed out on {tier.capitalize()} "
+                  "(model cold start / slow inference).")
+
+    def set_down(self, tier: str, error: str = "tier offline") -> None:
+        """Sticky outage until ``restore``."""
+        with self._lock:
+            self._down[tier] = {"error": error}
+
+    def restore(self, tier: str) -> None:
+        with self._lock:
+            self._down.pop(tier, None)
+            self._one_shot.pop(tier, None)
+            self._delay_s.pop(tier, None)
+
+    def add_latency(self, tier: str, seconds: float) -> None:
+        """Artificial per-request latency (perf-strategy steering tests)."""
+        with self._lock:
+            self._delay_s[tier] = seconds
+
+    # -- hook called by TierClient ----------------------------------------
+
+    def intercept(self, tier: str) -> Optional[Dict[str, Any]]:
+        """Return an error payload to short-circuit the request, else None.
+        Applies scripted latency as a side effect."""
+        with self._lock:
+            delay = self._delay_s.get(tier, 0.0)
+            down = self._down.get(tier)
+            shot = self._one_shot[tier].popleft() if self._one_shot[tier] else None
+        if delay > 0:
+            time.sleep(delay)
+        if down is not None:
+            return dict(down)
+        if shot is not None:
+            return shot
+        return None

@@ -1,0 +1,26 @@
+"""Flask if installed, else the stdlib micro-framework (utils/webapp.py).
+
+Serving modules import Flask/jsonify/request from here so the same code runs
+in this zero-egress image (no flask wheel) and in a normal deployment with
+real Flask + flask-cors.
+"""
+
+from __future__ import annotations
+
+try:
+    from flask import Flask, jsonify, request          # noqa: F401
+    HAVE_FLASK = True
+except ImportError:
+    from .webapp import Flask, jsonify, request       # noqa: F401
+    HAVE_FLASK = False
+
+
+def enable_cors(app) -> None:
+    """flask-cors when real Flask is present; webapp.py already sends
+    Access-Control-Allow-Origin."""
+    if HAVE_FLASK:
+        try:
+            from flask_cors import CORS
+            CORS(app)
+        except ImportError:
+            pass

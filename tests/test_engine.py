@@ -1,0 +1,154 @@
+"""Inference engine correctness on the CPU platform.
+
+The key invariant (the one Ollama guaranteed for the reference and we must
+guarantee ourselves): incremental decode with a KV cache produces the same
+distribution as a full forward pass."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_tpu.config import MODEL_PRESETS, TierConfig, tiny_cluster
+from distributed_llm_tpu.engine.inference import InferenceEngine
+from distributed_llm_tpu.engine.tokenizer import ByteTokenizer
+from distributed_llm_tpu.models import transformer
+
+
+CFG = MODEL_PRESETS["nano_test"]
+
+
+# -- tokenizer --------------------------------------------------------------
+
+def test_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    text = "Hello, TPU! ünïcødé 你好"
+    ids = tok.encode(text)
+    assert ids[0] == tok.bos_id
+    assert tok.decode(ids) == text
+
+
+def test_tokenizer_history_format():
+    tok = ByteTokenizer()
+    hist = [{"role": "user", "content": "hi"},
+            {"role": "assistant", "content": "hello"},
+            {"role": "user", "content": "bye"}]
+    assert tok.format_history(hist) == "user: hi\nassistant: hello\nuser: bye"
+    assert tok.format_history("plain text") == "plain text"
+
+
+# -- model ------------------------------------------------------------------
+
+def test_param_shapes_and_count():
+    params = transformer.init_params(CFG, seed=0)
+    assert params["embed"].shape == (CFG.vocab_size, CFG.hidden_size)
+    assert params["layers"]["wq"].shape == (
+        CFG.num_layers, CFG.hidden_size, CFG.num_heads * CFG.head_dim)
+    n = sum(x.size for x in jax.tree.leaves(params))
+    assert n == CFG.param_count()
+
+
+def test_prefill_decode_equivalence():
+    """Logits from incremental KV-cache decode must match full prefill."""
+    params = transformer.init_params(CFG, seed=1)
+    tokens = jnp.array([[257, 72, 101, 108, 108, 111, 33, 10]])  # BOS + bytes
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+
+    hidden, _ = transformer.prefill(CFG, params, tokens, positions)
+    full_logits = transformer.logits_from_hidden(params, hidden)  # [B,S,V]
+
+    cache = transformer.init_kv_cache(CFG, b, 32)
+    step_logits = []
+    for i in range(s):
+        logits, cache = transformer.decode_step(
+            CFG, params, tokens[:, i], jnp.array([i]), cache)
+        step_logits.append(logits)
+    step_logits = jnp.stack(step_logits, axis=1)
+
+    np.testing.assert_allclose(
+        np.asarray(step_logits), np.asarray(full_logits), rtol=2e-2, atol=2e-2)
+
+
+def test_prefill_is_causal():
+    """Changing a later token must not affect earlier positions' logits."""
+    params = transformer.init_params(CFG, seed=2)
+    t1 = jnp.array([[257, 10, 20, 30, 40]])
+    t2 = t1.at[0, 4].set(99)
+    pos = jnp.arange(5)[None]
+    h1, _ = transformer.prefill(CFG, params, t1, pos)
+    h2, _ = transformer.prefill(CFG, params, t2, pos)
+    np.testing.assert_allclose(np.asarray(h1[:, :4]), np.asarray(h2[:, :4]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_padding_does_not_change_last_logits():
+    """Right-padding a prompt up to a bucket must not change the logits at
+    the last real position (what the engine samples from)."""
+    params = transformer.init_params(CFG, seed=3)
+    ids = [257, 72, 101, 108, 108]
+    short = jnp.array([ids])
+    padded = jnp.array([ids + [256] * 11])
+    h_s, _ = transformer.prefill(
+        CFG, params, short, jnp.arange(short.shape[1])[None])
+    h_p, _ = transformer.prefill(
+        CFG, params, padded, jnp.arange(padded.shape[1])[None])
+    np.testing.assert_allclose(
+        np.asarray(h_s[0, len(ids) - 1]), np.asarray(h_p[0, len(ids) - 1]),
+        rtol=1e-5, atol=1e-5)
+
+
+# -- engine -----------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def engine():
+    return InferenceEngine(tiny_cluster().nano, seed=0)
+
+
+def test_generate_returns_result(engine):
+    r = engine.generate("user: say something")
+    assert r.prompt_tokens > 0
+    assert 0 <= r.gen_tokens <= engine.tier.max_new_tokens
+    assert r.ttft_ms > 0 and r.total_ms >= r.ttft_ms
+    assert isinstance(r.text, str)
+    assert len(r.token_ids) == r.gen_tokens
+
+
+def test_generate_deterministic_greedy(engine):
+    a = engine.generate("user: hello there")
+    b = engine.generate("user: hello there")
+    assert a.token_ids == b.token_ids
+
+
+def test_generate_from_history(engine):
+    hist = [{"role": "user", "content": "hi"},
+            {"role": "assistant", "content": "hello"},
+            {"role": "user", "content": "what is 2+2?"}]
+    r = engine.generate(hist)
+    assert r.prompt_tokens > 10
+
+
+def test_generate_respects_max_new_tokens(engine):
+    r = engine.generate("user: count to one hundred", max_new_tokens=3)
+    assert r.gen_tokens <= 3
+
+
+def test_long_prompt_truncated_keeps_tail(engine):
+    cap = engine.cfg.max_seq_len - engine.tier.max_new_tokens
+    long_prompt = "x" * (cap * 3)
+    r = engine.generate(long_prompt)
+    assert r.prompt_tokens <= cap
+
+
+def test_bucket_selection(engine):
+    assert engine._pick_bucket(5) == 16
+    assert engine._pick_bucket(17) == 32
+    assert engine._pick_bucket(10_000) == min(
+        max(engine.tier.prefill_buckets), engine.cfg.max_seq_len)
+
+
+def test_prefill_jit_cached_per_bucket(engine):
+    engine.generate("user: aaaa")
+    engine.generate("user: " + "a" * 40)
+    assert 16 in engine._prefill_fns and 32 in engine._prefill_fns
+    assert engine._decode_fn is not None   # decode loop compiled once

@@ -1,0 +1,176 @@
+"""Mesh carving, TP sharding, collectives, ring attention — on the 8-device
+virtual CPU mesh (no TPU required; SURVEY.md §4 implication)."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_llm_tpu.config import (MODEL_PRESETS, ClusterConfig,
+                                        TierConfig, tiny_cluster)
+from distributed_llm_tpu.engine.inference import InferenceEngine
+from distributed_llm_tpu.models import transformer
+from distributed_llm_tpu.ops.attention import causal_attention
+from distributed_llm_tpu.parallel.collectives import (
+    allgather_health, psum_scalar, summarize_perf_window)
+from distributed_llm_tpu.parallel.mesh import carve_tier_meshes, tp_mesh
+from distributed_llm_tpu.parallel.ring_attention import ring_attention
+from distributed_llm_tpu.parallel.sharding import (
+    param_shardings, param_specs)
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+    assert jax.default_backend() == "cpu"
+
+
+# -- mesh carving -----------------------------------------------------------
+
+def test_carve_disjoint_submeshes():
+    meshes = carve_tier_meshes(tiny_cluster())
+    nano_ids = {d.id for d in meshes["nano"].devices.flat}
+    orin_ids = {d.id for d in meshes["orin"].devices.flat}
+    assert len(nano_ids) == 1 and len(orin_ids) == 4
+    assert nano_ids.isdisjoint(orin_ids)
+
+
+def test_carve_single_device_shares():
+    meshes = carve_tier_meshes(tiny_cluster(), devices=jax.devices()[:1])
+    assert len(list(meshes["nano"].devices.flat)) == 1
+    assert len(list(meshes["orin"].devices.flat)) == 1
+
+
+def test_carve_shrinks_to_divisor_of_heads():
+    # orin_test has 4 kv heads; with 3 devices left, tp shrinks to 2
+    cluster = ClusterConfig(
+        nano=TierConfig(name="nano", model_preset="nano_test", tp=1),
+        orin=TierConfig(name="orin", model_preset="orin_test", tp=4))
+    meshes = carve_tier_meshes(cluster, devices=jax.devices()[:4])
+    assert len(list(meshes["orin"].devices.flat)) == 2
+
+
+# -- TP sharding ------------------------------------------------------------
+
+def test_param_specs_match_param_tree():
+    cfg = MODEL_PRESETS["orin_test"]
+    params = transformer.init_params(cfg, seed=0)
+    specs = param_specs(cfg)
+    jax.tree.map(lambda p, s: None, params, specs,
+                 is_leaf=lambda x: isinstance(x, P))
+
+
+def test_tp_sharded_prefill_matches_single_device():
+    cfg = MODEL_PRESETS["orin_test"]
+    tokens = jnp.array([[257, 72, 101, 108, 108, 111, 33, 10]])
+    pos = jnp.arange(tokens.shape[1])[None]
+
+    params = transformer.init_params(cfg, seed=5)
+    h_ref, _ = transformer.prefill(cfg, params, tokens, pos)
+
+    mesh = tp_mesh(jax.devices(), 4)
+    sharded = jax.device_put(params, param_shardings(cfg, mesh))
+    h_tp, (k_tp, _) = jax.jit(partial(transformer.prefill, cfg))(
+        sharded, tokens, pos)
+
+    np.testing.assert_allclose(np.asarray(h_tp, np.float32),
+                               np.asarray(h_ref, np.float32),
+                               rtol=5e-2, atol=5e-2)
+    # K cache heads actually sharded over tp
+    assert not k_tp.sharding.is_fully_replicated
+
+
+def test_tp_rejects_indivisible_heads():
+    cfg = MODEL_PRESETS["nano_test"]   # 2 kv heads
+    mesh = tp_mesh(jax.devices(), 4)
+    with pytest.raises(ValueError):
+        param_shardings(cfg, mesh)
+
+
+def test_engine_on_tp_mesh_generates():
+    tier = tiny_cluster().orin
+    mesh = tp_mesh(jax.devices(), 4)
+    eng = InferenceEngine(tier, seed=0, mesh=mesh)
+    r = eng.generate("user: hello from the mesh")
+    assert r.gen_tokens >= 0 and r.total_ms > 0
+    # params are actually distributed
+    wq = eng.params["layers"]["wq"]
+    assert len(wq.sharding.device_set) == 4
+
+
+def test_tp_engine_matches_single_device_tokens():
+    tier = tiny_cluster().orin
+    single = InferenceEngine(tier, seed=3)
+    tp = InferenceEngine(tier, seed=3, mesh=tp_mesh(jax.devices(), 4))
+    a = single.generate("user: compare me")
+    b = tp.generate("user: compare me")
+    assert a.token_ids == b.token_ids
+
+
+# -- collectives ------------------------------------------------------------
+
+def test_allgather_health_roundtrip():
+    mesh = tp_mesh(jax.devices(), 8, axis_name="ici")
+    rows = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+    out = allgather_health(mesh, rows)
+    np.testing.assert_allclose(out, rows)
+
+
+def test_allgather_health_row_mismatch():
+    mesh = tp_mesh(jax.devices(), 4, axis_name="ici")
+    with pytest.raises(ValueError):
+        allgather_health(mesh, np.zeros((3, 4), np.float32))
+
+
+def test_psum_scalar_counts_quorum():
+    mesh = tp_mesh(jax.devices(), 8, axis_name="ici")
+    alive = np.ones(8, np.float32)
+    assert psum_scalar(mesh, alive) == 8.0
+
+
+def test_summarize_perf_window():
+    samples = [(100.0, 10, True), (200.0, 0, False)]
+    row = summarize_perf_window(samples)
+    np.testing.assert_allclose(row, [300.0, 10.0, 1.0, 2.0])
+
+
+# -- ring attention ---------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("groups", [1, 2])
+def test_ring_attention_matches_reference(causal, groups):
+    mesh = tp_mesh(jax.devices(), 4, axis_name="sp")
+    b, s, n_q, d = 2, 32, 4, 16
+    n_kv = n_q // groups
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, n_q, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, n_kv, d), jnp.float32)
+    v = jax.random.normal(kv_, (b, s, n_kv, d), jnp.float32)
+
+    out_ring = ring_attention(q, k, v, mesh, axis_name="sp", causal=causal)
+
+    if causal:
+        out_ref = causal_attention(q, k, v)
+    else:
+        groups_e = n_q // n_kv
+        from distributed_llm_tpu.ops.attention import _expand_kv
+        ke, ve = _expand_kv(k, groups_e), _expand_kv(v, groups_e)
+        logits = jnp.einsum("bqnd,bknd->bnqk", q, ke) * d ** -0.5
+        out_ref = jnp.einsum("bnqk,bknd->bqnd",
+                             jax.nn.softmax(logits, -1), ve)
+
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_sequence_stays_sharded():
+    mesh = tp_mesh(jax.devices(), 4, axis_name="sp")
+    b, s, n, d = 1, 16, 2, 8
+    x = jnp.ones((b, s, n, d), jnp.float32)
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    q = jax.device_put(x, spec)
+    out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(q, q, q)
+    assert not out.sharding.is_fully_replicated

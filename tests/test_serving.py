@@ -1,0 +1,312 @@
+"""Serving stack: tiers, lifecycle, Router pipeline, Flask contracts.
+
+Reference parity targets: src/router.py, src/app.py, src/devices/*_api.py,
+src/models/{nano,orin}.py, src/models/server_manager.py."""
+
+import json
+
+import pytest
+
+from distributed_llm_tpu.config import PRODUCTION_CFG, tiny_cluster
+from distributed_llm_tpu.serving.app import create_app
+from distributed_llm_tpu.serving.router import Router
+from distributed_llm_tpu.serving.tiers import build_tiers
+from distributed_llm_tpu.serving.tpu_api import create_tier_app
+from distributed_llm_tpu.utils.faults import FaultInjector
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    return tiny_cluster()
+
+
+def make_router(cluster, **kw):
+    kw.setdefault("cluster", cluster)
+    return Router(**kw)
+
+
+# -- tiers & lifecycle ------------------------------------------------------
+
+def test_tier_lazy_start_and_process(cluster):
+    tiers = build_tiers(cluster, warmup_on_start=False)
+    nano = tiers["nano"]
+    assert not nano.server_manager.is_server_running()
+    out = nano.process([{"role": "user", "content": "hi"}])
+    assert "response" in out
+    assert nano.server_manager.is_server_running()
+    assert nano.last_result is not None and nano.last_result.ttft_ms > 0
+
+
+def test_manager_lifecycle_and_health(cluster):
+    tiers = build_tiers(cluster, warmup_on_start=False)
+    mgr = tiers["orin"].server_manager
+    assert mgr.health()["ok"] is False
+    mgr.start_server()
+    mgr.start_server()          # idempotent
+    h = mgr.health()
+    assert h["ok"] is True and h["tier"] == "orin" and h["uptime_s"] >= 0
+    mgr.stop_server()
+    assert not mgr.is_server_running()
+
+
+def test_fault_injection_shapes(cluster):
+    fi = FaultInjector()
+    tiers = build_tiers(cluster, fault_injector=fi, warmup_on_start=False)
+    fi.timeout_next("nano")
+    out = tiers["nano"].process("hi")
+    assert "error" in out and "timed out on Nano" in out["error"]
+    out2 = tiers["nano"].process("hi")     # one-shot: next call succeeds
+    assert "response" in out2
+    fi.set_down("nano")
+    assert "error" in tiers["nano"].process("hi")
+    fi.restore("nano")
+    assert "response" in tiers["nano"].process("hi")
+
+
+# -- Router pipeline --------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def bench_router(cluster):
+    return make_router(cluster, strategy="heuristic", benchmark_mode=True)
+
+
+def test_route_query_contract(bench_router):
+    resp, tokens, device = bench_router.route_query(
+        [{"role": "user", "content": "What is the capital of France"}])
+    assert device == "nano"                      # simple pattern
+    for key in ("response", "raw", "cache_hit", "routing_overhead_ms",
+                "routing_method", "routing_confidence", "routing_reasoning",
+                "ok"):
+        assert key in resp
+    assert resp["ok"] is True and resp["cache_hit"] is False
+    assert resp["routing_method"] == "heuristic"
+    assert tokens >= 1
+
+
+def test_router_multi_turn_context(bench_router):
+    hist = [
+        {"role": "user", "content": "hello"},
+        {"role": "assistant", "content": "hi there"},
+        {"role": "user", "content": "What is the capital of France"},
+    ]
+    query, context, ctx_hash = bench_router._history_to_query_and_context(hist)
+    assert query == "What is the capital of France"
+    assert context == "user: hello\nassistant: hi there"
+    assert len(ctx_hash) == 16
+    # hash covers only the last-k turns
+    q2, c2, h2 = bench_router._history_to_query_and_context(hist[:1] * 9 + hist)
+    assert h2 != ctx_hash or len(hist) <= bench_router.cache_last_k
+
+
+def test_failover_to_other_tier(cluster):
+    fi = FaultInjector()
+    r = make_router(cluster, strategy="heuristic", benchmark_mode=True,
+                    fault_injector=fi)
+    fi.fail_next("nano", "boom")
+    resp, _, device = r.route_query(
+        [{"role": "user", "content": "What is the capital of France"}])
+    assert device == "orin" and resp["ok"] is True
+
+
+def test_failover_disabled_surfaces_error(cluster):
+    fi = FaultInjector()
+    cfg = dict(PRODUCTION_CFG)
+    cfg["enable_failover"] = False
+    r = make_router(cluster, strategy="heuristic", config=cfg,
+                    benchmark_mode=True, fault_injector=fi)
+    fi.set_down("nano", "nano offline")
+    resp, _, device = r.route_query(
+        [{"role": "user", "content": "What is the capital of France"}])
+    assert device == "nano" and resp["ok"] is False
+    assert "nano offline" in resp["response"]
+    fi.restore("nano")
+
+
+def test_both_tiers_fail_keeps_primary_error(cluster):
+    fi = FaultInjector()
+    r = make_router(cluster, strategy="heuristic", benchmark_mode=True,
+                    fault_injector=fi)
+    fi.set_down("nano", "nano down")
+    fi.set_down("orin", "orin down")
+    resp, _, device = r.route_query(
+        [{"role": "user", "content": "What is the capital of France"}])
+    assert resp["ok"] is False and device == "nano"
+    assert "nano down" in resp["response"]
+
+
+def test_perf_feedback_loop(cluster):
+    fi = FaultInjector()
+    r = make_router(cluster, strategy="perf", benchmark_mode=True,
+                    fault_injector=fi)
+    hist = [{"role": "user", "content": "hello"}]
+    # First query defaults to nano (no stats); make nano fail so its
+    # fail-penalty steers subsequent traffic to orin.
+    fi.set_down("nano", "nano down")
+    r.route_query(hist)
+    fi.restore("nano")
+    resp, _, device = r.route_query(hist)
+    assert device == "orin"
+    assert "scores" in resp["routing_reasoning"]
+
+
+def test_response_cache_production_mode(cluster):
+    r = make_router(cluster, strategy="heuristic",
+                    config=dict(PRODUCTION_CFG), benchmark_mode=False)
+    hist = [{"role": "user", "content": "What is the capital of France"}]
+    first, _, _ = r.route_query(hist)
+    assert first["cache_hit"] in (False, True)   # routing cache may hit
+    second, _, _ = r.route_query(hist)
+    assert second["cache_hit"] is True
+    assert second["routing_method"] == "response_cache"
+    assert second["response"] == first["response"]
+    assert second["routing_overhead_ms"] == 0.0
+
+
+def test_response_cache_disabled_in_benchmark_mode(cluster):
+    r = make_router(cluster, strategy="heuristic",
+                    config=dict(PRODUCTION_CFG), benchmark_mode=True)
+    assert r.enable_response_cache is False
+
+
+def test_extract_text_shapes(bench_router):
+    ex = bench_router._extract_text
+    assert ex("  plain  ") == "plain"
+    assert ex({"response": "a"}) == "a"
+    assert ex({"content": "b"}) == "b"
+    assert ex({"message": {"content": "c"}}) == "c"
+    assert ex({"error": "E", "detail": "D"}) == "E D"
+    assert ex({"response": "  "}) is None
+    assert ex(None) is None
+
+
+def test_routing_engine_failure_falls_back_to_ctx_size(cluster, monkeypatch):
+    r = make_router(cluster, strategy="token", benchmark_mode=True)
+    monkeypatch.setattr(r.query_router, "route_query",
+                        lambda **kw: (_ for _ in ()).throw(RuntimeError("x")))
+    small, _, dev_small = r.route_query([{"role": "user", "content": "hi"}])
+    assert dev_small == "nano"
+    assert small["routing_method"] == "fallback_ctx_size"
+    big, _, dev_big = r.route_query(
+        [{"role": "user", "content": "w" * 2000}])
+    assert dev_big == "orin"
+
+
+# -- Flask /chat app --------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    router = Router(strategy="hybrid", config={
+        "cache_enabled": True, "enable_response_cache": True,
+        "enable_failover": True,
+        "weights": {"token": 0.25, "semantic": 0.45, "heuristic": 0.30},
+    }, cluster=cluster)
+    app = create_app(router=router)
+    app.testing = True
+    return app.test_client()
+
+
+def test_chat_contract(client):
+    rv = client.post("/chat", json={"message": "What is the capital of France",
+                                    "strategy": "hybrid",
+                                    "session_id": "s1"})
+    assert rv.status_code == 200
+    body = rv.get_json()
+    for key in ("reply", "device", "reasoning", "method", "confidence",
+                "cache_hit", "tokens"):
+        assert key in body
+    assert body["device"] in ("nano", "orin")
+
+
+def test_chat_empty_message_400(client):
+    rv = client.post("/chat", json={"message": "   "})
+    assert rv.status_code == 400
+    assert "error" in rv.get_json()
+
+
+def test_chat_history_roundtrip(client):
+    client.post("/chat", json={"message": "hello", "session_id": "s2"})
+    rv = client.get("/history?session_id=s2")
+    hist = rv.get_json()
+    assert hist[0] == {"role": "user", "content": "hello"}
+    assert hist[1]["role"] == "assistant"
+    rv = client.delete("/history?session_id=s2")
+    assert rv.get_json() == {"cleared": "s2"}
+    assert client.get("/history?session_id=s2").get_json() == []
+
+
+def test_chat_history_capped_at_10(client):
+    for i in range(8):
+        client.post("/chat", json={"message": f"msg {i}", "session_id": "s3"})
+    hist = client.get("/history?session_id=s3").get_json()
+    assert len(hist) == 10
+
+
+def test_chat_strategy_mapping_and_switch(client):
+    rv = client.post("/chat", json={"message": "hello there friend",
+                                    "strategy": "token-counting",
+                                    "session_id": "s4"})
+    assert rv.get_json()["method"] in ("token", "token_cached",
+                                       "response_cache")
+    rv = client.post("/chat", json={"message": "hello there friend",
+                                    "strategy": "bogus", "session_id": "s4"})
+    assert rv.status_code == 500
+
+
+# -- per-tier /query API ----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tier_client(cluster):
+    tiers = build_tiers(cluster, warmup_on_start=False)
+    app = create_tier_app("nano", manager=tiers["nano"].server_manager)
+    app.testing = True
+    return app.test_client()
+
+
+def test_tier_api_health(tier_client):
+    assert tier_client.get("/health").get_json() == {"ok": True}
+    assert tier_client.get("/").status_code == 200
+
+
+def test_tier_api_query_contract(tier_client):
+    rv = tier_client.post("/query", json={
+        "query": [{"role": "user", "content": "hi"}]})
+    assert rv.status_code == 200
+    assert "response" in rv.get_json()
+    rv = tier_client.post("/query", json={"query": "plain string"})
+    assert rv.status_code == 200
+
+
+def test_tier_api_bad_requests(tier_client):
+    assert tier_client.post("/query", json={}).status_code == 400
+    assert tier_client.post(
+        "/query", json={"query": 42}).status_code == 400
+
+
+def test_tier_api_num_predict(tier_client):
+    rv = tier_client.post("/query", json={"query": "count", "num_predict": 2})
+    assert rv.status_code == 200
+
+
+def test_tier_api_non_numeric_options_400(tier_client):
+    rv = tier_client.post("/query", json={"query": "hi", "num_predict": "fast"})
+    assert rv.status_code == 400
+    rv = tier_client.post("/query", json={"query": "hi", "temperature": "hot"})
+    assert rv.status_code == 400
+
+
+def test_tier_api_temperature_sampling(tier_client):
+    # temperature reaches the sampler: repeated hot-sampled calls should not
+    # all match the greedy output (512-way categorical vs argmax).
+    greedy = tier_client.post(
+        "/query", json={"query": "hello", "num_predict": 8}).get_json()
+    hot = [tier_client.post(
+        "/query", json={"query": "hello", "num_predict": 8,
+                        "temperature": 5.0}).get_json()
+        for _ in range(3)]
+    assert any(h["response"] != greedy["response"] for h in hot)
+
+
+def test_cors_preflight(client):
+    rv = client.open("/chat", method="OPTIONS")
+    assert rv.status_code == 204
+    assert "POST" in rv.allow_methods
