@@ -1,0 +1,98 @@
+"""Headline benchmark: req/s + p50 TTFT across routing strategies.
+
+Serves the labeled ``general_knowledge`` query set (multi-turn, like the
+reference harness src/tests/routing_chatbot_tester.py) through the full
+Router pipeline — routing decision, tier dispatch onto TPU engines, failover,
+perf feedback — under all five strategies, on whatever accelerator is
+attached (tiny models on CPU so the script always completes).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+Baseline: the reference serves general_knowledge in 922.2 s (nano) + 176.0 s
+(orin) at ctx-threshold 100 — 12 queries / 1098.2 s ≈ 0.010927 req/s
+(SURVEY.md §6, results_analysis.ipynb cell 0).
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+
+# Reference throughput on the same query set (see module docstring).
+BASELINE_REQ_PER_S = 12 / (922.2 + 176.0)
+
+STRATEGIES = ("token", "semantic", "heuristic", "hybrid", "perf")
+HISTORY_LIMIT = 10
+
+
+def run() -> dict:
+    import jax
+    from distributed_llm_tpu.bench.query_sets import query_sets
+    from distributed_llm_tpu.serving.router import Router
+
+    backend = jax.default_backend()
+    queries = query_sets["general_knowledge"]
+
+    per_strategy = {}
+    ttfts, latencies = [], []
+    n_queries = 0
+    total_s = 0.0
+    correct = 0
+    gen_tokens = 0
+
+    router = Router(strategy=STRATEGIES[0], benchmark_mode=True)
+    # Compile/warm both tier engines before the timed region.
+    for tier in router.tiers.values():
+        tier.server_manager.start_server()
+
+    for strategy in STRATEGIES:
+        router.query_router.change_strategy(strategy)
+        history = []
+        s_lat, s_ttft, s_correct = [], [], 0
+        t_strat = time.perf_counter()
+        for item in queries:
+            history.append({"role": "user", "content": item["query"]})
+            t0 = time.perf_counter()
+            response, tokens, device = router.route_query(history[-HISTORY_LIMIT:])
+            dt = time.perf_counter() - t0
+            history.append({"role": "assistant",
+                            "content": response.get("response", "")})
+            tier = router.tiers.get(device)
+            res = tier.last_result if tier else None
+            if res is not None:
+                s_ttft.append(res.ttft_ms)
+                gen_tokens += res.gen_tokens
+            s_lat.append(dt * 1000.0)
+            if device == item["expected_device"]:
+                s_correct += 1
+        elapsed = time.perf_counter() - t_strat
+        total_s += elapsed
+        n_queries += len(queries)
+        correct += s_correct
+        ttfts.extend(s_ttft)
+        latencies.extend(s_lat)
+        per_strategy[strategy] = {
+            "req_per_s": round(len(queries) / elapsed, 4),
+            "p50_ttft_ms": round(statistics.median(s_ttft), 2) if s_ttft else None,
+            "routing_accuracy": round(s_correct / len(queries), 3),
+        }
+
+    req_per_s = n_queries / total_s
+    return {
+        "metric": "req_per_s_general_knowledge_all_strategies",
+        "value": round(req_per_s, 4),
+        "unit": "req/s",
+        "vs_baseline": round(req_per_s / BASELINE_REQ_PER_S, 2),
+        "p50_ttft_ms": round(statistics.median(ttfts), 2) if ttfts else None,
+        "p50_latency_ms": round(statistics.median(latencies), 2),
+        "routing_accuracy": round(correct / n_queries, 3),
+        "decode_tok_per_s": round(gen_tokens / total_s, 1),
+        "backend": backend,
+        "queries": n_queries,
+        "per_strategy": per_strategy,
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run()))

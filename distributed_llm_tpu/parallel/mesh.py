@@ -74,6 +74,43 @@ def _fit_tp(tier: TierConfig, available: int) -> int:
     return max(tp, 1)
 
 
+def training_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    *,
+    num_kv_heads: int,
+    seq_len: int,
+) -> jax.sharding.Mesh:
+    """Factor the device list into a ('dp', 'sp', 'tp') training mesh using
+    ALL devices for any count n.
+
+    tp takes the largest divisor of n that also divides the kv-head count
+    (whole GQA heads shard over tp); sp the largest divisor of the remainder
+    that divides seq_len; dp absorbs the rest.  dp always divides n, so
+    callers size the batch as a multiple of ``mesh.shape['dp']`` (see
+    Trainer) — there is no silent device-dropping fallback.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+
+    def largest_divisor(m: int, dividing: int) -> int:
+        best = 1
+        for f in range(1, m + 1):
+            if m % f == 0 and dividing % f == 0:
+                best = f
+        return best
+
+    tp = largest_divisor(n, num_kv_heads)
+    rest = n // tp
+    sp = largest_divisor(rest, seq_len)
+    if sp == rest and rest > 2:
+        sp = largest_divisor(rest // 2, seq_len) if rest % 2 == 0 else sp
+    dp = rest // sp
+    arr = np.array(devices).reshape(dp, sp, tp)
+    return jax.sharding.Mesh(arr, ("dp", "sp", "tp"))
+
+
 def describe_meshes(meshes: Dict[str, jax.sharding.Mesh]) -> str:
     parts = []
     for name, mesh in meshes.items():

@@ -56,6 +56,61 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh,
     )
 
 
+def train_param_specs(cfg: ModelConfig, dp_axis: str = "dp",
+                      tp_axis: str = "tp") -> Dict[str, Any]:
+    """FSDP × TP specs for training: on top of the Megatron TP rules, each
+    weight's *other* matmul dimension is sharded over the data axis
+    (ZeRO-3 style), so optimizer state and gradients scale down with dp.
+    GSPMD inserts the all-gathers before use and reduce-scatters on grads.
+    Norm vectors stay replicated (tiny).
+    """
+    d, t = dp_axis, tp_axis
+    return {
+        "embed": P(d, None),
+        "layers": {
+            "ln1": P(None, None),
+            "wq": P(None, d, t),
+            "wk": P(None, d, t),
+            "wv": P(None, d, t),
+            "wo": P(None, t, d),
+            "ln2": P(None, None),
+            "w_gate": P(None, d, t),
+            "w_up": P(None, d, t),
+            "w_down": P(None, t, d),
+        },
+        "final_ln": P(None),
+    }
+
+
+def train_param_shardings(cfg: ModelConfig, mesh: Mesh,
+                          dp_axis: str = "dp",
+                          tp_axis: str = "tp") -> Dict[str, Any]:
+    """NamedSharding pytree for FSDP×TP training placement.  Axes that are
+    absent from the mesh, or that do not divide the dimension they shard
+    (tiny test models on wide meshes), fall back to replication — so the
+    same rules serve any mesh from ('dp','sp','tp') down to a single-axis
+    or single-device mesh."""
+    from ..models import transformer
+    specs = train_param_specs(cfg, dp_axis, tp_axis)
+    shapes = jax.eval_shape(lambda: transformer.init_params(cfg, seed=0))
+
+    def fix(spec: P, shaped) -> NamedSharding:
+        dims = shaped.shape
+        fixed = []
+        used = set()
+        for i, ax in enumerate(spec):
+            if (ax is None or ax in used or ax not in mesh.shape
+                    or dims[i] % mesh.shape[ax]):
+                fixed.append(None)
+            else:
+                fixed.append(ax)
+                used.add(ax)
+        return NamedSharding(mesh, P(*fixed))
+
+    return jax.tree.map(fix, specs, shapes,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
 def kv_cache_specs(tp_axis: str = "tp") -> Dict[str, P]:
     """KV cache [L, B, S, N_kv, D]: shard the kv-head axis over tp."""
     return {"k": P(None, None, None, tp_axis, None),

@@ -164,7 +164,7 @@ class QueryCache:
         self,
         max_size: int = 100,
         ttl_seconds: int = 300,
-        similarity_threshold: float = 0.85,
+        similarity_threshold: float = 0.40,   # = config.DEFAULT_CACHE_SIMILARITY
         use_semantic: bool = True,
         prediction_confidence_threshold: float = PREDICTION_CONFIDENCE_THRESHOLD,
     ):

@@ -8,7 +8,9 @@ features* — word unigrams/bigrams plus character trigrams, signed-hashed into
 a sparse vector — then projected to a dense low-dimensional space by a fixed
 random Gaussian matrix and L2-normalized.  Random projection approximately
 preserves inner products (Johnson–Lindenstrauss), so cosine similarity ranks
-lexically similar texts just like the cache's 0.85-threshold scan expects.
+lexically similar texts; the cache's similarity threshold is calibrated to
+this embedder's score distribution (config.DEFAULT_CACHE_SIMILARITY = 0.40 —
+paraphrases ~0.4-0.7, unrelated ~0.0; the reference's 0.85 was MiniLM-tuned).
 
 The projection (the FLOPs) runs as a jitted matmul on the default JAX device,
 satisfying the north star's "on-device semantic-cache embeddings"
@@ -33,9 +35,9 @@ FEATURE_DIM = 16384
 EMBED_DIM = 384
 _SEED = 20260729
 
-# Function words carry little routing signal; down-weighting them calibrates
-# paraphrase cosine similarity to the cache's 0.85 threshold (two phrasings of
-# the same question share content words but differ in function words).
+# Function words carry little routing signal; down-weighting them pushes
+# paraphrase pairs (shared content words, different function words) above the
+# cache's calibrated similarity threshold (config.DEFAULT_CACHE_SIMILARITY).
 _STOPWORDS = frozenset(
     "a an and are as at be but by can could did do does for from had has have "
     "he her his how i if in is it its may me my of on or our she should so "

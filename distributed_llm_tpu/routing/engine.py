@@ -19,7 +19,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..config import BENCHMARK_CFG
+from ..config import BENCHMARK_CFG, DEFAULT_CACHE_SIMILARITY
 from .cache import QueryCache
 from .embedder import default_embedder
 from .strategies import AVAILABLE_STRATEGIES, HybridStrategy, SemanticStrategy
@@ -44,7 +44,8 @@ class QueryRouter:
         self._cache = QueryCache(
             max_size=int(self.config.get("cache_max_size", 500)),
             ttl_seconds=int(self.config.get("cache_ttl_seconds", 3600)),
-            similarity_threshold=float(self.config.get("cache_similarity_threshold", 0.85)),
+            similarity_threshold=float(self.config.get("cache_similarity_threshold",
+                                  DEFAULT_CACHE_SIMILARITY)),
             use_semantic=bool(self.config.get("use_semantic_cache", True)),
             prediction_confidence_threshold=float(
                 self.config.get("prediction_confidence_threshold", 0.70)),

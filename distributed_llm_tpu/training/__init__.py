@@ -1,0 +1,2 @@
+from .trainer import TrainConfig, Trainer, lm_loss, make_optimizer  # noqa: F401
+from .data import batches, synthetic_text  # noqa: F401

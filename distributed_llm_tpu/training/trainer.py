@@ -1,0 +1,151 @@
+"""SPMD trainer: FSDP × TP × SP sharded language-model training step.
+
+The reference has no training at all (models live in Ollama,
+src/devices/nano_api.py:15); a TPU-native framework that *owns* its models
+must be able to train/finetune them, so this subsystem is new capability.
+Design is mesh-first:
+
+- One ``jax.sharding.Mesh`` with axes ('dp', 'sp', 'tp'):
+  * **dp** — data parallel over the batch dim AND ZeRO-3/FSDP sharding of
+    params + optimizer state (parallel/sharding.py ``train_param_specs``).
+  * **sp** — sequence parallel: the token/sequence axis of activations is
+    sharded, so long-context training scales past one chip's HBM.  GSPMD
+    inserts the collectives the causal attention needs.
+  * **tp** — Megatron tensor parallel inside each layer (one all-reduce
+    after attention, one after the MLP, riding ICI).
+- The train step is ONE jitted function with explicit in/out shardings;
+  params and optimizer state are donated so updates happen in place in HBM.
+- ``jax.checkpoint`` (remat) around the forward trades FLOPs for HBM on the
+  backward pass — the standard TPU memory lever.
+- bfloat16 params/activations, float32 master optimizer state via optax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import ModelConfig
+from ..models import transformer
+from ..parallel.sharding import train_param_shardings
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    batch_size: int = 8
+    seq_len: int = 128
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.01
+    warmup_steps: int = 100
+    max_grad_norm: float = 1.0
+    remat: bool = True
+    seed: int = 0
+
+
+def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    sched = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=tc.learning_rate,
+        warmup_steps=tc.warmup_steps, decay_steps=max(tc.warmup_steps * 10, 1000))
+    return optax.chain(
+        optax.clip_by_global_norm(tc.max_grad_norm),
+        optax.adamw(sched, weight_decay=tc.weight_decay),
+    )
+
+
+def lm_loss(cfg: ModelConfig, params, tokens: jax.Array,
+            loss_mask: jax.Array, remat: bool = True) -> jax.Array:
+    """Next-token cross-entropy.  tokens: [B, S] int32; loss_mask: [B, S]
+    (1.0 where the *target* position counts).  Accumulates in float32."""
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+    fwd = transformer.prefill
+    if remat:
+        fwd = jax.checkpoint(fwd, static_argnums=(0,))
+    hidden, _ = fwd(cfg, params, tokens, positions)
+    logits = transformer.logits_from_hidden(params, hidden[:, :-1])  # [B,S-1,V]
+    targets = tokens[:, 1:]
+    mask = loss_mask[:, 1:].astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+class Trainer:
+    """Owns params + optimizer state on the mesh and the compiled step.
+
+    mesh axes: any subset of ('dp', 'sp', 'tp') — missing axes are treated
+    as size 1.  Batch is sharded over dp, sequence over sp.
+    """
+
+    def __init__(self, cfg: ModelConfig, tc: TrainConfig,
+                 mesh: Mesh, params: Optional[Dict[str, Any]] = None):
+        self.cfg = cfg
+        self.tc = tc
+        self.mesh = mesh
+        self.optimizer = make_optimizer(tc)
+
+        axes = set(mesh.axis_names)
+        dp = "dp" if "dp" in axes else None
+        sp = "sp" if "sp" in axes else None
+        self._batch_sharding = NamedSharding(mesh, P(dp, sp))
+
+        # train_param_shardings drops any axis the mesh doesn't have, so
+        # subset meshes (tp-only, dp-only, single device) just replicate
+        # along the missing axes.
+        self._param_shardings = train_param_shardings(cfg, mesh)
+
+        init = jax.jit(partial(transformer.init_params, cfg),
+                       static_argnames=("seed",),
+                       out_shardings=self._param_shardings)
+        self.params = params if params is not None else init(seed=tc.seed)
+        # Eager init: optax moments are zeros_like(param), which preserves
+        # each param's NamedSharding; scalar counters stay replicated.
+        self.opt_state = self.optimizer.init(self.params)
+
+        self.step_count = 0
+        self._step_fn = self._build_step()
+
+    def _build_step(self):
+        cfg, tc, optimizer = self.cfg, self.tc, self.optimizer
+
+        def step(params, opt_state, tokens, loss_mask):
+            loss, grads = jax.value_and_grad(
+                lambda p: lm_loss(cfg, p, tokens, loss_mask, remat=tc.remat)
+            )(params)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            gnorm = optax.global_norm(grads)
+            return params, opt_state, {"loss": loss, "grad_norm": gnorm}
+
+        # Pin the params' output shardings to the canonical placement —
+        # otherwise GSPMD may legally return e.g. a dp-sharded norm vector,
+        # which would then fail the next call's in_shardings check.
+        return jax.jit(
+            step,
+            in_shardings=(self._param_shardings, None,
+                          self._batch_sharding, self._batch_sharding),
+            out_shardings=(self._param_shardings, None, None),
+            donate_argnums=(0, 1),
+        )
+
+    def train_step(self, tokens: np.ndarray,
+                   loss_mask: Optional[np.ndarray] = None
+                   ) -> Dict[str, float]:
+        """One step on a [B, S] int32 token batch.  Returns host metrics."""
+        if loss_mask is None:
+            loss_mask = np.ones_like(tokens, np.float32)
+        tokens = jax.device_put(jnp.asarray(tokens, jnp.int32),
+                                self._batch_sharding)
+        loss_mask = jax.device_put(jnp.asarray(loss_mask, jnp.float32),
+                                   self._batch_sharding)
+        self.params, self.opt_state, metrics = self._step_fn(
+            self.params, self.opt_state, tokens, loss_mask)
+        self.step_count += 1
+        return {k: float(v) for k, v in metrics.items()}
