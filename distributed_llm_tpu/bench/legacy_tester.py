@@ -1,0 +1,126 @@
+"""Legacy threshold-sweep tester (v1 harness parity).
+
+Reference parity: src/tests/chatbot_tester.py — the earlier single-strategy
+harness that sweeps the context threshold over a Chatbot and writes the
+``final_results.csv`` schema consumed by results_analysis.ipynb ("Query Set",
+"Context Threshold", then per-device Latency / Energy / Avg Power / Tokens
+Generated).  Kept because the stored baseline numbers (BASELINE.md) are in
+this schema.
+
+Documented fix vs the reference (SURVEY.md §7 quirks): v1 summed raw 1 Hz
+power samples as "energy" (chatbot_tester.py:225); we integrate the sampled
+telemetry properly over each query window (the v2 semantics), using the
+HBM-occupancy proxy since TPUs expose no per-query power (utils/telemetry).
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+from datetime import datetime
+from typing import Dict, List, Optional
+
+from ..serving.cli import Chatbot
+from .query_sets import query_sets
+from .tester import normalize_query_set
+
+HEADERS = [
+    "Query Set", "Context Threshold",
+    "Nano Latency (ms)", "Nano Energy (mJ)", "Nano Avg Power (W)",
+    "Nano Tokens Generated",
+    "Orin Latency (ms)", "Orin Energy (mJ)", "Orin Avg Power (W)",
+    "Orin Tokens Generated",
+]
+
+
+class ChatbotTester:
+    def __init__(self, test_queries, context_thresholds,
+                 strategy: str = "perf"):
+        self.test_queries = normalize_query_set(test_queries)
+        self.context_thresholds = list(context_thresholds)
+        self.strategy = strategy
+        from .tester import _build_telemetry
+        self.telemetry = _build_telemetry()
+
+    def run(self, query_set_name: str,
+            output_file: str = "final_results.csv") -> Dict[int, Dict]:
+        self.telemetry.start()
+        query_log = []   # (threshold, device, start, end, tokens)
+        try:
+            for threshold in self.context_thresholds:
+                chatbot = Chatbot(strategy=self.strategy, config={
+                    "cache_enabled": False,
+                    "enable_response_cache": False,
+                    "enable_failover": True,
+                    "token_threshold": threshold,
+                })
+                chatbot.router.set_threshold(threshold)
+                for qi in self.test_queries:
+                    start = datetime.now()
+                    chatbot.add_message("user", qi.text)
+                    response, tokens, device = chatbot.router.route_query(
+                        chatbot.history)
+                    reply = (response.get("response", "")
+                             if isinstance(response, dict) else str(response))
+                    chatbot.add_message("assistant", reply)
+                    query_log.append((threshold, device, start,
+                                      datetime.now(), int(tokens or 0)))
+                chatbot.shutdown()
+        finally:
+            self.telemetry.stop()
+
+        results = self.calculate_energy(query_log)
+        self.save_results(results, query_set_name, output_file)
+        return results
+
+    def calculate_energy(self, query_log) -> Dict[int, Dict]:
+        results: Dict[int, Dict[str, List[float]]] = {}
+        for threshold, device, start, end, tokens in query_log:
+            if device not in ("nano", "orin"):
+                continue
+            per = results.setdefault(
+                threshold, {"nano": [0, 0.0, 0.0, 0], "orin": [0, 0.0, 0.0, 0]})
+            latency = round((end - start).total_seconds() * 1000)
+            energy = self.telemetry.energy_for_window(device, start, end)
+            per[device][0] += latency
+            per[device][1] += energy
+            per[device][3] += tokens
+        for per in results.values():
+            for device in ("nano", "orin"):
+                lat, energy = per[device][0], per[device][1]
+                per[device][2] = round(energy / lat, 3) if lat > 0 else 0.0
+        return results
+
+    def save_results(self, results, query_set_name: str,
+                     output_file: str) -> None:
+        file_exists = os.path.exists(output_file)
+        with open(output_file, "a", newline="") as f:
+            writer = csv.writer(f)
+            if not file_exists:
+                writer.writerow(HEADERS)
+            for threshold, per in results.items():
+                writer.writerow([
+                    query_set_name, threshold,
+                    per["nano"][0], round(per["nano"][1], 3),
+                    per["nano"][2], per["nano"][3],
+                    per["orin"][0], round(per["orin"][1], 3),
+                    per["orin"][2], per["orin"][3],
+                ])
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--query-set", default="personal_health")
+    p.add_argument("--thresholds", nargs="+", type=int,
+                   default=[100, 500, 1000, 2000, 4000])
+    p.add_argument("--strategy", default="perf")
+    p.add_argument("--output-csv", default="final_results.csv")
+    args = p.parse_args(argv)
+    tester = ChatbotTester(query_sets[args.query_set], args.thresholds,
+                           strategy=args.strategy)
+    tester.run(args.query_set, args.output_csv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,183 @@
+"""Telemetry: the TPU equivalent of the reference's power subsystem.
+
+The reference SSHes a jtop sampler onto each Jetson (src/tests/
+logging_power.py: 1 Hz lines "<ts>: <total_mW>"), scp's the logs back, and
+integrates power over each query's [start, end) window into mJ
+(src/tests/routing_chatbot_tester.py:239-254).  Cloud TPU exposes no
+per-query power, so the same *shape* of subsystem samples what the hardware
+does expose — per-device HBM occupancy (``device.memory_stats()``) — at the
+same 1 Hz cadence, writes the same "<ts>: <value>" log format, and offers
+the same trapezoidal window integration.  The integral is bytes·s (an
+occupancy proxy, NOT millijoules); CSV columns keep the reference schema
+with this documented substitution (SURVEY.md §5.1).
+
+Also here: ``jax.profiler`` capture helpers — the flamegraph-class tooling
+the reference never had — and a phase-timer used by the serving stack to
+attribute time to tokenize/prefill/decode/detokenize.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from datetime import datetime
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import jax
+
+
+def device_memory_snapshot() -> List[Dict[str, Any]]:
+    """Per-device memory stats (empty dict per device where unsupported,
+    e.g. host CPU backends)."""
+    out = []
+    for dev in jax.devices():
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:
+            stats = {}
+        out.append({
+            "device": dev.id,
+            "platform": dev.platform,
+            "bytes_in_use": stats.get("bytes_in_use", 0),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+            "bytes_limit": stats.get("bytes_limit", 0),
+        })
+    return out
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str = "/tmp/dllm_tpu_trace"):
+    """Capture a jax.profiler trace (TensorBoard / xprof readable) around a
+    block — per-op HLO timings on TPU, the flamegraph the reference lacked."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+class PhaseTimer:
+    """Accumulates wall-time per named phase across queries."""
+
+    def __init__(self):
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {name: {"total_s": round(self.totals[name], 4),
+                       "count": self.counts[name],
+                       "mean_ms": round(1000 * self.totals[name]
+                                        / max(1, self.counts[name]), 3)}
+                for name in self.totals}
+
+
+class TierTelemetry:
+    """1 Hz sampler of per-tier device memory, window-integrable.
+
+    Mirrors the reference power logger's lifecycle: ``start()`` (SSH nohup
+    equivalent), ``stop()``, ``save_log(tier, path)`` ("scp" equivalent,
+    same "<ts>: <value>" line format), and ``energy_for_window`` with the
+    v2 harness's trapezoidal accumulation semantics.
+    """
+
+    def __init__(self, tiers: Iterable[str], interval_s: float = 1.0,
+                 tier_devices: Optional[Dict[str, List[int]]] = None):
+        self.tiers = list(tiers)
+        self.interval_s = interval_s
+        # Without an explicit tier→device map, every tier reads all devices
+        # (correct for the single-chip bench; multi-slice deployments pass
+        # the carved submesh device ids).
+        self.tier_devices = tier_devices or {}
+        self.samples: Dict[str, List[Tuple[float, float]]] = {
+            t: [] for t in self.tiers}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _sample_once(self) -> None:
+        now = time.time()
+        snap = device_memory_snapshot()
+        by_id = {s["device"]: s for s in snap}
+        for tier in self.tiers:
+            ids = self.tier_devices.get(tier)
+            rows = ([by_id[i] for i in ids if i in by_id]
+                    if ids else snap)
+            total = float(sum(r["bytes_in_use"] for r in rows))
+            self.samples[tier].append((now, total))
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._sample_once()
+            except Exception:
+                pass
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tier-telemetry")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=2 * self.interval_s)
+        self._thread = None
+
+    def save_log(self, tier: str, path: str) -> None:
+        """Write the reference power-log line format: "<unix_ts>: <value>"."""
+        with open(path, "w") as f:
+            for ts, val in self.samples.get(tier, []):
+                f.write(f"{ts:.3f}: {val:.0f}\n")
+
+    def energy_for_window(self, tier: str, start: datetime,
+                          end: datetime) -> float:
+        """Integrate the piecewise-linear sample trace over [start, end)
+        (the v2 harness's mW·s accumulation, routing_chatbot_tester.py:
+        239-254).  Units: <sample unit>·s.
+
+        Unlike the reference (whose multi-second Jetson queries always
+        spanned several 1 Hz samples), TPU queries can finish between two
+        samples — so the trace is interpolated to the exact window edges,
+        and a window inside one sampling interval still integrates a
+        nonzero slice.
+        """
+        t0, t1 = start.timestamp(), end.timestamp()
+        pts = self.samples.get(tier, [])
+        if not pts or t1 <= t0:
+            return 0.0
+
+        def value_at(t: float) -> float:
+            # Clamp outside the trace; linear interpolation inside.
+            if t <= pts[0][0]:
+                return pts[0][1]
+            if t >= pts[-1][0]:
+                return pts[-1][1]
+            for (ta, va), (tb, vb) in zip(pts, pts[1:]):
+                if ta <= t <= tb:
+                    if tb == ta:
+                        return va
+                    return va + (vb - va) * (t - ta) / (tb - ta)
+            return pts[-1][1]
+
+        knots = ([(t0, value_at(t0))]
+                 + [(ts, v) for ts, v in pts if t0 < ts < t1]
+                 + [(t1, value_at(t1))])
+        total = 0.0
+        for (ta, va), (tb, vb) in zip(knots, knots[1:]):
+            total += 0.5 * (va + vb) * (tb - ta)
+        return total

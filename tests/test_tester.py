@@ -1,0 +1,88 @@
+"""Benchmark-harness tests: CLI contract, sweep semantics, CSV schemas.
+
+Reference behaviors under test: threshold sweep applies only to the token
+strategy (routing_chatbot_tester.py:352-367); cache off→benchmark_mode;
+per-query + summary CSV schemas; accuracy vs expected_device labels.
+"""
+
+import csv
+
+from distributed_llm_tpu.bench import tester
+from distributed_llm_tpu.bench.query_sets import query_sets
+
+
+def test_normalize_query_set_shapes():
+    items = tester.normalize_query_set(
+        ["  plain string ", {"query": "labeled", "expected_device": "ORIN"},
+         {"text": "alt key", "label": "bogus"}, {"query": "   "}])
+    assert [i.text for i in items] == ["plain string", "labeled", "alt key"]
+    assert [i.expected_device for i in items] == [None, "orin", None]
+
+
+def test_grid_sweeps_threshold_only_for_token():
+    cfg = tester.RunConfig(
+        query_set_name="x", thresholds=[100, 1000, 4000],
+        strategies=["token", "heuristic"], cache_modes=["off", "on"],
+        fixed_threshold_for_non_token=1000,
+        output_csv="", output_per_query_csv="")
+    grid = list(tester._experiment_grid(cfg))
+    token_runs = [g for g in grid if g[0] == "token"]
+    other_runs = [g for g in grid if g[0] != "token"]
+    assert len(token_runs) == 6            # 3 thresholds × 2 cache modes
+    assert len(other_runs) == 2            # fixed threshold × 2 cache modes
+    assert {g[2] for g in other_runs} == {1000}
+
+
+def test_compute_accuracy_ignores_unlabeled():
+    rows = [
+        {"expected_device": "nano", "device_used": "nano"},
+        {"expected_device": "orin", "device_used": "nano"},
+        {"expected_device": None, "device_used": "nano"},
+    ]
+    assert tester.compute_accuracy(rows) == 0.5
+    assert tester.compute_accuracy([{"expected_device": None}]) is None
+
+
+def test_end_to_end_run_writes_both_csvs(tmp_path):
+    out_summary = tmp_path / "summary.csv"
+    out_perq = tmp_path / "per_query.csv"
+    items = tester.normalize_query_set(query_sets["general_knowledge"][:3])
+    cfg = tester.RunConfig(
+        query_set_name="general_knowledge",
+        thresholds=[1000], strategies=["token", "heuristic"],
+        cache_modes=["off"], fixed_threshold_for_non_token=1000,
+        output_csv=str(out_summary), output_per_query_csv=str(out_perq),
+        telemetry=True)
+    rows = tester.run_experiment(items, cfg)
+    assert len(rows) == 2 * len(items)
+
+    with open(out_perq) as f:
+        per_query = list(csv.DictReader(f))
+    assert len(per_query) == 2 * len(items)
+    assert set(tester.PER_QUERY_HEADERS) == set(per_query[0].keys())
+    assert all(r["device_used"] in ("nano", "orin") for r in per_query)
+    assert all(float(r["latency_ms"]) >= 0 for r in per_query)
+
+    with open(out_summary) as f:
+        summary = list(csv.DictReader(f))
+    assert len(summary) == 2
+    assert set(tester.SUMMARY_HEADERS) == set(summary[0].keys())
+    for row in summary:
+        assert 0.0 <= float(row["routing_accuracy"]) <= 1.0
+        assert float(row["req_per_s"]) > 0
+        total = (int(row["nano_total_tokens"]) + int(row["orin_total_tokens"]))
+        assert total == int(row["overall_total_tokens"])
+
+
+def test_legacy_tester_writes_v1_schema(tmp_path):
+    from distributed_llm_tpu.bench.legacy_tester import ChatbotTester, HEADERS
+    out = tmp_path / "final_results.csv"
+    t = ChatbotTester(query_sets["personal_health"][:2],
+                      context_thresholds=[100], strategy="token")
+    results = t.run("personal_health", str(out))
+    assert 100 in results
+    with open(out) as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == HEADERS
+    assert len(rows) == 2
+    assert rows[1][0] == "personal_health"
