@@ -86,6 +86,11 @@ class ModelConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
+    # "auto" | "pallas" | "xla": attention kernel choice.  auto = the
+    # GSPMD-shardable XLA path (safe under any mesh); unsharded serving
+    # engines upgrade auto to the Pallas flash kernels on TPU
+    # (engine/inference.py, ops/attention.py resolve_impl).
+    attention_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:

@@ -71,6 +71,12 @@ class InferenceEngine:
     ):
         self.tier = tier
         self.cfg = tier.model()
+        # Unsharded tiers on TPU upgrade "auto" attention to the Pallas
+        # flash kernels; sharded meshes stay on the GSPMD-partitionable XLA
+        # path (a pallas_call has no sharding rule — see ops/attention.py).
+        if (self.cfg.attention_impl == "auto" and mesh is None
+                and jax.default_backend() == "tpu"):
+            self.cfg = dataclasses.replace(self.cfg, attention_impl="pallas")
         self.tokenizer = ByteTokenizer()
         self.mesh = mesh
         self._rng = jax.random.PRNGKey(seed ^ 0x5EED)

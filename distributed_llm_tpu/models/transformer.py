@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import ModelConfig
-from ..ops.attention import causal_attention, decode_attention
+from ..ops import attention
 
 Params = Dict[str, Any]
 KVCache = Dict[str, jax.Array]   # {"k": [L,B,S,N_kv,D], "v": [L,B,S,N_kv,D]}
@@ -120,7 +120,8 @@ def prefill(cfg: ModelConfig, params: Params, tokens: jax.Array,
         v = (h_in @ lp["wv"]).reshape(b, s, cfg.num_kv_heads, d)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
-        attn = causal_attention(q, k, v).reshape(b, s, cfg.num_heads * d)
+        attn = attention.causal(q, k, v, impl=cfg.attention_impl
+                                ).reshape(b, s, cfg.num_heads * d)
         x = x + attn @ lp["wo"]
         x = x + _swiglu(rms_norm(x, lp["ln2"], cfg.norm_eps),
                         lp["w_gate"], lp["w_up"], lp["w_down"])
@@ -170,7 +171,8 @@ def decode_step(cfg: ModelConfig, params: Params, token: jax.Array,
         k_cache = write(k_cache, k)
         v_cache = write(v_cache, v)
 
-        attn = decode_attention(q, k_cache, v_cache, pos)
+        attn = attention.decode(q, k_cache, v_cache, pos,
+                                impl=cfg.attention_impl)
         x = x + attn.reshape(b, cfg.num_heads * d) @ lp["wo"]
         x = x + _swiglu(rms_norm(x, lp["ln2"], cfg.norm_eps),
                         lp["w_gate"], lp["w_up"], lp["w_down"])

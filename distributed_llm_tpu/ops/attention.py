@@ -11,10 +11,55 @@ head_dim); queries are [B, S, N_q, D] with N_q a multiple of N_kv (GQA).
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+
+def resolve_impl(impl: str = "auto") -> str:
+    """Resolve the attention implementation choice.
+
+    'auto' resolves to the portable XLA path: it is GSPMD-partitionable, so
+    it is the only safe default inside pjit-sharded computations (the
+    trainer's sp/tp meshes, tensor-sharded tiers).  'pallas' is an explicit
+    opt-in used by unsharded serving engines (engine/inference.py picks it
+    for single-device tiers on TPU); a pallas_call has no GSPMD sharding
+    rule, so opting in under a >1-device mesh would replicate the operands.
+    DLLM_ATTENTION=xla|pallas overrides everything (kill switch / forced
+    testing); any other value raises rather than failing open.
+    """
+    env = os.environ.get("DLLM_ATTENTION")
+    if env is not None:
+        if env not in ("xla", "pallas"):
+            raise ValueError(f"DLLM_ATTENTION={env!r}: expected 'xla' or 'pallas'")
+        return env
+    if impl == "auto":
+        return "xla"
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"attention impl {impl!r}: expected 'auto', 'xla' "
+                         "or 'pallas'")
+    return impl
+
+
+def causal(q: jax.Array, k: jax.Array, v: jax.Array,
+           impl: str = "auto") -> jax.Array:
+    """Dispatching causal attention (prefill)."""
+    if resolve_impl(impl) == "pallas":
+        from .pallas_attention import flash_causal_attention
+        return flash_causal_attention(q, k, v)
+    return causal_attention(q, k, v)
+
+
+def decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+           pos: jax.Array, impl: str = "auto") -> jax.Array:
+    """Dispatching single-step decode attention."""
+    if resolve_impl(impl) == "pallas":
+        from .pallas_attention import flash_decode_attention
+        return flash_decode_attention(q, k_cache, v_cache, pos)
+    return decode_attention(q, k_cache, v_cache, pos)
 
 
 def _expand_kv(x: jax.Array, groups: int) -> jax.Array:

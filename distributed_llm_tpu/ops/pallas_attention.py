@@ -1,0 +1,196 @@
+"""Pallas TPU attention kernels — the hot ops of the serving engine.
+
+The reference's attention lives inside llama.cpp's CUDA/CPU kernels behind
+Ollama (SURVEY.md §2.1); these are their TPU-native replacement, written
+against the Mosaic/Pallas TPU programming model (/opt/skills/guides/
+pallas_guide.md):
+
+- ``flash_causal_attention`` — blocked prefill attention with the online-
+  softmax (flash) recurrence: KV blocks stream through VMEM, the [S, S]
+  score matrix is never materialized in HBM, and the causal frontier prunes
+  whole KV blocks (block j is skipped entirely once j*BK > (i+1)*BQ).
+  float32 running max / sum / accumulator, bfloat16 everywhere else — the
+  MXU-native mix.  A custom VJP recomputes attention with the XLA path on
+  the backward pass so the same kernel serves training (flash backward
+  trades FLOPs for the O(S²) residuals it refuses to store).
+- ``flash_decode_attention`` — single-token decode against the full KV
+  cache: grid over (batch, kv-head), each program attends one GQA group's
+  queries to its kv head's [S_max, D] cache slice in VMEM with the
+  per-sequence length mask applied in-kernel.  This is the masked/"ragged"
+  decode read: every sequence sees exactly its own prefix.
+
+Both kernels run in interpreter mode off-TPU, so the CPU test suite
+exercises the exact kernel code paths the TPU compiles.
+
+Layouts: the public contracts match ops/attention.py ([B, S, N, D] /
+cache [B, S_max, N_kv, D]); kernels internally use head-major [B, N, S, D]
+so the last two dims tile onto (sublane, lane).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import NEG_INF, causal_attention, decode_attention
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# =============================================================================
+# Prefill: blocked causal flash attention
+# =============================================================================
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, bq: int, bk: int,
+                  head_dim: int, scale: float):
+    i = pl.program_id(2)
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale              # [BQ, D]
+    row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
+
+    acc = jnp.zeros((bq, head_dim), jnp.float32)
+    m = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, 0, pl.ds(j * bk, bk), :]                # [BK, D]
+        v = v_ref[0, 0, pl.ds(j * bk, bk), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+        s = jnp.where(col <= row, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                               # [BQ, BK]
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        return acc, m_new, l
+
+    # Causal pruning: KV blocks strictly above this Q block's last row
+    # contribute nothing — don't even stream them in.
+    n_blocks = pl.cdiv((i + 1) * bq, bk)
+    acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc, m, l))
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    b, s, nq, d = q.shape
+    nkv = k.shape[2]
+    groups = nq // nkv
+    bq = bk = min(s, 128)
+    if s % bq != 0:
+        raise ValueError(
+            f"flash_causal_attention: seq len {s} not a multiple of the "
+            f"{bq} block — use power-of-two buckets/seq lens (or impl='xla')")
+
+    qh = q.transpose(0, 2, 1, 3)                             # [B, Nq, S, D]
+    kh = k.transpose(0, 2, 1, 3)                             # [B, Nkv, S, D]
+    vh = v.transpose(0, 2, 1, 3)
+
+    kernel = functools.partial(_flash_kernel, bq=bq, bk=bk, head_dim=d,
+                               scale=d ** -0.5)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, nq, s // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h, i: (b_, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, s, d), lambda b_, h, i: (b_, h // groups, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, s, d), lambda b_, h, i: (b_, h // groups, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h, i: (b_, h, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        interpret=_interpret(),
+    )(qh, kh, vh)
+    return out.transpose(0, 2, 1, 3)                         # [B, S, Nq, D]
+
+
+@jax.custom_vjp
+def flash_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array
+                           ) -> jax.Array:
+    """Drop-in for ops.attention.causal_attention (q [B,S,Nq,D],
+    k/v [B,S,Nkv,D] -> [B,S,Nq,D]), flash-blocked on TPU."""
+    return _flash_forward(q, k, v)
+
+
+def _flash_fwd(q, k, v):
+    return _flash_forward(q, k, v), (q, k, v)
+
+
+def _flash_bwd(res, g):
+    # Backward = VJP of the mathematically identical XLA attention,
+    # recomputed from the saved inputs (no O(S²) residuals kept).
+    q, k, v = res
+    _, vjp = jax.vjp(causal_attention, q, k, v)
+    return vjp(g)
+
+
+flash_causal_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# =============================================================================
+# Decode: masked ("ragged") single-token attention over the KV cache
+# =============================================================================
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float):
+    p = pos_ref[0]                                            # this seq's pos
+    q = q_ref[0, 0].astype(jnp.float32) * scale               # [G, D]
+    k = k_ref[0, 0]                                           # [S, D]
+    v = v_ref[0, 0]
+
+    s = jnp.dot(q, k.T.astype(jnp.float32),
+                preferred_element_type=jnp.float32)           # [G, S]
+    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(col <= p, s, NEG_INF)                       # ragged mask
+
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    probs = e / jnp.sum(e, axis=-1, keepdims=True)
+    o_ref[0, 0] = jnp.dot(probs.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def flash_decode_attention(q: jax.Array, k_cache: jax.Array,
+                           v_cache: jax.Array, pos: jax.Array) -> jax.Array:
+    """Drop-in for ops.attention.decode_attention (q [B,Nq,D],
+    caches [B,S_max,Nkv,D], pos [B] -> [B,Nq,D])."""
+    b, nq, d = q.shape
+    s_max, nkv = k_cache.shape[1], k_cache.shape[2]
+    groups = nq // nkv
+
+    qh = q.reshape(b, nkv, groups, d)                        # group-major
+    kh = k_cache.transpose(0, 2, 1, 3)                       # [B, Nkv, S, D]
+    vh = v_cache.transpose(0, 2, 1, 3)
+    pos32 = pos.astype(jnp.int32)
+
+    kernel = functools.partial(_decode_kernel, scale=d ** -0.5)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, nkv),
+        in_specs=[
+            pl.BlockSpec((1,), lambda b_, h: (b_,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, groups, d), lambda b_, h: (b_, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, s_max, d), lambda b_, h: (b_, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, s_max, d), lambda b_, h: (b_, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, groups, d), lambda b_, h: (b_, h, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        interpret=_interpret(),
+    )(pos32, qh, kh, vh)
+    return out.reshape(b, nq, d)
