@@ -158,7 +158,11 @@ class TierConfig:
     max_new_tokens: int = 256       # decode cap (reference: num_predict, -1=unbounded)
     temperature: float = 0.0        # greedy by default (src/devices/nano_api.py:21)
     prefill_buckets: Tuple[int, ...] = (64, 128, 256, 512, 1024, 2048)
+    # decode_batch > 1 turns on the continuous-batching engine (that many
+    # concurrent sequences share one compiled decode step); kv_block_size is
+    # its paged KV pool's block granularity (engine/batching.py, paged_kv.py).
     decode_batch: int = 1
+    kv_block_size: int = 64
 
     def model(self) -> ModelConfig:
         return MODEL_PRESETS[self.model_preset]

@@ -1,0 +1,363 @@
+"""Continuous batching: many concurrent requests share one decode loop.
+
+The reference serves one blocking request per device at a time (Flask →
+Ollama with ``stream: false``, src/devices/nano_api.py:64-76); concurrency
+is only across the two Jetsons.  Here a tier runs a scheduler in front of
+the paged KV pool (engine/paged_kv.py):
+
+- requests **admit** into one of ``max_slots`` batch slots as soon as a
+  slot and enough KV blocks are free (prefill runs immediately — TTFT is
+  one compiled prefill call, same as the sequential engine);
+- every scheduler tick runs ONE batched ``decode_step_paged`` for all
+  active slots — a new request joins mid-flight without waiting for its
+  neighbors to finish, and a finished one frees its blocks the same tick;
+- the public surface stays the synchronous per-request ``generate()``
+  (the /query contract): callers block on a per-request event while their
+  tokens stream out of the shared loop.
+
+Shapes are static in (max_slots, blocks_per_slot): one compiled decode
+step serves every occupancy, so the scheduler never recompiles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import TierConfig
+from ..models import transformer
+from .inference import (GenerationResult, prepare_prompt, trim_at_eos,
+                        upgrade_attention_impl)
+from .paged_kv import (BlockAllocator, PagedConfig, TRASH_BLOCK,
+                       decode_step_paged, init_pool, write_prefill_blocks)
+from .tokenizer import ByteTokenizer
+
+History = Union[str, Sequence[Dict[str, Any]]]
+
+
+def _sample_batched(logits: jax.Array, rng: jax.Array,
+                    temps: jax.Array) -> jax.Array:
+    """Per-slot runtime temperature: greedy where temp<=0, else sampled."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
+@dataclasses.dataclass
+class _Request:
+    history: History
+    max_new_tokens: Optional[int]
+    temperature: Optional[float]
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    result: Optional[GenerationResult] = None
+    error: Optional[BaseException] = None
+    t_submit: float = dataclasses.field(default_factory=time.perf_counter)
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: _Request
+    blocks: List[int]
+    prompt_len: int
+    budget: int
+    temperature: float
+    ttft_ms: float
+    tokens: List[int] = dataclasses.field(default_factory=list)
+
+
+class ContinuousBatchingEngine:
+    """Drop-in for InferenceEngine (same generate()/warmup() surface) with
+    a shared batched decode loop behind it.  Built by EngineManager when
+    ``tier.decode_batch > 1``."""
+
+    def __init__(self, tier: TierConfig, seed: int = 0,
+                 params: Optional[Dict[str, Any]] = None,
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 devices: Optional[Sequence[jax.Device]] = None):
+        if mesh is not None:
+            raise NotImplementedError(
+                "continuous batching currently targets unsharded tiers; "
+                "use InferenceEngine for tensor-sharded meshes")
+        self.tier = tier
+        self.cfg = upgrade_attention_impl(tier.model(), mesh)
+        bad = [b for b in tier.prefill_buckets if b % tier.kv_block_size]
+        if bad:
+            raise ValueError(
+                f"prefill buckets {bad} not multiples of kv_block_size="
+                f"{tier.kv_block_size}: prefilled K/V must page evenly")
+        self.tokenizer = ByteTokenizer()
+        self.devices = list(devices) if devices else None
+        self._rng = jax.random.PRNGKey(seed ^ 0xBA7C4)
+
+        self.paged = PagedConfig(block_size=tier.kv_block_size,
+                                 max_slots=tier.decode_batch,
+                                 max_seq_len=self.cfg.max_seq_len)
+        if params is None:
+            init = jax.jit(partial(transformer.init_params, self.cfg),
+                           static_argnames=("seed",))
+            params = init(seed=seed)
+        self.params = params
+        self.pool = init_pool(self.cfg, self.paged)
+        self.allocator = BlockAllocator(self.paged.num_blocks)
+
+        b, mb = self.paged.max_slots, self.paged.blocks_per_slot
+        self._tables = np.full((b, mb), TRASH_BLOCK, np.int32)
+        self._pos = np.zeros(b, np.int32)
+        self._cur = np.zeros(b, np.int32)
+        self._temps = np.zeros(b, np.float32)
+        self._slots: List[Optional[_Slot]] = [None] * b
+
+        self._prefill_fns: Dict[int, Any] = {}
+        self._writer_fns: Dict[int, Any] = {}
+        self._decode_fn = None
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lifecycle = threading.Lock()   # guards start()/stop()
+
+    # -- compiled stages ---------------------------------------------------
+
+    def _prefill_fn(self, bucket: int):
+        """Per bucket: forward the padded prompt, return the first sampled
+        token and the per-layer K/V to page into the pool."""
+        if bucket in self._prefill_fns:
+            return self._prefill_fns[bucket]
+        cfg = self.cfg
+
+        def run(params, tokens, true_len, rng, temp):
+            b, s = tokens.shape
+            positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+            hidden, (k_all, v_all) = transformer.prefill(
+                cfg, params, tokens, positions)
+            last = hidden[jnp.arange(b), true_len - 1]
+            logits = transformer.logits_from_hidden(params, last)
+            first = _sample_batched(logits, rng, temp[None])[0]
+            return first, k_all[:, 0], v_all[:, 0]       # squeeze batch
+
+        fn = jax.jit(run)
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    def _decode_step(self):
+        """One compiled tick for all slots."""
+        if self._decode_fn is not None:
+            return self._decode_fn
+        cfg = self.cfg
+
+        def run(params, pool, tables, pos, cur, temps, rng):
+            logits, pool = decode_step_paged(cfg, params, cur, pos, pool,
+                                             tables)
+            nxt = _sample_batched(logits, rng, temps)
+            return nxt, pool
+
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        self._decode_fn = jax.jit(run, donate_argnums=donate)
+        return self._decode_fn
+
+    def _writer_fn(self, nb: int):
+        """Jitted pool scatter (donated pool → in-place page-in), one
+        compile per prefill block count."""
+        if nb not in self._writer_fns:
+            donate = (0,) if jax.default_backend() != "cpu" else ()
+            self._writer_fns[nb] = jax.jit(write_prefill_blocks,
+                                           donate_argnums=donate)
+        return self._writer_fns[nb]
+
+    # -- scheduler ---------------------------------------------------------
+
+    def _admit(self, req: _Request, slot_ix: int) -> bool:
+        ids, bucket = prepare_prompt(self.tokenizer, req.history,
+                                     self.tier.prefill_buckets,
+                                     self.cfg.max_seq_len,
+                                     self.tier.max_new_tokens)
+        n = len(ids)
+        budget = self.tier.max_new_tokens
+        if req.max_new_tokens and req.max_new_tokens > 0:
+            budget = min(budget, req.max_new_tokens)
+
+        bs = self.paged.block_size
+        need = -(-min(bucket + budget, self.cfg.max_seq_len) // bs)
+        blocks = self.allocator.alloc(need)
+        if blocks is None:
+            return False                     # KV pressure: stay queued
+
+        try:
+            tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+            tokens[0, :n] = ids
+            self._rng, rng = jax.random.split(self._rng)
+            temp = (self.tier.temperature if req.temperature is None
+                    else req.temperature)
+
+            first, k_all, v_all = self._prefill_fn(bucket)(
+                self.params, jnp.asarray(tokens), jnp.asarray([n], np.int32),
+                rng, jnp.float32(temp))
+            # Page the prefilled bucket into this slot's leading blocks.
+            nb_prefill = bucket // bs
+            self.pool = self._writer_fn(nb_prefill)(
+                self.pool, jnp.asarray(blocks[:nb_prefill], np.int32),
+                k_all, v_all)
+            first = int(jax.block_until_ready(first))
+        except BaseException:
+            self.allocator.free(blocks)      # don't leak pool blocks
+            raise
+        ttft_ms = (time.perf_counter() - req.t_submit) * 1000.0
+
+        slot = _Slot(request=req, blocks=blocks, prompt_len=n, budget=budget,
+                     temperature=temp, ttft_ms=ttft_ms, tokens=[first])
+        self._slots[slot_ix] = slot
+        row = np.full(self.paged.blocks_per_slot, TRASH_BLOCK, np.int32)
+        row[:len(blocks)] = blocks
+        self._tables[slot_ix] = row
+        self._pos[slot_ix] = n               # first generated token's pos
+        self._cur[slot_ix] = first
+        self._temps[slot_ix] = temp
+        if first == self.tokenizer.eos_id or slot.budget <= 1:
+            self._finish(slot_ix)
+        return True
+
+    def _finish(self, slot_ix: int) -> None:
+        slot = self._slots[slot_ix]
+        gen_ids = trim_at_eos(slot.tokens, self.tokenizer.eos_id,
+                              self.tokenizer.pad_id)
+        req = slot.request
+        req.result = GenerationResult(
+            text=self.tokenizer.decode(gen_ids),
+            token_ids=gen_ids,
+            prompt_tokens=slot.prompt_len,
+            gen_tokens=len(gen_ids),
+            ttft_ms=slot.ttft_ms,
+            total_ms=(time.perf_counter() - req.t_submit) * 1000.0,
+        )
+        self._release(slot_ix)
+        req.done.set()
+
+    def _release(self, slot_ix: int) -> None:
+        slot = self._slots[slot_ix]
+        self.allocator.free(slot.blocks)
+        self._slots[slot_ix] = None
+        self._tables[slot_ix] = TRASH_BLOCK
+        self._pos[slot_ix] = 0
+        self._cur[slot_ix] = 0
+
+    def _fail_slot(self, slot_ix: int, exc: BaseException) -> None:
+        req = self._slots[slot_ix].request
+        self._release(slot_ix)
+        req.error = exc
+        req.done.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            # Admit while there are free slots and queued requests.
+            admitted_any = False
+            for ix in range(self.paged.max_slots):
+                if self._slots[ix] is not None:
+                    continue
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                try:
+                    if not self._admit(req, ix):
+                        self._queue.put(req)     # no KV blocks yet
+                        break
+                    admitted_any = True
+                except BaseException as exc:     # surface to the caller
+                    req.error = exc
+                    req.done.set()
+
+            active = [ix for ix, s in enumerate(self._slots) if s is not None]
+            if not active:
+                if not admitted_any:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                continue
+
+            try:
+                self._rng, rng = jax.random.split(self._rng)
+                nxt, self.pool = self._decode_step()(
+                    self.params, self.pool, jnp.asarray(self._tables),
+                    jnp.asarray(self._pos), jnp.asarray(self._cur),
+                    jnp.asarray(self._temps), rng)
+                nxt = np.asarray(jax.block_until_ready(nxt))
+            except BaseException as exc:
+                # A dead tick must not become a dead scheduler: fail the
+                # in-flight requests and keep serving new ones.
+                for ix in active:
+                    self._fail_slot(ix, exc)
+                continue
+
+            for ix in active:
+                slot = self._slots[ix]
+                tok = int(nxt[ix])
+                slot.tokens.append(tok)
+                self._pos[ix] += 1
+                self._cur[ix] = tok
+                hit_cap = len(slot.tokens) >= slot.budget
+                hit_end = (tok == self.tokenizer.eos_id
+                           or self._pos[ix] >= self.cfg.max_seq_len - 1)
+                if hit_cap or hit_end:
+                    self._finish(ix)
+
+    # -- public surface (InferenceEngine parity) ---------------------------
+
+    def start(self) -> None:
+        with self._lifecycle:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name=f"batcher-{self.tier.name}")
+            self._thread.start()
+
+    def stop(self) -> None:
+        """Join the loop, then fail anything still in flight or queued so
+        no caller is left blocked on done.wait()."""
+        with self._lifecycle:
+            if self._thread is not None:
+                self._stop.set()
+                self._wake.set()
+                self._thread.join(timeout=5)
+                self._thread = None
+            shutdown = RuntimeError(f"tier {self.tier.name}: engine stopped")
+            for ix, slot in enumerate(self._slots):
+                if slot is not None:
+                    self._fail_slot(ix, shutdown)
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                req.error = shutdown
+                req.done.set()
+
+    def submit(self, history: History,
+               max_new_tokens: Optional[int] = None,
+               temperature: Optional[float] = None) -> _Request:
+        self.start()
+        req = _Request(history=history, max_new_tokens=max_new_tokens,
+                       temperature=temperature)
+        self._queue.put(req)
+        self._wake.set()
+        return req
+
+    def generate(self, history: History,
+                 max_new_tokens: Optional[int] = None,
+                 temperature: Optional[float] = None) -> GenerationResult:
+        req = self.submit(history, max_new_tokens, temperature)
+        req.done.wait()
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def warmup(self) -> None:
+        self.generate("warmup", max_new_tokens=2)

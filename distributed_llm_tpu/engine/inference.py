@@ -54,6 +54,53 @@ class GenerationResult:
         return 1000.0 * self.gen_tokens / self.total_ms
 
 
+def pick_bucket(buckets: Sequence[int], n: int, max_seq: int) -> int:
+    """Smallest configured prefill bucket holding ``n`` tokens (capped at
+    the model's max_seq_len)."""
+    for b in buckets:
+        if n <= b and b <= max_seq:
+            return b
+    return min(max(buckets), max_seq)
+
+
+def prepare_prompt(tokenizer: ByteTokenizer, history, buckets: Sequence[int],
+                   max_seq: int, reserve: int) -> Tuple[List[int], int]:
+    """Tokenize + tail-truncate a prompt and pick its bucket.
+
+    ``reserve`` tokens are kept free for generation; overlong prompts keep
+    their TAIL (most recent turns), mirroring the reference's silent
+    context truncation (SURVEY.md §5.7).
+    """
+    ids = tokenizer.encode_history(history)
+    max_prompt = max_seq - reserve
+    if len(ids) > max_prompt:
+        ids = ids[-max_prompt:]
+    bucket = pick_bucket(buckets, len(ids), max_seq)
+    if len(ids) > bucket:
+        ids = ids[-bucket:]
+    return ids, bucket
+
+
+def trim_at_eos(tokens: Sequence[int], eos_id: int, pad_id: int) -> List[int]:
+    """Generated ids up to (excluding) the first EOS/PAD."""
+    out: List[int] = []
+    for t in tokens:
+        if t in (eos_id, pad_id):
+            break
+        out.append(int(t))
+    return out
+
+
+def upgrade_attention_impl(cfg, mesh) -> Any:
+    """Unsharded tiers on TPU upgrade "auto" attention to the Pallas flash
+    kernels; sharded meshes stay on the GSPMD-partitionable XLA path (a
+    pallas_call has no sharding rule — see ops/attention.py)."""
+    if (cfg.attention_impl == "auto" and mesh is None
+            and jax.default_backend() == "tpu"):
+        return dataclasses.replace(cfg, attention_impl="pallas")
+    return cfg
+
+
 class InferenceEngine:
     """Single-tier engine: one model, one (sub)mesh, synchronous generate().
 
@@ -70,13 +117,7 @@ class InferenceEngine:
         devices: Optional[Sequence[jax.Device]] = None,
     ):
         self.tier = tier
-        self.cfg = tier.model()
-        # Unsharded tiers on TPU upgrade "auto" attention to the Pallas
-        # flash kernels; sharded meshes stay on the GSPMD-partitionable XLA
-        # path (a pallas_call has no sharding rule — see ops/attention.py).
-        if (self.cfg.attention_impl == "auto" and mesh is None
-                and jax.default_backend() == "tpu"):
-            self.cfg = dataclasses.replace(self.cfg, attention_impl="pallas")
+        self.cfg = upgrade_attention_impl(tier.model(), mesh)
         self.tokenizer = ByteTokenizer()
         self.mesh = mesh
         self._rng = jax.random.PRNGKey(seed ^ 0x5EED)
@@ -189,12 +230,6 @@ class InferenceEngine:
 
     # -- host orchestration ------------------------------------------------
 
-    def _pick_bucket(self, n: int) -> int:
-        for b in self.tier.prefill_buckets:
-            if n <= b and b <= self._max_seq:
-                return b
-        return min(max(self.tier.prefill_buckets), self._max_seq)
-
     def generate(
         self,
         history: Union[str, Sequence[Dict[str, Any]]],
@@ -210,17 +245,9 @@ class InferenceEngine:
         both are runtime operands — no recompilation.
         """
         t0 = time.perf_counter()
-        ids = self.tokenizer.encode_history(history)
-
-        # Budget: prompt must leave room to generate; keep the TAIL (most
-        # recent turns) like the reference's silent context truncation.
-        max_prompt = self._max_seq - self.tier.max_new_tokens
-        if len(ids) > max_prompt:
-            ids = ids[-max_prompt:]
-        bucket = self._pick_bucket(len(ids))
-        if len(ids) > bucket:
-            ids = ids[-bucket:]
-
+        ids, bucket = prepare_prompt(self.tokenizer, history,
+                                     self.tier.prefill_buckets, self._max_seq,
+                                     self.tier.max_new_tokens)
         n = len(ids)
         tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
         tokens[0, :n] = ids
@@ -244,12 +271,8 @@ class InferenceEngine:
         out = np.asarray(jax.block_until_ready(out))[0]
         total_ms = (time.perf_counter() - t0) * 1000.0
 
-        # Trim at EOS / padding
-        gen_ids: List[int] = []
-        for t in out.tolist()[:budget]:
-            if t == self.tokenizer.eos_id or t == self.tokenizer.pad_id:
-                break
-            gen_ids.append(t)
+        gen_ids = trim_at_eos(out.tolist()[:budget], self.tokenizer.eos_id,
+                              self.tokenizer.pad_id)
 
         return GenerationResult(
             text=self.tokenizer.decode(gen_ids),

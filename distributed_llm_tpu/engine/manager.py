@@ -52,8 +52,20 @@ class EngineManager:
             if self._engine is not None:
                 return
             t0 = time.perf_counter()
-            engine = InferenceEngine(
-                self.tier, seed=self.seed, mesh=self.mesh, devices=self.devices)
+            if self.tier.decode_batch > 1 and self.mesh is None:
+                from .batching import ContinuousBatchingEngine
+                engine = ContinuousBatchingEngine(
+                    self.tier, seed=self.seed, devices=self.devices)
+            else:
+                if self.tier.decode_batch > 1:
+                    logger.warning(
+                        "tier %s: decode_batch=%d requested but tier is "
+                        "mesh-sharded — continuous batching is not supported "
+                        "there yet, using the sequential engine",
+                        self.tier.name, self.tier.decode_batch)
+                engine = InferenceEngine(
+                    self.tier, seed=self.seed, mesh=self.mesh,
+                    devices=self.devices)
             if self.warmup_on_start:
                 engine.warmup()
             self._engine = engine
@@ -67,6 +79,9 @@ class EngineManager:
     def stop_server(self) -> None:
         """Drop the engine; params/KV buffers are freed with it."""
         with self._lock:
+            stop = getattr(self._engine, "stop", None)
+            if callable(stop):
+                stop()                      # batching engine: join its loop
             self._engine = None
             self._started_at = None
 

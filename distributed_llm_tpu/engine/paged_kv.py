@@ -1,0 +1,164 @@
+"""Paged KV cache: block-table memory management for the decode cache.
+
+The reference's KV memory lives inside Ollama/llama.cpp, one contiguous
+context per server process (SURVEY.md §2.1); a continuous-batching engine
+needs many sequences of very different lengths resident at once, so the
+TPU-native design is vLLM-style paging adapted to XLA's static shapes:
+
+- One HBM **pool** per tier, ``[L, num_blocks, block_size, N_kv, D]``.
+- A host-side **BlockAllocator** (free list) hands fixed-size blocks to
+  slots; block 0 is reserved as a trash block that idle batch slots write
+  into, so the batched decode step needs no host-side compaction.
+- Each batch slot owns a **block table** row ``[max_blocks_per_slot]`` of
+  pool block ids; logical position ``p`` lives at
+  ``(table[p // bs], p % bs)``, so a gathered table reconstructs the
+  sequence in order and the usual ``col <= pos`` mask is the ragged mask.
+- ``decode_step_paged`` is the batched one-token forward: scatter this
+  step's K/V into the pool (write-before-attend, like the contiguous
+  path), gather each slot's logical window, and run masked decode
+  attention.  All shapes are static in (max_slots, max_blocks); occupancy
+  varies at runtime only through ``pos`` and the table contents.
+
+The transformer math (RMSNorm/RoPE/GQA/SwiGLU) is imported from
+models/transformer.py — this module only changes where K/V live.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+from ..models import transformer
+from ..ops import attention
+
+KVPool = Dict[str, jax.Array]    # {"k","v": [L, NB, bs, N_kv, D]}
+
+TRASH_BLOCK = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedConfig:
+    block_size: int = 64
+    max_slots: int = 4
+    max_seq_len: int = 2048
+
+    @property
+    def blocks_per_slot(self) -> int:
+        return -(-self.max_seq_len // self.block_size)
+
+    @property
+    def num_blocks(self) -> int:
+        # Full residency for every slot, plus the reserved trash block.
+        return self.max_slots * self.blocks_per_slot + 1
+
+
+def init_pool(cfg: ModelConfig, pcfg: PagedConfig) -> KVPool:
+    shape = (cfg.num_layers, pcfg.num_blocks, pcfg.block_size,
+             cfg.num_kv_heads, cfg.head_dim)
+    dtype = jnp.dtype(cfg.dtype)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+class BlockAllocator:
+    """Thread-safe free-list over pool blocks (block 0 never allocated)."""
+
+    def __init__(self, num_blocks: int):
+        self._free: List[int] = list(range(1, num_blocks))
+        self._lock = threading.Lock()
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        with self._lock:
+            if len(self._free) < n:
+                return None
+            got, self._free = self._free[:n], self._free[n:]
+            return got
+
+    def free(self, blocks: List[int]) -> None:
+        with self._lock:
+            self._free.extend(b for b in blocks if b != TRASH_BLOCK)
+
+    @property
+    def available(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+
+def write_prefill_blocks(pool: KVPool, blocks: jax.Array,
+                         k_all: jax.Array, v_all: jax.Array) -> KVPool:
+    """Scatter a prefilled prompt's K/V into its allocated blocks.
+
+    blocks: [nb] pool block ids; k_all/v_all: [L, S, N_kv, D] with
+    S == nb * block_size (bucketed prompts divide evenly).
+    """
+    l, s, nkv, d = k_all.shape
+    nb = blocks.shape[0]
+    bs = s // nb
+    k_blk = k_all.reshape(l, nb, bs, nkv, d)
+    v_blk = v_all.reshape(l, nb, bs, nkv, d)
+    return {"k": pool["k"].at[:, blocks].set(k_blk),
+            "v": pool["v"].at[:, blocks].set(v_blk)}
+
+
+def decode_step_paged(
+    cfg: ModelConfig,
+    params: transformer.Params,
+    token: jax.Array,          # [B] current input token per slot
+    pos: jax.Array,            # [B] its position (0-based)
+    pool: KVPool,
+    tables: jax.Array,         # [B, MB] block ids per slot
+) -> Tuple[jax.Array, KVPool]:
+    """One batched autoregressive step over paged caches.
+
+    Returns (logits [B, V] float32, updated pool).  Idle slots point their
+    whole table at the trash block; their writes land there and their
+    logits are ignored by the scheduler.
+    """
+    b = token.shape[0]
+    d = cfg.head_dim
+    bs = pool["k"].shape[2]
+    mb = tables.shape[1]
+
+    x = params["embed"][token]                         # [B, H]
+    sin, cos = transformer.rope_sincos(pos, d, cfg.rope_theta)
+
+    blk = jnp.take_along_axis(tables, (pos // bs)[:, None], axis=1)[:, 0]
+    off = pos % bs                                     # [B]
+    batch_ix = jnp.arange(b)
+
+    def layer(x, scanned):
+        lp, k_pool, v_pool = scanned                   # pools: [NB, bs, nkv, d]
+        h_in = transformer.rms_norm(x, lp["ln1"], cfg.norm_eps)
+        q = (h_in @ lp["wq"]).reshape(b, cfg.num_heads, d)
+        k = (h_in @ lp["wk"]).reshape(b, cfg.num_kv_heads, d)
+        v = (h_in @ lp["wv"]).reshape(b, cfg.num_kv_heads, d)
+        q = transformer.apply_rope(q, sin, cos)
+        k = transformer.apply_rope(k, sin, cos)
+
+        # Write-before-attend at (block, offset); batched scatter — active
+        # slots hit distinct blocks, idle slots collide harmlessly in trash.
+        k_pool = k_pool.at[blk, off].set(k)
+        v_pool = v_pool.at[blk, off].set(v)
+
+        # Gather this slot's logical window back in order: position p is
+        # (table[p//bs], p%bs), so reshaping the gathered blocks gives the
+        # sequence axis directly.
+        k_seq = k_pool[tables].reshape(b, mb * bs, cfg.num_kv_heads, d)
+        v_seq = v_pool[tables].reshape(b, mb * bs, cfg.num_kv_heads, d)
+        attn = attention.decode(q, k_seq, v_seq, pos, impl=cfg.attention_impl)
+
+        x = x + attn.reshape(b, cfg.num_heads * d) @ lp["wo"]
+        x = x + transformer._swiglu(
+            transformer.rms_norm(x, lp["ln2"], cfg.norm_eps),
+            lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, (k_pool, v_pool)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, (params["layers"], pool["k"], pool["v"]))
+    hidden = transformer.rms_norm(x, params["final_ln"], cfg.norm_eps)
+    return transformer.logits_from_hidden(params, hidden), \
+        {"k": k_new, "v": v_new}

@@ -141,10 +141,11 @@ def test_long_prompt_truncated_keeps_tail(engine):
 
 
 def test_bucket_selection(engine):
-    assert engine._pick_bucket(5) == 16
-    assert engine._pick_bucket(17) == 32
-    assert engine._pick_bucket(10_000) == min(
-        max(engine.tier.prefill_buckets), engine.cfg.max_seq_len)
+    from distributed_llm_tpu.engine.inference import pick_bucket
+    buckets, max_seq = engine.tier.prefill_buckets, engine.cfg.max_seq_len
+    assert pick_bucket(buckets, 5, max_seq) == 16
+    assert pick_bucket(buckets, 17, max_seq) == 32
+    assert pick_bucket(buckets, 10_000, max_seq) == min(max(buckets), max_seq)
 
 
 def test_prefill_jit_cached_per_bucket(engine):
