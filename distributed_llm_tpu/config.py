@@ -163,6 +163,9 @@ class TierConfig:
     # its paged KV pool's block granularity (engine/batching.py, paged_kv.py).
     decode_batch: int = 1
     kv_block_size: int = 64
+    # Orbax checkpoint directory to serve trained weights from; None =
+    # deterministic random init (utils/checkpoint.py load_params_for_tier).
+    checkpoint_path: Optional[str] = None
 
     def model(self) -> ModelConfig:
         return MODEL_PRESETS[self.model_preset]

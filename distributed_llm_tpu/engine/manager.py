@@ -52,10 +52,17 @@ class EngineManager:
             if self._engine is not None:
                 return
             t0 = time.perf_counter()
+            params = None
+            if self.tier.checkpoint_path:
+                from ..utils.checkpoint import load_params_for_tier
+                params = load_params_for_tier(
+                    self.tier.checkpoint_path, self.tier.model(),
+                    mesh=self.mesh, devices=self.devices)
             if self.tier.decode_batch > 1 and self.mesh is None:
                 from .batching import ContinuousBatchingEngine
                 engine = ContinuousBatchingEngine(
-                    self.tier, seed=self.seed, devices=self.devices)
+                    self.tier, seed=self.seed, devices=self.devices,
+                    params=params)
             else:
                 if self.tier.decode_batch > 1:
                     logger.warning(
@@ -65,7 +72,7 @@ class EngineManager:
                         self.tier.name, self.tier.decode_batch)
                 engine = InferenceEngine(
                     self.tier, seed=self.seed, mesh=self.mesh,
-                    devices=self.devices)
+                    devices=self.devices, params=params)
             if self.warmup_on_start:
                 engine.warmup()
             self._engine = engine

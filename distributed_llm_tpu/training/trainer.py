@@ -149,3 +149,16 @@ class Trainer:
             self.params, self.opt_state, tokens, loss_mask)
         self.step_count += 1
         return {k: float(v) for k, v in metrics.items()}
+
+    # -- checkpoint/resume (utils/checkpoint.py) ---------------------------
+
+    def save(self, path: str) -> str:
+        """Checkpoint params + optimizer state + step counter."""
+        from ..utils.checkpoint import save_train_state
+        return save_train_state(path, self)
+
+    def load(self, path: str) -> None:
+        """Resume from a checkpoint, restored onto this trainer's mesh
+        shardings (cross-mesh resume reshards at restore time)."""
+        from ..utils.checkpoint import load_train_state
+        load_train_state(path, self)
