@@ -1,0 +1,103 @@
+"""Native (C++) runtime components, bound over ctypes.
+
+The compute path is XLA; this package holds the host-side hot loops that
+warrant native code (SURVEY.md §2.1 — the reference outsources ALL native
+work to llama.cpp; here the equivalents we own live in-tree).  Currently:
+
+- ``featurizer.cc`` — hashed n-gram text features for the routing embedder
+  (runs on every routed query and semantic-cache lookup).
+
+The library auto-builds with g++ on first import (cached next to the
+source), and everything degrades to the pure-Python implementations when
+no toolchain is available or DLLM_NATIVE=0 is set — behavior is
+bit-identical either way, only speed changes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_SRC_DIR, "featurizer.cc")
+_LIB = os.path.join(_SRC_DIR, "_libdllm.so")
+_ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        logger.info("native build unavailable (%s); using Python fallback", exc)
+        return False
+    if res.returncode != 0:
+        logger.warning("native build failed:\n%s", res.stderr[-2000:])
+        return False
+    return True
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None → fallback.
+    ANY failure — missing source, stale .so without the expected symbols,
+    read-only install dir — degrades to the Python path, never raises."""
+    global _lib, _tried
+    if _tried:                    # lock-free fast path (hot per query)
+        return _lib
+    with _lock:
+        if _tried:
+            return _lib
+        lib = None
+        try:
+            if os.environ.get("DLLM_NATIVE") != "0":
+                stale = (os.path.exists(_SRC) and os.path.exists(_LIB)
+                         and os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+                if (not os.path.exists(_LIB) or stale) and not _build():
+                    raise OSError("native build unavailable")
+                lib = ctypes.CDLL(_LIB)
+                if lib.dllm_abi_version() != _ABI_VERSION:
+                    logger.warning("native ABI mismatch; rebuilding")
+                    os.unlink(_LIB)
+                    if not _build():
+                        raise OSError("rebuild failed")
+                    lib = ctypes.CDLL(_LIB)
+                lib.dllm_featurize_batch.argtypes = [
+                    ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+                    ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+        except Exception as exc:
+            logger.info("native featurizer unavailable (%s); "
+                        "using Python fallback", exc)
+            lib = None
+        _lib = lib
+        _tried = True             # published last: gates the fast path
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def featurize_batch(texts: Sequence[str], dim: int) -> Optional[np.ndarray]:
+    """[n, dim] float32 hashed-ngram features, or None if native is
+    unavailable (caller falls back to the Python implementation)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(texts)
+    out = np.zeros((n, dim), dtype=np.float32)
+    arr = (ctypes.c_char_p * n)(*[t.encode("utf-8") for t in texts])
+    lib.dllm_featurize_batch(
+        arr, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), dim)
+    return out

@@ -104,7 +104,18 @@ class HashedNgramEmbedder:
     def encode(self, texts: Sequence[str]) -> np.ndarray:
         if isinstance(texts, str):
             texts = [texts]
-        feats = np.stack([_features(t) for t in texts])
+        if not texts:
+            return np.zeros((0, self.dim), np.float32)
+        # The C++ featurizer (native/featurizer.cc) is bit-identical to
+        # _features; None means no toolchain/lib — use the Python loop.
+        # Pre-lowering on the Python side keeps Unicode case folding (which
+        # can map non-ASCII chars INTO [a-z], e.g. the Kelvin sign) and NUL
+        # handling identical across both paths.
+        from .. import native
+        normalized = [t.lower().replace("\0", " ") for t in texts]
+        feats = native.featurize_batch(normalized, FEATURE_DIM)
+        if feats is None:
+            feats = np.stack([_features(t) for t in normalized])
         return self._project(feats)
 
 

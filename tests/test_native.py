@@ -1,0 +1,96 @@
+"""Native featurizer: build, exact parity with the Python loop, fallback."""
+
+import numpy as np
+import pytest
+
+from distributed_llm_tpu import native
+from distributed_llm_tpu.routing import embedder
+
+TEXTS = [
+    "What is the capital of Japan?",
+    "what's the CAPITAL of japan",
+    "Write a thorough comparison of the Roman Republic and the Roman "
+    "Empire: institutions, military organization, and law.",
+    "Debug this: my binary search returns the wrong index (off-by-one).",
+    "",
+    "    ",
+    "it's the user's code, don't touch",
+    "numbers 123 and ids a1b2c3 survive; émigré splits on accents",
+]
+
+# Inputs whose Python/native parity needs the encode()-level normalization:
+# Unicode case folds INTO ASCII (U+212A Kelvin sign -> 'k'), and NUL bytes
+# (c_char_p truncates at NUL; Python does not).
+TRICKY = ["temperature in Kelvin", "foo\0bar baz"]
+
+
+@pytest.fixture(scope="module")
+def lib_available():
+    if not native.available():
+        pytest.skip("no C++ toolchain in this environment")
+    return True
+
+
+def test_native_matches_python_bitwise(lib_available):
+    got = native.featurize_batch(TEXTS, embedder.FEATURE_DIM)
+    want = np.stack([embedder._features(t) for t in TEXTS])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_encode_parity_on_unicode_and_nul(lib_available, monkeypatch):
+    e = embedder.HashedNgramEmbedder()
+    with_native = e.encode(TRICKY)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    without_native = e.encode(TRICKY)
+    np.testing.assert_array_equal(with_native, without_native)
+
+
+def test_encode_empty_list_works_on_both_paths(monkeypatch):
+    e = embedder.HashedNgramEmbedder()
+    assert e.encode([]).shape == (0, embedder.EMBED_DIM)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    assert e.encode([]).shape == (0, embedder.EMBED_DIM)
+
+
+def test_embedder_uses_native_and_scores_sanely(lib_available):
+    e = embedder.HashedNgramEmbedder()
+    vecs = e.encode(["what is the capital of japan",
+                     "capital of japan?",
+                     "design a 12-week marathon training plan"])
+    para = float(np.dot(vecs[0], vecs[1]))
+    unrelated = float(np.dot(vecs[0], vecs[2]))
+    assert para > 0.4
+    assert unrelated < 0.2
+
+
+def test_fallback_when_disabled(monkeypatch):
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    assert native.featurize_batch(["x"], 16) is None
+    # encode() still works through the Python loop.
+    e = embedder.HashedNgramEmbedder()
+    out = e.encode(["hello world"])
+    assert out.shape == (1, embedder.EMBED_DIM)
+
+
+def test_native_speedup_is_real(lib_available):
+    import time
+    text = ("explain the difference between a b-tree and an lsm tree for "
+            "write-heavy workloads with complexity analysis " * 20)
+    batch = [text] * 50
+
+    def best_of(fn, n=3):
+        times = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    t_native = best_of(lambda: native.featurize_batch(
+        batch, embedder.FEATURE_DIM))
+    t_py = best_of(lambda: np.stack([embedder._features(t) for t in batch]))
+    # Not a benchmark, just a sanity floor with slack for CI jitter.
+    assert t_native < t_py * 1.5
