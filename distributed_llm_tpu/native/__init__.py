@@ -37,16 +37,26 @@ _tried = False
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB]
+    # Compile to a process-unique temp file, then atomically publish:
+    # concurrent first-imports must never CDLL a half-written ELF.
+    tmp = f"{_LIB}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
     try:
         res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if res.returncode != 0:
+            logger.warning("native build failed:\n%s", res.stderr[-2000:])
+            return False
+        os.replace(tmp, _LIB)
+        return True
     except (OSError, subprocess.TimeoutExpired) as exc:
         logger.info("native build unavailable (%s); using Python fallback", exc)
         return False
-    if res.returncode != 0:
-        logger.warning("native build failed:\n%s", res.stderr[-2000:])
-        return False
-    return True
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -67,8 +77,11 @@ def _load() -> Optional[ctypes.CDLL]:
                 if (not os.path.exists(_LIB) or stale) and not _build():
                     raise OSError("native build unavailable")
                 lib = ctypes.CDLL(_LIB)
-                if lib.dllm_abi_version() != _ABI_VERSION:
-                    logger.warning("native ABI mismatch; rebuilding")
+                # A missing symbol means a corrupt or pre-ABI binary —
+                # recover exactly like a version mismatch: rebuild.
+                ver_fn = getattr(lib, "dllm_abi_version", None)
+                if ver_fn is None or ver_fn() != _ABI_VERSION:
+                    logger.warning("native ABI stale/corrupt; rebuilding")
                     os.unlink(_LIB)
                     if not _build():
                         raise OSError("rebuild failed")

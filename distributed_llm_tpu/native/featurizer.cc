@@ -14,7 +14,8 @@
 // same stopword set and weights — tests assert bit-identical vectors.
 //
 // Build: g++ -O3 -shared -fPIC -std=c++17 featurizer.cc -o _libdllm.so
-// (driven by native/build.py; pure-Python fallback when no toolchain).
+// (auto-built by native/__init__.py; pure-Python fallback when no
+// toolchain is present).
 
 #include <cstdint>
 #include <cstring>

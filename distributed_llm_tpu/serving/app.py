@@ -21,11 +21,13 @@ a threaded server (SURVEY.md §5.2).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Any, Dict, List, Optional
 
 from ..config import ClusterConfig
-from ..utils.http_compat import Flask, enable_cors, jsonify, request
+from ..utils.http_compat import (Flask, enable_cors, jsonify, request,
+                                 static_response)
 from .router import Router
 
 logger = logging.getLogger(__name__)
@@ -128,6 +130,36 @@ def create_app(router: Optional[Router] = None,
                 "cache_hit": False,
                 "tokens": 0,
             }), 500
+
+    # -- frontend (reference: fyp-chat-frontend, served here dependency-
+    # free — same /chat contract, so the original React app also works
+    # pointed at this server) --------------------------------------------
+    frontend_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "frontend")
+    ui_files = {                  # fixed allowlist: no path traversal
+        "/ui": ("index.html", "text/html; charset=utf-8"),
+        "/ui/app.js": ("app.js", "application/javascript; charset=utf-8"),
+        "/ui/style.css": ("style.css", "text/css; charset=utf-8"),
+    }
+
+    def _serve_ui(route: str):
+        fname, ctype = ui_files[route]
+        path = os.path.join(frontend_dir, fname)
+        if not os.path.exists(path):
+            return jsonify({"error": "frontend not bundled"}), 404
+        with open(path, "rb") as f:
+            return static_response(f.read(), ctype)
+
+    def _make_ui_view(route: str):
+        def view():
+            return _serve_ui(route)
+        # Distinct names: real Flask derives its endpoint from __name__.
+        view.__name__ = "ui_" + ui_files[route][0].replace(".", "_")
+        return view
+
+    for route in ui_files:
+        app.route(route, methods=["GET"])(_make_ui_view(route))
 
     @app.route("/history", methods=["GET"])
     def get_history():

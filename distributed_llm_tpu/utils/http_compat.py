@@ -15,6 +15,16 @@ except ImportError:
     HAVE_FLASK = False
 
 
+def static_response(body: bytes, content_type: str):
+    """A raw-body response with an explicit content type, on either
+    backend (used to serve the frontend files)."""
+    if HAVE_FLASK:
+        from flask import Response
+        return Response(body, mimetype=content_type)
+    from .webapp import Response
+    return Response(body, 200, content_type)
+
+
 def enable_cors(app) -> None:
     """flask-cors when real Flask is present; webapp.py already sends
     Access-Control-Allow-Origin."""

@@ -1,0 +1,224 @@
+/* Chat client logic — reference parity: fyp-chat-frontend/src/App.tsx.
+ * Talks to the Flask backend (serving/app.py) over the same JSON contract;
+ * session_id is per browser tab (App.tsx:37-39 uses sessionStorage). */
+
+"use strict";
+
+const API_BASE = "";           // same origin (Flask serves /ui and /chat)
+
+// --- per-tab session id (reference: App.tsx:37-39) -------------------------
+function sessionId() {
+  let id = sessionStorage.getItem("dllm_session");
+  if (!id) {
+    id = "tab-" + Math.random().toString(36).slice(2, 10) + "-" + Date.now();
+    sessionStorage.setItem("dllm_session", id);
+  }
+  return id;
+}
+
+// --- tiny markdown renderer (replaces react-markdown) -----------------------
+function escapeHtml(s) {
+  return s.replace(/&/g, "&amp;").replace(/</g, "&lt;").replace(/>/g, "&gt;");
+}
+
+function renderMarkdown(text) {
+  const esc = escapeHtml(text);
+  const blocks = esc.split(/```/);
+  let html = "";
+  blocks.forEach(function (block, i) {
+    if (i % 2 === 1) {                       // fenced code block
+      const body = block.replace(/^[a-z]*\n/, "");
+      html += "<pre><code>" + body + "</code></pre>";
+      return;
+    }
+    let t = block
+      .replace(/`([^`]+)`/g, "<code>$1</code>")
+      .replace(/\*\*([^*]+)\*\*/g, "<strong>$1</strong>")
+      .replace(/(^|\n)### (.*)/g, "$1<h4>$2</h4>")
+      .replace(/(^|\n)## (.*)/g, "$1<h3>$2</h3>")
+      .replace(/(^|\n)[-*] (.*)/g, "$1<li>$2</li>");
+    t = t.replace(/(<li>.*<\/li>)/s, "<ul>$1</ul>");
+    html += t.replace(/\n\n/g, "<br><br>").replace(/\n/g, "<br>");
+  });
+  return html;
+}
+
+// --- DOM helpers ------------------------------------------------------------
+const $ = (sel) => document.querySelector(sel);
+const messagesEl = $("#messages");
+const inputEl = $("#input");
+const sendEl = $("#send");
+const strategyEl = $("#strategy");
+
+function el(tag, cls, html) {
+  const node = document.createElement(tag);
+  if (cls) node.className = cls;
+  if (html !== undefined) node.innerHTML = html;
+  return node;
+}
+
+function clearWelcome() {
+  const w = messagesEl.querySelector(".welcome");
+  if (w) w.remove();
+}
+
+function scrollDown() {
+  messagesEl.scrollTop = messagesEl.scrollHeight;
+}
+
+// --- message rendering (reference: ChatMessage.tsx) -------------------------
+function addUserMessage(text) {
+  clearWelcome();
+  const row = el("div", "msg user");
+  row.appendChild(el("div", "bubble", escapeHtml(text)));
+  messagesEl.appendChild(row);
+  scrollDown();
+}
+
+function metaPanel(d) {
+  // Device badge color-coded (ChatMessage.tsx:15-19), cache-hit badge
+  // (67-73), method/confidence/tokens (78-84), reasoning (87-91).
+  const conf = d.confidence !== undefined
+    ? Math.round(d.confidence * 100) + "%" : "—";
+  let html = "<span class='badge device-" + escapeHtml(d.device || "na") +
+    "'>" + escapeHtml((d.device || "n/a").toUpperCase()) + "</span>";
+  if (d.cache_hit) html += "<span class='badge cache'>cache hit</span>";
+  html += "<span class='kv'>method <b>" + escapeHtml(d.method || "—") +
+    "</b></span>";
+  html += "<span class='kv'>confidence <b>" + conf + "</b></span>";
+  html += "<span class='kv'>tokens <b>" + (d.tokens ?? "—") + "</b></span>";
+  const panel = el("div", "meta", html);
+  if (d.reasoning) {
+    panel.appendChild(el("div", "reasoning", escapeHtml(d.reasoning)));
+  }
+  return panel;
+}
+
+function addBotMessage(d) {
+  const row = el("div", "msg bot");
+  const bubble = el("div", "bubble");
+  bubble.appendChild(el("div", "reply", renderMarkdown(d.reply || "")));
+  bubble.appendChild(metaPanel(d));
+  row.appendChild(bubble);
+  messagesEl.appendChild(row);
+  scrollDown();
+}
+
+function addErrorMessage(text) {
+  const row = el("div", "msg bot");
+  row.appendChild(el("div", "bubble error", escapeHtml(text)));
+  messagesEl.appendChild(row);
+  scrollDown();
+}
+
+// typing dots (reference: TypingIndicator.tsx)
+function addTyping() {
+  const row = el("div", "msg bot typing-row");
+  row.appendChild(el("div", "bubble typing",
+    "<span></span><span></span><span></span>"));
+  messagesEl.appendChild(row);
+  scrollDown();
+  return row;
+}
+
+// --- send flow (reference: App.tsx:100-110) ---------------------------------
+let busy = false;
+
+async function send(text) {
+  if (busy || !text.trim()) return;
+  busy = true;
+  sendEl.disabled = true;
+  addUserMessage(text);
+  const typing = addTyping();
+  try {
+    const res = await fetch(API_BASE + "/chat", {
+      method: "POST",
+      headers: { "Content-Type": "application/json" },
+      body: JSON.stringify({
+        message: text,
+        strategy: strategyEl.value,
+        session_id: sessionId(),
+      }),
+    });
+    const data = await res.json();
+    typing.remove();
+    if (!res.ok) {
+      addErrorMessage(data.reply || data.error || ("HTTP " + res.status));
+    } else {
+      addBotMessage(data);
+    }
+  } catch (err) {
+    typing.remove();
+    addErrorMessage("Network error: " + err.message);
+  } finally {
+    busy = false;
+    sendEl.disabled = !inputEl.value.trim();
+  }
+}
+
+// --- wiring -----------------------------------------------------------------
+$("#composer").addEventListener("submit", function (e) {
+  e.preventDefault();
+  const text = inputEl.value;
+  inputEl.value = "";
+  autosize();
+  send(text);
+});
+
+inputEl.addEventListener("input", function () {
+  sendEl.disabled = busy || !inputEl.value.trim();
+  autosize();
+});
+
+inputEl.addEventListener("keydown", function (e) {
+  if (e.key === "Enter" && !e.shiftKey) {
+    e.preventDefault();
+    $("#composer").requestSubmit();
+  }
+});
+
+function autosize() {
+  inputEl.style.height = "auto";
+  inputEl.style.height = Math.min(inputEl.scrollHeight, 160) + "px";
+}
+
+messagesEl.addEventListener("click", function (e) {
+  if (e.target.classList.contains("sample")) send(e.target.textContent.trim());
+});
+
+strategyEl.addEventListener("change", function () {
+  // perf-mode info banner (reference: App.tsx:208-215)
+  $("#perf-banner").classList.toggle("hidden", strategyEl.value !== "perf");
+});
+
+$("#clear").addEventListener("click", async function () {
+  await fetch(API_BASE + "/history?session_id=" + sessionId(),
+              { method: "DELETE" }).catch(function () {});
+  messagesEl.innerHTML = "";
+  messagesEl.appendChild(el("div", "welcome",
+    "<h2>Conversation cleared</h2><p>Ask something new.</p>"));
+});
+
+$("#theme").addEventListener("click", function () {
+  const dark = document.body.classList.toggle("dark");
+  localStorage.setItem("dllm_theme", dark ? "dark" : "light");
+});
+
+if (localStorage.getItem("dllm_theme") === "dark") {
+  document.body.classList.add("dark");
+}
+
+// Restore this tab's history on reload (GET /history).
+(async function restore() {
+  try {
+    const res = await fetch(API_BASE + "/history?session_id=" + sessionId());
+    const hist = await res.json();
+    if (Array.isArray(hist) && hist.length) {
+      clearWelcome();
+      hist.forEach(function (m) {
+        if (m.role === "user") addUserMessage(m.content);
+        else addBotMessage({ reply: m.content, device: "history" });
+      });
+    }
+  } catch (err) { /* backend not up yet — welcome screen stays */ }
+})();

@@ -310,3 +310,25 @@ def test_cors_preflight(client):
     rv = client.open("/chat", method="OPTIONS")
     assert rv.status_code == 204
     assert "POST" in rv.allow_methods
+
+
+# -- frontend serving (frontend/ static app over the /chat contract) --------
+
+def test_ui_routes_served_with_content_types(cluster):
+    app = create_app(router=make_router(cluster))
+    c = app.test_client()
+    page = c.get("/ui")
+    assert page.status_code == 200
+    assert "text/html" in page.content_type
+    assert "Medibot" in page.text and "app.js" in page.text
+
+    js = c.get("/ui/app.js")
+    assert js.status_code == 200
+    assert "javascript" in js.content_type
+    # The client must speak the reference contract fields.
+    for field in ("session_id", "strategy", "cache_hit", "confidence"):
+        assert field in js.text
+
+    css = c.get("/ui/style.css")
+    assert css.status_code == 200
+    assert "text/css" in css.content_type
