@@ -1,0 +1,179 @@
+"""Benchmark results analysis — results_analysis.ipynb as a module.
+
+Reference parity: the notebook loads final_results.csv, derives per-token
+and human-unit metrics, and plots latency / energy / avg power /
+latency-per-token / energy-per-token against the context threshold
+(results_analysis.ipynb cells 4-22).  Here the same derivations run over
+the v2 harness CSVs (bench/tester.py schemas) plus the TPU-native columns
+(req/s, p50 TTFT, decode tok/s), emit a markdown report, and optionally
+write the notebook's plot set as PNGs:
+
+  python -m distributed_llm_tpu.bench.analysis \
+      --summary-csv results.csv --per-query-csv per_query.csv \
+      --output-md report.md --plots-dir plots/
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional
+
+import pandas as pd
+
+
+def derive_metrics(df: pd.DataFrame) -> pd.DataFrame:
+    """Add the notebook's derived columns in human units (s, J, W)."""
+    out = df.copy()
+    for dev in ("nano", "orin", "overall"):
+        lat = f"{dev}_total_latency_ms"
+        en = f"{dev}_total_energy_mJ"
+        tok = f"{dev}_total_tokens"
+        if lat in out:
+            out[f"{dev}_latency_s"] = out[lat].astype(float) / 1000.0
+        if en in out:
+            out[f"{dev}_energy_J"] = out[en].astype(float) / 1000.0
+        if lat in out and tok in out:
+            toks = out[tok].astype(float)
+            out[f"{dev}_s_per_token"] = (
+                out[lat].astype(float) / 1000.0 / toks.where(toks > 0))
+        if en in out and tok in out:
+            toks = out[tok].astype(float)
+            out[f"{dev}_J_per_token"] = (
+                out[en].astype(float) / 1000.0 / toks.where(toks > 0))
+    return out
+
+
+def _fmt(v) -> str:
+    if pd.isna(v) or v == "":
+        return "—"
+    if isinstance(v, float):
+        return f"{v:.3f}"
+    return str(v)
+
+
+def markdown_report(summary: pd.DataFrame,
+                    per_query: Optional[pd.DataFrame] = None) -> str:
+    """Markdown tables: one per query set, ordered by strategy/threshold."""
+    df = derive_metrics(summary)
+    lines: List[str] = ["# Benchmark report", ""]
+
+    cols = ["strategy", "cache_mode", "token_threshold", "routing_accuracy",
+            "req_per_s", "p50_ttft_ms", "p50_latency_ms", "decode_tok_per_s",
+            "nano_latency_s", "orin_latency_s", "overall_total_tokens"]
+    cols = [c for c in cols if c in df.columns]
+
+    for qset, group in df.groupby("query_set"):
+        lines.append(f"## {qset}")
+        lines.append("")
+        lines.append("| " + " | ".join(cols) + " |")
+        lines.append("|" + "---|" * len(cols))
+        group = group.sort_values(["strategy", "cache_mode",
+                                   "token_threshold"])
+        for _, row in group.iterrows():
+            lines.append("| " + " | ".join(_fmt(row[c]) for c in cols) + " |")
+        lines.append("")
+
+    if per_query is not None and len(per_query):
+        lines.append("## Device split per strategy")
+        lines.append("")
+        pivot = (per_query.groupby(["strategy", "device_used"])
+                 .size().unstack(fill_value=0))
+        lines.append("| strategy | " +
+                     " | ".join(map(str, pivot.columns)) + " |")
+        lines.append("|" + "---|" * (len(pivot.columns) + 1))
+        for strategy, row in pivot.iterrows():
+            lines.append(f"| {strategy} | " +
+                         " | ".join(str(int(v)) for v in row) + " |")
+        lines.append("")
+
+        hot = (per_query.assign(lat=per_query["latency_ms"].astype(float))
+               .nlargest(5, "lat")[["strategy", "query_text", "device_used",
+                                    "lat"]])
+        lines.append("## Slowest queries")
+        lines.append("")
+        for _, r in hot.iterrows():
+            lines.append(f"- **{r['lat']:.0f} ms** [{r['device_used']}/"
+                         f"{r['strategy']}] {str(r['query_text'])[:90]}")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def write_plots(summary: pd.DataFrame, plots_dir: str) -> List[str]:
+    """The notebook's plot set vs token threshold + a strategy overview."""
+    import os
+
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    os.makedirs(plots_dir, exist_ok=True)
+    df = derive_metrics(summary)
+    written: List[str] = []
+
+    sweep = df[df["strategy"] == "token"]
+    metrics = [("latency_s", "total latency (s)"),
+               ("energy_J", "energy (J·proxy)"),
+               ("s_per_token", "latency per token (s)"),
+               ("J_per_token", "energy per token (J·proxy)")]
+    if len(sweep) > 1:
+        for key, label in metrics:
+            fig, ax = plt.subplots(figsize=(6, 4))
+            # One sorted line per (query set, cache mode) per device —
+            # mixing them would zigzag back across thresholds.
+            for (qset, cmode), grp in sweep.groupby(
+                    ["query_set", "cache_mode"]):
+                grp = grp.sort_values("token_threshold")
+                for dev in ("nano", "orin"):
+                    col = f"{dev}_{key}"
+                    if col in grp:
+                        ax.plot(grp["token_threshold"], grp[col], marker="o",
+                                label=f"{dev} ({qset}, cache {cmode})")
+            ax.set_xlabel("token threshold")
+            ax.set_ylabel(label)
+            ax.legend(fontsize=7)
+            ax.set_title(f"{label} vs threshold (token strategy)")
+            path = os.path.join(plots_dir, f"threshold_{key}.png")
+            fig.savefig(path, dpi=120, bbox_inches="tight")
+            plt.close(fig)
+            written.append(path)
+
+    if "req_per_s" in df.columns:
+        per_strategy = (df.assign(req_per_s=pd.to_numeric(
+            df["req_per_s"], errors="coerce"))
+            .dropna(subset=["req_per_s"])
+            .groupby("strategy").agg(req_per_s=("req_per_s", "max")))
+        if len(per_strategy) == 0:
+            return written          # header-only / failed-run CSV
+        fig, ax = plt.subplots(figsize=(6, 4))
+        per_strategy["req_per_s"].plot.bar(ax=ax)
+        ax.set_ylabel("req/s")
+        ax.set_title("throughput per routing strategy")
+        path = os.path.join(plots_dir, "req_per_s.png")
+        fig.savefig(path, dpi=120, bbox_inches="tight")
+        plt.close(fig)
+        written.append(path)
+    return written
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--summary-csv", required=True)
+    p.add_argument("--per-query-csv", default=None)
+    p.add_argument("--output-md", default="benchmark_report.md")
+    p.add_argument("--plots-dir", default=None)
+    args = p.parse_args(argv)
+
+    summary = pd.read_csv(args.summary_csv)
+    per_query = (pd.read_csv(args.per_query_csv)
+                 if args.per_query_csv else None)
+    report = markdown_report(summary, per_query)
+    with open(args.output_md, "w") as f:
+        f.write(report)
+    print(f"[done] report -> {args.output_md}")
+    if args.plots_dir:
+        for path in write_plots(summary, args.plots_dir):
+            print(f"[done] plot -> {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -133,6 +133,10 @@ class InferenceEngine:
         self._prefill_fns: Dict[int, Any] = {}
         self._decode_fn = None
         self._max_seq = self.cfg.max_seq_len
+        # Per-phase wall-time attribution (tokenize/prefill/decode/detok) —
+        # the jax.profiler-adjacent view surfaced at GET /stats (§5.1/§5.5).
+        from ..utils.telemetry import PhaseTimer
+        self.phases = PhaseTimer()
 
     # ------------------------------------------------------------------
 
@@ -245,9 +249,11 @@ class InferenceEngine:
         both are runtime operands — no recompilation.
         """
         t0 = time.perf_counter()
-        ids, bucket = prepare_prompt(self.tokenizer, history,
-                                     self.tier.prefill_buckets, self._max_seq,
-                                     self.tier.max_new_tokens)
+        with self.phases.phase("tokenize"):
+            ids, bucket = prepare_prompt(self.tokenizer, history,
+                                         self.tier.prefill_buckets,
+                                         self._max_seq,
+                                         self.tier.max_new_tokens)
         n = len(ids)
         tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
         tokens[0, :n] = ids
@@ -260,22 +266,28 @@ class InferenceEngine:
         if max_new_tokens and max_new_tokens > 0:
             budget = min(budget, max_new_tokens)
 
-        first, cache = self._prefill_fn(bucket)(
-            self.params, jnp.asarray(tokens), jnp.asarray(true_len), rng1, temp)
-        first = jax.block_until_ready(first)
+        with self.phases.phase("prefill"):
+            first, cache = self._prefill_fn(bucket)(
+                self.params, jnp.asarray(tokens), jnp.asarray(true_len),
+                rng1, temp)
+            first = jax.block_until_ready(first)
         ttft_ms = (time.perf_counter() - t0) * 1000.0
 
-        out, steps = self._decode_loop()(
-            self.params, cache, first, jnp.asarray(true_len), rng2, temp,
-            jnp.int32(budget))
-        out = np.asarray(jax.block_until_ready(out))[0]
+        with self.phases.phase("decode"):
+            out, steps = self._decode_loop()(
+                self.params, cache, first, jnp.asarray(true_len), rng2, temp,
+                jnp.int32(budget))
+            out = np.asarray(jax.block_until_ready(out))[0]
         total_ms = (time.perf_counter() - t0) * 1000.0
 
-        gen_ids = trim_at_eos(out.tolist()[:budget], self.tokenizer.eos_id,
-                              self.tokenizer.pad_id)
+        with self.phases.phase("detokenize"):
+            gen_ids = trim_at_eos(out.tolist()[:budget],
+                                  self.tokenizer.eos_id,
+                                  self.tokenizer.pad_id)
+            text = self.tokenizer.decode(gen_ids)
 
         return GenerationResult(
-            text=self.tokenizer.decode(gen_ids),
+            text=text,
             token_ids=gen_ids,
             prompt_tokens=n,
             gen_tokens=len(gen_ids),
@@ -285,4 +297,8 @@ class InferenceEngine:
 
     def warmup(self) -> None:
         """Compile the smallest prefill bucket + the decode loop."""
+        from ..utils.telemetry import PhaseTimer
         self.generate("warmup", max_new_tokens=1)
+        # Compile time lands in the warmup call's phases; reset so /stats
+        # attribution reflects steady-state serving only.
+        self.phases = PhaseTimer()

@@ -161,6 +161,35 @@ def create_app(router: Optional[Router] = None,
     for route in ui_files:
         app.route(route, methods=["GET"])(_make_ui_view(route))
 
+    @app.route("/stats", methods=["GET"])
+    def stats():
+        """Observability snapshot (SURVEY.md §5.5): routing-cache health,
+        per-tier engine state + phase timings, device memory."""
+        from ..utils.telemetry import device_memory_snapshot
+        with state_lock:
+            router_ = state["router"]
+            strategy = state["strategy"]
+            sessions = len(state["histories"])
+        tiers = {}
+        for name, tier in router_.tiers.items():
+            mgr = tier.server_manager
+            entry = dict(mgr.health())
+            engine = mgr._engine          # peek without lazy-starting it
+            if engine is not None and hasattr(engine, "phases"):
+                entry["phases"] = engine.phases.summary()
+            tiers[name] = entry
+        try:
+            cache_stats = router_.query_router.get_cache_stats()
+        except Exception:
+            cache_stats = None
+        return jsonify({
+            "strategy": strategy,
+            "sessions": sessions,
+            "cache": cache_stats,
+            "tiers": tiers,
+            "devices": device_memory_snapshot(),
+        })
+
     @app.route("/history", methods=["GET"])
     def get_history():
         session_id = request.args.get("session_id", "default")

@@ -37,7 +37,10 @@ function renderMarkdown(text) {
       .replace(/(^|\n)### (.*)/g, "$1<h4>$2</h4>")
       .replace(/(^|\n)## (.*)/g, "$1<h3>$2</h3>")
       .replace(/(^|\n)[-*] (.*)/g, "$1<li>$2</li>");
-    t = t.replace(/(<li>.*<\/li>)/s, "<ul>$1</ul>");
+    // Wrap each CONTIGUOUS run of <li> in its own <ul> (a greedy wrap
+    // would swallow paragraphs between separate lists).
+    t = t.replace(/<li>.*?<\/li>(?:\n<li>.*?<\/li>)*/g,
+                  (run) => "<ul>" + run + "</ul>");
     html += t.replace(/\n\n/g, "<br><br>").replace(/\n/g, "<br>");
   });
   return html;

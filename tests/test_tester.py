@@ -86,3 +86,50 @@ def test_legacy_tester_writes_v1_schema(tmp_path):
     assert rows[0] == HEADERS
     assert len(rows) == 2
     assert rows[1][0] == "personal_health"
+
+
+# -- analysis tooling (results_analysis.ipynb equivalent) --------------------
+
+def test_analysis_report_and_plots(tmp_path):
+    out_summary = tmp_path / "summary.csv"
+    out_perq = tmp_path / "per_query.csv"
+    items = tester.normalize_query_set(query_sets["general_knowledge"][:2])
+    cfg = tester.RunConfig(
+        query_set_name="general_knowledge",
+        thresholds=[100, 1000], strategies=["token"],
+        cache_modes=["off"], fixed_threshold_for_non_token=1000,
+        output_csv=str(out_summary), output_per_query_csv=str(out_perq),
+        telemetry=False)
+    tester.run_experiment(items, cfg)
+
+    from distributed_llm_tpu.bench import analysis
+    md = tmp_path / "report.md"
+    plots = tmp_path / "plots"
+    analysis.main(["--summary-csv", str(out_summary),
+                   "--per-query-csv", str(out_perq),
+                   "--output-md", str(md), "--plots-dir", str(plots)])
+    text = md.read_text()
+    assert "# Benchmark report" in text
+    assert "general_knowledge" in text
+    assert "Slowest queries" in text
+    pngs = list(plots.glob("*.png"))
+    assert pngs, "expected at least one plot"
+
+
+def test_stats_endpoint_exposes_phases_and_cache():
+    from distributed_llm_tpu.serving.app import create_app
+    app = create_app()
+    c = app.test_client()
+    c.post("/chat", json={"message": "hello", "strategy": "heuristic",
+                          "session_id": "s-stats"})
+    r = c.get("/stats")
+    assert r.status_code == 200
+    d = r.get_json()
+    assert d["strategy"] == "heuristic"
+    assert d["sessions"] == 1
+    assert set(d["tiers"]) == {"nano", "orin"}
+    used = [t for t in d["tiers"].values() if t.get("phases")]
+    assert used, "at least one tier should have phase timings"
+    phases = used[0]["phases"]
+    assert {"tokenize", "prefill", "decode"} <= set(phases)
+    assert len(d["devices"]) == 8
