@@ -1,0 +1,215 @@
+"""Health monitor: liveness probing, ICI health allgather, tier failover.
+
+Reference parity + TPU upgrade (SURVEY.md §5.3).  The reference's failure
+detection is per-call: a TCP probe + /health poll at bootstrap
+(src/models/server_manager.py:20-32,120-134), lazy restart in every
+``.process()`` (src/models/nano.py:19-21), and failover on error-shaped
+responses (src/router.py:277-282).  All of that survives in TierClient /
+EngineManager / Router.  This module adds the pieces a chip-tier deployment
+needs on top:
+
+- **Background liveness probing** of every tier at a fixed cadence (the
+  reference only probed at bootstrap) with automatic engine restart after
+  ``max_consecutive_failures`` — the ServerManager self-healing made
+  continuous instead of per-request.  A tier that is merely *stopped*
+  (lazy, or deliberately shut down between benchmark configs) is reported
+  as "stopped", not failed: only a tier that was seen running and then
+  went unhealthy counts toward restart.
+- **Cross-host health allgather** (the north star's "perf health signals
+  allgathered over ICI"): every mesh participant contributes its local
+  perf-window summary row; rows owned by OTHER processes (judged by each
+  mesh device's ``process_index``) are folded into the local PerfStrategy
+  via ``merge_remote`` (routing/strategies.py).  On a single-process mesh
+  every row is local, so nothing is merged — the exchange is a true
+  identity, never an echo of our own samples.
+- **Snapshot API** feeding GET /stats.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..parallel.collectives import allgather_health, summarize_perf_window
+
+logger = logging.getLogger(__name__)
+
+
+class HealthMonitor:
+    """Periodically probes tiers, restarts engines that went unhealthy, and
+    (when a mesh is given) merges cross-host perf summaries into the
+    router's perf strategy."""
+
+    def __init__(
+        self,
+        router,                              # serving.router.Router
+        interval_s: float = 5.0,
+        max_consecutive_failures: int = 3,
+        mesh=None,                           # jax Mesh for the allgather
+        auto_restart: bool = True,
+    ):
+        self.router = router
+        self.interval_s = interval_s
+        self.max_failures = max_consecutive_failures
+        self.mesh = mesh
+        self.auto_restart = auto_restart
+        self._fail_counts: Dict[str, int] = {}
+        self._seen_running: Dict[str, bool] = {}
+        self._last: Dict[str, Dict[str, Any]] = {}
+        self._restarts: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- probing -----------------------------------------------------------
+
+    def _probe_tier(self, name: str, mgr) -> Tuple[str, Dict[str, Any]]:
+        """-> (state, health): state ∈ {running, stopped, failed}."""
+        try:
+            running = mgr.is_server_running()
+            health = mgr.health()
+        except Exception as exc:
+            return "failed", {"ok": False, "error": str(exc)}
+        if not running:
+            return "stopped", health
+        # Running but unhealthy (e.g. a batching engine whose scheduler
+        # thread died) counts as failed.
+        engine = getattr(mgr, "_engine", None)
+        loop_dead = (engine is not None
+                     and getattr(engine, "_thread", True) is None
+                     and hasattr(engine, "submit"))
+        if not health.get("ok") or loop_dead:
+            return "failed", {**health, "ok": False}
+        return "running", health
+
+    def probe_once(self) -> Dict[str, Dict[str, Any]]:
+        """One liveness pass.  Restarts (outside the lock — it can compile
+        for tens of seconds) only tiers that were seen running and then
+        failed ``max_consecutive_failures`` probes in a row."""
+        snapshot: Dict[str, Dict[str, Any]] = {}
+        to_restart: List[Tuple[str, Any]] = []
+
+        for name, tier in self.router.tiers.items():
+            mgr = tier.server_manager
+            state, health = self._probe_tier(name, mgr)
+            with self._lock:
+                if state == "running":
+                    self._fail_counts[name] = 0
+                    self._seen_running[name] = True
+                elif state == "failed" and self._seen_running.get(name):
+                    self._fail_counts[name] = self._fail_counts.get(name, 0) + 1
+                    if (self.auto_restart
+                            and self._fail_counts[name] >= self.max_failures):
+                        to_restart.append((name, mgr))
+                entry = {**health, "state": state,
+                         "consecutive_failures": self._fail_counts.get(name, 0),
+                         "restarts": self._restarts.get(name, 0)}
+                self._last[name] = entry
+            snapshot[name] = entry
+
+        for name, mgr in to_restart:
+            logger.warning("tier %s unhealthy after %d probes — restarting",
+                           name, self.max_failures)
+            try:
+                mgr.stop_server()
+                mgr.start_server()
+                with self._lock:
+                    self._restarts[name] = self._restarts.get(name, 0) + 1
+                    self._fail_counts[name] = 0
+                    self._last[name]["restarts"] = self._restarts[name]
+            except Exception as exc:
+                logger.error("tier %s restart failed: %s", name, exc)
+        return snapshot
+
+    # -- cross-host perf exchange ------------------------------------------
+
+    def _perf_strategy(self):
+        strategy = getattr(self.router.query_router, "router", None)
+        if strategy is not None and hasattr(strategy, "merge_remote"):
+            return strategy           # PerfStrategy only (hybrid has none)
+        return None
+
+    def _participants(self) -> Tuple[int, np.ndarray]:
+        """(row count, remote mask) along the mesh's first axis: row i is
+        remote iff the device at index i along that axis belongs to another
+        process (multi-host pod)."""
+        axis = self.mesh.axis_names[0]
+        n = self.mesh.shape[axis]
+        # Devices along the first axis, holding other axes at index 0.
+        lead = np.moveaxis(self.mesh.devices,
+                           self.mesh.axis_names.index(axis), 0)
+        lead = lead.reshape(n, -1)[:, 0]
+        me = jax.process_index()
+        remote = np.array([d.process_index != me for d in lead])
+        return n, remote
+
+    def exchange_health(self) -> Optional[Dict[str, np.ndarray]]:
+        """All-gather each tier's perf summary over the mesh; fold rows
+        owned by other processes into the local perf strategy.  Returns the
+        gathered rows per tier (None without a mesh or perf strategy)."""
+        perf = self._perf_strategy()
+        if self.mesh is None or perf is None:
+            return None
+        n, remote_mask = self._participants()
+        gathered: Dict[str, np.ndarray] = {}
+        for tier_name, samples in perf.samples.items():
+            row = summarize_perf_window(list(samples))
+            rows = np.tile(row, (n, 1))   # every participant contributes its
+            out = allgather_health(self.mesh, rows)   # own row in its slot
+            gathered[tier_name] = out
+            self._merge_gathered(perf, tier_name, out, remote_mask)
+        return gathered
+
+    @staticmethod
+    def _merge_gathered(perf, tier_name: str, rows: np.ndarray,
+                        remote_mask: Sequence[bool]) -> None:
+        """Fold REMOTE rows only (mask True) into the perf strategy as
+        representative samples."""
+        for i, row in enumerate(rows):
+            if not remote_mask[i]:
+                continue
+            lat, tok, ok_count, n_samples = row
+            n_samples = int(n_samples)
+            if n_samples <= 0:
+                continue
+            k = min(n_samples, 5)         # cap synthetic samples per host
+            mean_lat = float(lat) / n_samples
+            mean_tok = max(1, int(tok) // n_samples)
+            ok_true = round(float(ok_count) / n_samples * k)
+            samples: List[Tuple[float, int, bool]] = [
+                (mean_lat, mean_tok, j < ok_true) for j in range(k)]
+            perf.merge_remote(tier_name, samples)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.probe_once()
+                self.exchange_health()
+            except Exception:
+                logger.exception("health monitor tick failed")
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="health-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=2 * self.interval_s)
+        self._thread = None
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._last.items()}

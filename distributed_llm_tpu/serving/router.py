@@ -79,6 +79,17 @@ class Router:
         self.enable_failover = bool(self.config.get("enable_failover", True))
         self._response_store: Dict[str, Dict[str, Any]] = {}
 
+        # Continuous liveness probing + ICI health exchange (serving/
+        # health.py) — off by default to keep bench runs deterministic.
+        self.health_monitor = None
+        if self.config.get("enable_health_monitor", False):
+            from .health import HealthMonitor
+            self.health_monitor = HealthMonitor(
+                self,
+                interval_s=float(self.config.get("health_interval_s", 5.0)),
+                mesh=self.config.get("health_mesh"))
+            self.health_monitor.start()
+
     # -- back-compat (src/router.py:65-67) ---------------------------------
 
     def set_threshold(self, threshold: int) -> None:
