@@ -15,8 +15,10 @@ Baseline: the reference serves general_knowledge in 922.2 s (nano) + 176.0 s
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import statistics
+import threading
 import time
 
 # Reference throughput on the same query set (see module docstring).
@@ -26,7 +28,63 @@ STRATEGIES = ("token", "semantic", "heuristic", "hybrid", "perf")
 HISTORY_LIMIT = 10
 
 
+def concurrent_phase(cluster, n_requests: int = 12, n_sequential: int = 4,
+                     slots: int = 4, max_new: int = 32) -> dict:
+    """Continuous-batching load test: independent single-turn queries
+    submitted concurrently share one batched decode loop.  Reports the
+    concurrent rate and its speedup over the same engine serving a sample
+    of the same queries one at a time (isolates the batching win from
+    model speed).  Sized small: every batched tick is a host↔device round
+    trip, which is expensive over a tunneled chip."""
+    import sys
+
+    from distributed_llm_tpu.engine.batching import ContinuousBatchingEngine
+
+    tier = dataclasses.replace(cluster.nano, decode_batch=slots,
+                               max_new_tokens=max_new)
+    engine = ContinuousBatchingEngine(tier, seed=1)
+    try:
+        engine.warmup()
+        print("[bench] batching engine warm", file=sys.stderr, flush=True)
+        queries = [
+            f"user: question {i}: summarize fact number {i} about geography"
+            for i in range(n_requests)]
+
+        t0 = time.perf_counter()
+        for q in queries[:n_sequential]:
+            engine.generate(q)
+        sequential_rate = n_sequential / (time.perf_counter() - t0)
+        print("[bench] sequential sample done", file=sys.stderr, flush=True)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=engine.generate, args=(q,))
+                   for q in queries]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        concurrent_rate = n_requests / (time.perf_counter() - t0)
+    finally:
+        engine.stop()
+
+    return {
+        "concurrent_req_per_s": round(concurrent_rate, 3),
+        "sequential_req_per_s": round(sequential_rate, 3),
+        "batching_speedup": round(concurrent_rate / sequential_rate, 2),
+        "slots": slots,
+        "requests": n_requests,
+    }
+
+
 def run() -> dict:
+    import os
+
+    # Known-good attention path for the headline run: the Pallas decode
+    # kernel's Mosaic compile is unvalidated on this chip (tiny GQA group
+    # sublane at long S_max) and a wedged compile would eat the whole bench
+    # window.  Export DLLM_ATTENTION=pallas to A/B the kernels explicitly.
+    os.environ.setdefault("DLLM_ATTENTION", "xla")
+
     import jax
     from distributed_llm_tpu.bench.query_sets import query_sets
     from distributed_llm_tpu.serving.router import Router
@@ -47,6 +105,8 @@ def run() -> dict:
         tier.server_manager.start_server()
 
     for strategy in STRATEGIES:
+        import sys
+        print(f"[bench] strategy {strategy}", file=sys.stderr, flush=True)
         router.query_router.change_strategy(strategy)
         history = []
         s_lat, s_ttft, s_correct = [], [], 0
@@ -78,6 +138,14 @@ def run() -> dict:
             "routing_accuracy": round(s_correct / len(queries), 3),
         }
 
+    # Free the sweep engines' HBM before the load test spins up its pool.
+    for tier in router.tiers.values():
+        tier.server_manager.stop_server()
+    try:
+        batching = concurrent_phase(router.cluster)
+    except Exception as exc:              # never lose the headline line
+        batching = {"error": str(exc)[:200]}
+
     req_per_s = n_queries / total_s
     return {
         "metric": "req_per_s_general_knowledge_all_strategies",
@@ -91,6 +159,7 @@ def run() -> dict:
         "backend": backend,
         "queries": n_queries,
         "per_strategy": per_strategy,
+        "continuous_batching": batching,
     }
 
 

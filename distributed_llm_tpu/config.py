@@ -91,6 +91,11 @@ class ModelConfig:
     # engines upgrade auto to the Pallas flash kernels on TPU
     # (engine/inference.py, ops/attention.py resolve_impl).
     attention_impl: str = "auto"
+    # Mixture-of-Experts (models/moe.py): >1 replaces the dense FFN with
+    # top-2 routed experts sharded over the mesh's 'ep' axis.
+    num_experts: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -130,6 +135,14 @@ MODEL_PRESETS: Dict[str, ModelConfig] = {
     "nano_test": ModelConfig(
         name="nano_test", hidden_size=64, num_layers=2, num_heads=4,
         num_kv_heads=2, ffn_size=128, max_seq_len=256,
+    ),
+    "moe_test": ModelConfig(
+        name="moe_test", hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, ffn_size=128, max_seq_len=256, num_experts=4,
+    ),
+    "moe_8x1b": ModelConfig(
+        name="moe_8x1b", hidden_size=2048, num_layers=16, num_heads=32,
+        num_kv_heads=8, ffn_size=8192, max_seq_len=8192, num_experts=8,
     ),
     "orin_test": ModelConfig(
         name="orin_test", hidden_size=128, num_layers=2, num_heads=8,
@@ -203,9 +216,11 @@ def tiny_cluster() -> ClusterConfig:
     """Tiny cluster for CPU unit tests (8 virtual devices: 1 + 4 used)."""
     return ClusterConfig(
         nano=TierConfig(name="nano", model_preset="nano_test", tp=1,
-                        max_new_tokens=8, prefill_buckets=(16, 32, 64)),
+                        max_new_tokens=8, prefill_buckets=(16, 32, 64),
+                        kv_block_size=16),
         orin=TierConfig(name="orin", model_preset="orin_test", tp=4,
-                        max_new_tokens=8, prefill_buckets=(16, 32, 64)),
+                        max_new_tokens=8, prefill_buckets=(16, 32, 64),
+                        kv_block_size=16),
     )
 
 

@@ -89,6 +89,10 @@ class ContinuousBatchingEngine:
                 "use InferenceEngine for tensor-sharded meshes")
         self.tier = tier
         self.cfg = upgrade_attention_impl(tier.model(), mesh)
+        if self.cfg.num_experts > 1:
+            raise NotImplementedError(
+                "continuous batching currently serves dense models; "
+                "MoE tiers use the sequential InferenceEngine")
         bad = [b for b in tier.prefill_buckets if b % tier.kv_block_size]
         if bad:
             raise ValueError(

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import TierConfig
+from .. import models
 from ..models import transformer
 from ..ops.sampling import sample_token_dynamic
 from .tokenizer import ByteTokenizer
@@ -141,15 +142,15 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def _init_params(self, seed: int) -> Dict[str, Any]:
-        init = jax.jit(partial(transformer.init_params, self.cfg),
+        init = jax.jit(partial(models.init_params, self.cfg),
                        static_argnames=("seed",))
         if self.mesh is not None:
             from ..parallel.sharding import param_shardings
             shardings = param_shardings(self.cfg, self.mesh)
-            init = jax.jit(partial(transformer.init_params, self.cfg),
+            init = jax.jit(partial(models.init_params, self.cfg),
                            static_argnames=("seed",), out_shardings=shardings)
         elif self.devices:
-            init = jax.jit(partial(transformer.init_params, self.cfg),
+            init = jax.jit(partial(models.init_params, self.cfg),
                            static_argnames=("seed",),
                            out_shardings=jax.sharding.SingleDeviceSharding(self.devices[0]))
         return init(seed=seed)
@@ -167,7 +168,7 @@ class InferenceEngine:
         def run(params, tokens, true_len, rng, temperature):
             b, s = tokens.shape
             positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
-            hidden, (k_all, v_all) = transformer.prefill(cfg, params, tokens, positions)
+            hidden, (k_all, v_all) = models.serving_prefill(cfg, params, tokens, positions)
             # logits only at each sequence's last real position
             last = hidden[jnp.arange(b), true_len - 1]
             logits = transformer.logits_from_hidden(params, last)
@@ -214,7 +215,8 @@ class InferenceEngine:
                 step, out, cache, done, rng = state
                 cur = out[:, step - 1]
                 pos = prompt_len + step - 1       # position of `cur`
-                logits, cache = transformer.decode_step(cfg, params, cur, pos, cache)
+                logits, cache = models.model_module(cfg).decode_step(
+                    cfg, params, cur, pos, cache)
                 rng, sub = jax.random.split(rng)
                 nxt = sample_token_dynamic(logits, sub, temperature)
                 nxt = jnp.where(done, pad, nxt)

@@ -1,0 +1,28 @@
+"""Model families: dense LLaMA-style (transformer.py) and MoE (moe.py).
+
+``model_module(cfg)`` dispatches on ModelConfig.num_experts so the engine,
+trainer, and checkpoint code serve either family through one surface:
+both modules expose ``init_params(cfg, seed)``, ``prefill`` (MoE returns an
+extra aux-loss scalar — use ``serving_prefill`` to normalize), and
+``decode_step``; cache layout and the tied LM head live in transformer.py
+and are shared.
+"""
+
+from __future__ import annotations
+
+from ..config import ModelConfig
+from . import moe, transformer  # noqa: F401
+
+
+def model_module(cfg: ModelConfig):
+    return moe if cfg.num_experts > 1 else transformer
+
+
+def serving_prefill(cfg: ModelConfig, params, tokens, positions):
+    """(hidden, (k_all, v_all)) for either family (drops MoE aux loss)."""
+    out = model_module(cfg).prefill(cfg, params, tokens, positions)
+    return out[0], out[1]
+
+
+def init_params(cfg: ModelConfig, seed: int = 0):
+    return model_module(cfg).init_params(cfg, seed)

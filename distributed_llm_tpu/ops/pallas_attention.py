@@ -144,7 +144,7 @@ flash_causal_attention.defvjp(_flash_fwd, _flash_bwd)
 # =============================================================================
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float):
-    p = pos_ref[0]                                            # this seq's pos
+    p = pos_ref[0, 0]                     # scalars are (1,1) 2D in SMEM
     q = q_ref[0, 0].astype(jnp.float32) * scale               # [G, D]
     k = k_ref[0, 0]                                           # [S, D]
     v = v_ref[0, 0]
@@ -172,14 +172,14 @@ def flash_decode_attention(q: jax.Array, k_cache: jax.Array,
     qh = q.reshape(b, nkv, groups, d)                        # group-major
     kh = k_cache.transpose(0, 2, 1, 3)                       # [B, Nkv, S, D]
     vh = v_cache.transpose(0, 2, 1, 3)
-    pos32 = pos.astype(jnp.int32)
+    pos32 = pos.astype(jnp.int32).reshape(b, 1)              # 2D for SMEM
 
     kernel = functools.partial(_decode_kernel, scale=d ** -0.5)
     out = pl.pallas_call(
         kernel,
         grid=(b, nkv),
         in_specs=[
-            pl.BlockSpec((1,), lambda b_, h: (b_,),
+            pl.BlockSpec((1, 1), lambda b_, h: (b_, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, groups, d), lambda b_, h: (b_, h, 0, 0),
                          memory_space=pltpu.VMEM),

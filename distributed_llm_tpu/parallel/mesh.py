@@ -111,6 +111,27 @@ def training_mesh(
     return jax.sharding.Mesh(arr, ("dp", "sp", "tp"))
 
 
+def moe_training_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    *,
+    num_experts: int,
+) -> jax.sharding.Mesh:
+    """A ('dp', 'ep') mesh for MoE training: ep takes the largest divisor
+    of the device count that also divides the expert count (whole experts
+    per shard), dp absorbs the rest."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    ep = 1
+    for f in range(1, n + 1):
+        if n % f == 0 and num_experts % f == 0:
+            ep = f
+    dp = n // ep
+    arr = np.array(devices).reshape(dp, ep)
+    return jax.sharding.Mesh(arr, ("dp", "ep"))
+
+
 def describe_meshes(meshes: Dict[str, jax.sharding.Mesh]) -> str:
     parts = []
     for name, mesh in meshes.items():

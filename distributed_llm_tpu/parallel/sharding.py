@@ -22,62 +22,88 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import ModelConfig
 
 
-def param_specs(cfg: ModelConfig, tp_axis: str = "tp") -> Dict[str, Any]:
-    """PartitionSpec pytree matching transformer.init_params' structure."""
+def param_specs(cfg: ModelConfig, tp_axis: str = "tp",
+                ep_axis: str = "ep") -> Dict[str, Any]:
+    """PartitionSpec pytree matching the model family's param structure
+    (dense transformer or MoE — expert weights gain a leading [E] dim
+    sharded over the 'ep' axis)."""
     t = tp_axis
-    return {
-        "embed": P(None, None),
-        "layers": {
-            "ln1": P(None, None),
-            "wq": P(None, None, t),      # column parallel (heads)
-            "wk": P(None, None, t),
-            "wv": P(None, None, t),
-            "wo": P(None, t, None),      # row parallel
-            "ln2": P(None, None),
+    layers: Dict[str, P] = {
+        "ln1": P(None, None),
+        "wq": P(None, None, t),          # column parallel (heads)
+        "wk": P(None, None, t),
+        "wv": P(None, None, t),
+        "wo": P(None, t, None),          # row parallel
+        "ln2": P(None, None),
+    }
+    if cfg.num_experts > 1:
+        layers.update({
+            "w_router": P(None, None, None),
+            "w_gate": P(None, ep_axis, None, t),   # [L, E, H, F]
+            "w_up": P(None, ep_axis, None, t),
+            "w_down": P(None, ep_axis, t, None),   # [L, E, F, H]
+        })
+    else:
+        layers.update({
             "w_gate": P(None, None, t),  # column parallel (ffn)
             "w_up": P(None, None, t),
             "w_down": P(None, t, None),  # row parallel
-        },
+        })
+    return {
+        "embed": P(None, None),
+        "layers": layers,
         "final_ln": P(None),
     }
 
 
 def param_shardings(cfg: ModelConfig, mesh: Mesh,
                     tp_axis: str = "tp") -> Dict[str, Any]:
-    """NamedSharding pytree for placing params on a tier mesh."""
+    """NamedSharding pytree for placing params on a tier mesh.  Axes the
+    mesh doesn't have (e.g. 'ep' on a tp-only serving mesh) or that don't
+    divide their dimension fall back to replication, so MoE models serve
+    on plain tensor-parallel tiers."""
     if cfg.num_heads % mesh.shape[tp_axis] or cfg.num_kv_heads % mesh.shape[tp_axis]:
         raise ValueError(
             f"tp={mesh.shape[tp_axis]} must divide heads "
             f"({cfg.num_heads}/{cfg.num_kv_heads}) for {cfg.name}")
-    return jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec),
-        param_specs(cfg, tp_axis),
-        is_leaf=lambda x: isinstance(x, P),
-    )
+    return _shardings_with_fallback(cfg, mesh, param_specs(cfg, tp_axis))
 
 
 def train_param_specs(cfg: ModelConfig, dp_axis: str = "dp",
-                      tp_axis: str = "tp") -> Dict[str, Any]:
-    """FSDP × TP specs for training: on top of the Megatron TP rules, each
-    weight's *other* matmul dimension is sharded over the data axis
-    (ZeRO-3 style), so optimizer state and gradients scale down with dp.
+                      tp_axis: str = "tp",
+                      ep_axis: str = "ep") -> Dict[str, Any]:
+    """FSDP × TP (× EP) specs for training: on top of the Megatron TP
+    rules, each weight's *other* matmul dimension is sharded over the data
+    axis (ZeRO-3 style), so optimizer state and gradients scale down with
+    dp; MoE expert weights additionally shard their [E] dim over 'ep'.
     GSPMD inserts the all-gathers before use and reduce-scatters on grads.
     Norm vectors stay replicated (tiny).
     """
-    d, t = dp_axis, tp_axis
-    return {
-        "embed": P(d, None),
-        "layers": {
-            "ln1": P(None, None),
-            "wq": P(None, d, t),
-            "wk": P(None, d, t),
-            "wv": P(None, d, t),
-            "wo": P(None, t, d),
-            "ln2": P(None, None),
+    d, t, e = dp_axis, tp_axis, ep_axis
+    layers: Dict[str, P] = {
+        "ln1": P(None, None),
+        "wq": P(None, d, t),
+        "wk": P(None, d, t),
+        "wv": P(None, d, t),
+        "wo": P(None, t, d),
+        "ln2": P(None, None),
+    }
+    if cfg.num_experts > 1:
+        layers.update({
+            "w_router": P(None, d, None),
+            "w_gate": P(None, e, d, t),
+            "w_up": P(None, e, d, t),
+            "w_down": P(None, e, t, d),
+        })
+    else:
+        layers.update({
             "w_gate": P(None, d, t),
             "w_up": P(None, d, t),
             "w_down": P(None, t, d),
-        },
+        })
+    return {
+        "embed": P(d, None),
+        "layers": layers,
         "final_ln": P(None),
     }
 
@@ -90,9 +116,16 @@ def train_param_shardings(cfg: ModelConfig, mesh: Mesh,
     (tiny test models on wide meshes), fall back to replication — so the
     same rules serve any mesh from ('dp','sp','tp') down to a single-axis
     or single-device mesh."""
-    from ..models import transformer
-    specs = train_param_specs(cfg, dp_axis, tp_axis)
-    shapes = jax.eval_shape(lambda: transformer.init_params(cfg, seed=0))
+    return _shardings_with_fallback(cfg, mesh,
+                                    train_param_specs(cfg, dp_axis, tp_axis))
+
+
+def _shardings_with_fallback(cfg: ModelConfig, mesh: Mesh,
+                             specs: Dict[str, Any]) -> Dict[str, Any]:
+    """Map specs onto the mesh, dropping axes the mesh lacks or that don't
+    divide the dimension they shard (tiny test models on wide meshes)."""
+    from ..models import init_params
+    shapes = jax.eval_shape(lambda: init_params(cfg, seed=0))
 
     def fix(spec: P, shaped) -> NamedSharding:
         dims = shaped.shape

@@ -62,19 +62,23 @@ def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
 def lm_loss(cfg: ModelConfig, params, tokens: jax.Array,
             loss_mask: jax.Array, remat: bool = True) -> jax.Array:
     """Next-token cross-entropy.  tokens: [B, S] int32; loss_mask: [B, S]
-    (1.0 where the *target* position counts).  Accumulates in float32."""
+    (1.0 where the *target* position counts).  Accumulates in float32.
+    MoE models add their load-balance aux loss (models/moe.py)."""
+    from ..models import model_module
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
-    fwd = transformer.prefill
+    fwd = model_module(cfg).prefill
     if remat:
         fwd = jax.checkpoint(fwd, static_argnums=(0,))
-    hidden, _ = fwd(cfg, params, tokens, positions)
+    out = fwd(cfg, params, tokens, positions)
+    hidden, aux = out[0], (out[2] if len(out) > 2 else 0.0)
     logits = transformer.logits_from_hidden(params, hidden[:, :-1])  # [B,S-1,V]
     targets = tokens[:, 1:]
     mask = loss_mask[:, 1:].astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return ce + cfg.moe_aux_weight * aux
 
 
 class Trainer:
@@ -101,7 +105,8 @@ class Trainer:
         # along the missing axes.
         self._param_shardings = train_param_shardings(cfg, mesh)
 
-        init = jax.jit(partial(transformer.init_params, cfg),
+        from ..models import init_params as family_init
+        init = jax.jit(partial(family_init, cfg),
                        static_argnames=("seed",),
                        out_shardings=self._param_shardings)
         self.params = params if params is not None else init(seed=tc.seed)

@@ -34,7 +34,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from ..config import ModelConfig
-from ..models import transformer
+from ..models import init_params as family_init_params
 
 _VERSION_RE = re.compile(r"^v(\d+)$")
 
@@ -72,7 +72,7 @@ def restore_subtree(path: str, like: Dict[str, Any]) -> Dict[str, Any]:
 def abstract_params(cfg: ModelConfig, shardings: Any) -> Any:
     """ShapeDtypeStruct tree for the model's params, annotated with the
     target shardings (a matching tree or a single Sharding for all)."""
-    abstract = jax.eval_shape(lambda: transformer.init_params(cfg, seed=0))
+    abstract = jax.eval_shape(lambda: family_init_params(cfg, seed=0))
     if not isinstance(shardings, (dict,)):
         shardings = jax.tree.map(lambda _: shardings, abstract)
     return jax.tree.map(
