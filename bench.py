@@ -163,5 +163,53 @@ def run() -> dict:
     }
 
 
+def _accelerator_configured() -> bool:
+    import os
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    return bool(platforms) and platforms.lower() not in ("cpu", "")
+
+
+def _accelerator_healthy(timeout_s: int = 180) -> bool:
+    """Probe the default backend in a subprocess: a wedged chip/tunnel
+    hangs device ops indefinitely, which would eat the whole bench window.
+    The probe claims and releases the chip; on timeout/failure the bench
+    falls back to CPU so the driver still records a result.
+
+    Poll-and-abandon, NOT subprocess.run: a child stuck in an
+    uninterruptible device ioctl survives SIGKILL until the syscall
+    returns, and run()'s post-kill communicate() would block on it
+    forever — the exact hang this probe exists to dodge."""
+    import subprocess
+    import sys
+    code = ("import jax, jax.numpy as jnp;"
+            "x = jnp.ones((128, 128));"
+            "jax.jit(lambda a: a @ a)(x).block_until_ready();"
+            "print('HEALTHY')")
+    try:
+        proc = subprocess.Popen([sys.executable, "-c", code],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, text=True)
+    except OSError:
+        return False
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        ret = proc.poll()
+        if ret is not None:
+            out = proc.stdout.read() if proc.stdout else ""
+            return ret == 0 and "HEALTHY" in out
+        time.sleep(0.5)
+    proc.kill()          # best effort; do NOT wait — abandon a D-state child
+    return False
+
+
 if __name__ == "__main__":
+    import sys
+    if _accelerator_configured() and not _accelerator_healthy():
+        print("[bench] accelerator probe failed/hung — falling back to CPU",
+              file=sys.stderr, flush=True)
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
     print(json.dumps(run()))

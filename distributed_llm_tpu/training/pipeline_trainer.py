@@ -1,0 +1,151 @@
+"""Pipeline-parallel trainer for the dense transformer.
+
+Composes the generic GPipe machinery (parallel/pipeline.py) with the
+transformer layer body: the layer stack splits into ``pp`` contiguous
+stages placed along a ('pp',) mesh axis; embedding, final norm, and the
+tied LM head are replicated (they are tiny at byte-level vocab).  One
+jitted step runs microbatched forward, pipeline-parallel backward (via
+jax.grad through shard_map/ppermute), and the optax update.
+
+This is the 'pp' leg of the parallelism matrix — dp/sp/tp live in
+training/trainer.py, ep in the MoE family.  Composing pp with those axes
+is future work; the mesh here is 1-D.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import ModelConfig
+from ..models import transformer
+from ..parallel.pipeline import merge_stages, pipeline_apply, split_stages
+from .trainer import TrainConfig, make_optimizer
+
+
+def _stage_fn(cfg: ModelConfig):
+    """One pipeline stage = lax.scan over this device's layer slice
+    (the dense transformer layer body, minus KV collection)."""
+    def run(lp_stack, x, extras):
+        sin, cos = extras
+        b, s, _ = x.shape
+        d = cfg.head_dim
+
+        def layer(x, lp):
+            h_in = transformer.rms_norm(x, lp["ln1"], cfg.norm_eps)
+            q = (h_in @ lp["wq"]).reshape(b, s, cfg.num_heads, d)
+            k = (h_in @ lp["wk"]).reshape(b, s, cfg.num_kv_heads, d)
+            v = (h_in @ lp["wv"]).reshape(b, s, cfg.num_kv_heads, d)
+            q = transformer.apply_rope(q, sin, cos)
+            k = transformer.apply_rope(k, sin, cos)
+            from ..ops import attention
+            attn = attention.causal(q, k, v, impl="xla"
+                                    ).reshape(b, s, cfg.num_heads * d)
+            x = x + attn @ lp["wo"]
+            x = x + transformer._swiglu(
+                transformer.rms_norm(x, lp["ln2"], cfg.norm_eps),
+                lp["w_gate"], lp["w_up"], lp["w_down"])
+            return x, None
+
+        x, _ = jax.lax.scan(layer, x, lp_stack)
+        return x
+    return run
+
+
+def pipeline_lm_loss(cfg: ModelConfig, params: Dict[str, Any],
+                     tokens: jax.Array, loss_mask: jax.Array,
+                     mesh: Mesh, num_microbatches: int) -> jax.Array:
+    """Next-token CE with the layer stack executed as a GPipe pipeline.
+    params["layers"] leaves carry the [S, L/S, ...] stage split."""
+    b, s = tokens.shape
+    mb = b // num_microbatches
+    positions = jnp.broadcast_to(jnp.arange(s)[None], (mb, s))
+    sin, cos = transformer.rope_sincos(positions, cfg.head_dim,
+                                       cfg.rope_theta)
+
+    x = params["embed"][tokens]                        # [B, S, H]
+    mbs = x.reshape(num_microbatches, mb, s, cfg.hidden_size)
+    out = pipeline_apply(mesh, _stage_fn(cfg), params["layers"], mbs,
+                         extras=(sin, cos))
+    hidden = transformer.rms_norm(out.reshape(b, s, cfg.hidden_size),
+                                  params["final_ln"], cfg.norm_eps)
+    logits = transformer.logits_from_hidden(params, hidden[:, :-1])
+    targets = tokens[:, 1:]
+    mask = loss_mask[:, 1:].astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+class PipelineTrainer:
+    """Owns stage-split params on a ('pp',) mesh and the compiled step."""
+
+    def __init__(self, cfg: ModelConfig, tc: TrainConfig, mesh: Mesh,
+                 num_microbatches: Optional[int] = None):
+        if "pp" not in mesh.axis_names:
+            raise ValueError("PipelineTrainer needs a mesh with a 'pp' axis")
+        self.cfg = cfg
+        self.tc = tc
+        self.mesh = mesh
+        self.stages = mesh.shape["pp"]
+        self.num_microbatches = num_microbatches or max(2, self.stages)
+        if tc.batch_size % self.num_microbatches:
+            raise ValueError(
+                f"batch_size={tc.batch_size} not divisible by "
+                f"microbatches={self.num_microbatches}")
+        self.optimizer = make_optimizer(tc)
+
+        def shard(tree, spec_fn):
+            return jax.tree.map(
+                lambda x: jax.device_put(
+                    x, NamedSharding(mesh, spec_fn(x))), tree)
+
+        base = transformer.init_params(cfg, seed=tc.seed)
+        staged = {**base, "layers": split_stages(base["layers"], self.stages)}
+        self.params = {
+            "embed": shard(staged["embed"], lambda x: P()),
+            "layers": shard(staged["layers"],
+                            lambda x: P("pp", *([None] * (x.ndim - 1)))),
+            "final_ln": shard(staged["final_ln"], lambda x: P()),
+        }
+        self.opt_state = self.optimizer.init(self.params)
+        self.step_count = 0
+        self._step_fn = self._build_step()
+
+    def _build_step(self):
+        cfg, tc, mesh = self.cfg, self.tc, self.mesh
+        optimizer = self.optimizer
+        microbatches = self.num_microbatches
+
+        def step(params, opt_state, tokens, loss_mask):
+            loss, grads = jax.value_and_grad(
+                lambda p: pipeline_lm_loss(cfg, p, tokens, loss_mask, mesh,
+                                           microbatches))(params)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"loss": loss,
+                                       "grad_norm": optax.global_norm(grads)}
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def train_step(self, tokens: np.ndarray,
+                   loss_mask: Optional[np.ndarray] = None
+                   ) -> Dict[str, float]:
+        if loss_mask is None:
+            loss_mask = np.ones_like(tokens, np.float32)
+        self.params, self.opt_state, metrics = self._step_fn(
+            self.params, self.opt_state,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(loss_mask, jnp.float32))
+        self.step_count += 1
+        return {k: float(v) for k, v in metrics.items()}
+
+    def export_params(self) -> Dict[str, Any]:
+        """Standard [L, ...] layout (for serving/checkpoint interop)."""
+        return {**self.params,
+                "layers": merge_stages(self.params["layers"])}
