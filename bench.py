@@ -164,9 +164,11 @@ def run() -> dict:
 
 
 def _accelerator_configured() -> bool:
+    # Probe unless the run is EXPLICITLY pinned to CPU: with the env var
+    # unset jax may auto-detect a TPU, which is exactly the case that can
+    # wedge.  A CPU-only host pays one ~3 s subprocess for the certainty.
     import os
-    platforms = os.environ.get("JAX_PLATFORMS", "")
-    return bool(platforms) and platforms.lower() not in ("cpu", "")
+    return os.environ.get("JAX_PLATFORMS", "").lower() != "cpu"
 
 
 def _accelerator_healthy(timeout_s: int = 180) -> bool:

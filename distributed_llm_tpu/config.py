@@ -179,6 +179,10 @@ class TierConfig:
     # Orbax checkpoint directory to serve trained weights from; None =
     # deterministic random init (utils/checkpoint.py load_params_for_tier).
     checkpoint_path: Optional[str] = None
+    # Model preset to draft with for speculative decoding (greedy-exact;
+    # engine/speculative.py).  None = plain decoding.
+    draft_preset: Optional[str] = None
+    speculative_gamma: int = 4
 
     def model(self) -> ModelConfig:
         return MODEL_PRESETS[self.model_preset]

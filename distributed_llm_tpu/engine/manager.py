@@ -58,7 +58,32 @@ class EngineManager:
                 params = load_params_for_tier(
                     self.tier.checkpoint_path, self.tier.model(),
                     mesh=self.mesh, devices=self.devices)
-            if self.tier.decode_batch > 1 and self.mesh is None:
+            use_speculative = bool(self.tier.draft_preset)
+            if use_speculative and (self.mesh is not None
+                                    or self.tier.decode_batch > 1
+                                    or self.tier.temperature > 0):
+                logger.warning(
+                    "tier %s: draft_preset=%s ignored (speculative decoding "
+                    "is greedy-only and unsharded/unbatched; mesh=%s "
+                    "decode_batch=%d temperature=%s)",
+                    self.tier.name, self.tier.draft_preset,
+                    self.mesh is not None, self.tier.decode_batch,
+                    self.tier.temperature)
+                use_speculative = False
+            if use_speculative:
+                import dataclasses as _dc
+
+                from .speculative import SpeculativeEngine
+                # The draft is a fresh model: no draft-side checkpoint
+                # exists (the target's weights are a different
+                # architecture), so clear inherited paths.
+                draft = _dc.replace(self.tier, name=f"{self.tier.name}-draft",
+                                    model_preset=self.tier.draft_preset,
+                                    draft_preset=None, checkpoint_path=None)
+                engine = SpeculativeEngine(
+                    self.tier, draft, gamma=self.tier.speculative_gamma,
+                    seed=self.seed, target_params=params)
+            elif self.tier.decode_batch > 1 and self.mesh is None:
                 from .batching import ContinuousBatchingEngine
                 engine = ContinuousBatchingEngine(
                     self.tier, seed=self.seed, devices=self.devices,

@@ -1,0 +1,276 @@
+"""Speculative decoding: the nano tier drafts, the orin tier verifies.
+
+A natural extension of the reference's two-tier topology (SURVEY.md §1):
+instead of routing a query to EITHER the weak or the strong model, the
+weak model proposes ``gamma`` greedy tokens and the strong model checks
+them in ONE chunked forward — decode throughput approaches
+draft-speed × acceptance-rate while outputs remain token-identical to
+greedy decoding with the strong model alone (the classic speculative
+guarantee, trivially exact in the greedy case: accept while argmaxes
+agree, then take the target's token).
+
+TPU shape discipline: one jitted ``spec_step`` per engine — the γ-step
+draft loop (lax.scan), the target's γ+1-position verify forward, and the
+acceptance logic all run on device with static shapes; the host loop only
+counts accepted tokens.  Verification uses a chunked decode
+(multi-position query against the KV cache with a per-query position
+mask), which is also what long-prefill chunking needs.
+
+Both caches stay consistent without rollback machinery: rejected
+positions' K/V are simply overwritten by later write-before-attend steps,
+exactly like the right-padded prefill garbage (engine/inference.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import TierConfig
+from ..models import transformer
+from .inference import (GenerationResult, prepare_prompt, trim_at_eos,
+                        upgrade_attention_impl)
+from .tokenizer import ByteTokenizer
+
+
+def decode_chunk(cfg, params, tokens: jax.Array, start_pos: jax.Array,
+                 kv: transformer.KVCache
+                 ) -> Tuple[jax.Array, transformer.KVCache]:
+    """Multi-token decode: process ``tokens`` [B, G] at positions
+    [start_pos, start_pos+G) against the cache.  Returns (logits [B, G, V]
+    float32, updated cache).  Queries attend strictly to their own prefix
+    (cache cols ≤ their position; write-before-attend)."""
+    b, g = tokens.shape
+    d = cfg.head_dim
+    pos = start_pos[:, None] + jnp.arange(g)[None]            # [B, G]
+    x = params["embed"][tokens]                               # [B, G, H]
+    sin, cos = transformer.rope_sincos(pos, d, cfg.rope_theta)
+
+    def layer(x, scanned):
+        lp, k_cache, v_cache = scanned                        # [B, S, NKV, D]
+        h_in = transformer.rms_norm(x, lp["ln1"], cfg.norm_eps)
+        q = (h_in @ lp["wq"]).reshape(b, g, cfg.num_heads, d)
+        k = (h_in @ lp["wk"]).reshape(b, g, cfg.num_kv_heads, d)
+        v = (h_in @ lp["wv"]).reshape(b, g, cfg.num_kv_heads, d)
+        q = transformer.apply_rope(q, sin, cos)
+        k = transformer.apply_rope(k, sin, cos)
+
+        def write(cache, new):                                # scatter G rows
+            def one(c, n, p):
+                return jax.lax.dynamic_update_slice(c, n, (p, 0, 0))
+            return jax.vmap(one)(cache, new, start_pos)
+        k_cache = write(k_cache, k)
+        v_cache = write(v_cache, v)
+
+        # Per-query ragged mask: query g attends cols <= pos[b, g].
+        s_max = k_cache.shape[1]
+        groups = cfg.num_heads // cfg.num_kv_heads
+        k_exp = jnp.repeat(k_cache, groups, axis=2)
+        v_exp = jnp.repeat(v_cache, groups, axis=2)
+        scale = d ** -0.5
+        logits = jnp.einsum("bgnd,bknd->bngk", q, k_exp
+                            ).astype(jnp.float32) * scale
+        valid = (jnp.arange(s_max)[None, None, :] <= pos[:, :, None])
+        logits = jnp.where(valid[:, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(v_exp.dtype)
+        attn = jnp.einsum("bngk,bknd->bgnd", probs, v_exp)
+
+        x = x + attn.reshape(b, g, cfg.num_heads * d) @ lp["wo"]
+        x = x + transformer._swiglu(
+            transformer.rms_norm(x, lp["ln2"], cfg.norm_eps),
+            lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, (params["layers"], kv["k"], kv["v"]))
+    hidden = transformer.rms_norm(x, params["final_ln"], cfg.norm_eps)
+    return transformer.logits_from_hidden(params, hidden), \
+        {"k": k_new, "v": v_new}
+
+
+class SpeculativeEngine:
+    """Greedy speculative generation over a (target, draft) tier pair.
+
+    Same ``generate()/warmup()`` surface as InferenceEngine; the result's
+    text is token-identical to greedy decoding with the target alone.
+    """
+
+    def __init__(self, target: TierConfig, draft: TierConfig,
+                 gamma: int = 4, seed: int = 0,
+                 target_params: Optional[Dict[str, Any]] = None,
+                 draft_params: Optional[Dict[str, Any]] = None):
+        if target.model().vocab_size != draft.model().vocab_size:
+            raise ValueError("speculative decoding needs a shared vocab")
+        if target.temperature and target.temperature > 0:
+            raise ValueError(
+                "speculative engine is greedy-only; tier temperature "
+                f"{target.temperature} would be silently ignored")
+        self.target = target
+        self.draft = draft
+        self.cfg_t = upgrade_attention_impl(target.model(), None)
+        self.cfg_d = upgrade_attention_impl(draft.model(), None)
+        self.gamma = gamma
+        self.tokenizer = ByteTokenizer()
+        self._max_seq = min(self.cfg_t.max_seq_len, self.cfg_d.max_seq_len)
+
+        def init(cfg, params, salt):
+            if params is not None:
+                return params
+            return jax.jit(lambda: transformer.init_params(cfg, seed + salt))()
+        self.params_t = init(self.cfg_t, target_params, 0)
+        self.params_d = init(self.cfg_d, draft_params, 1)
+
+        self._prefill_fns: Dict[int, Any] = {}
+        self._spec_fn = None
+        self.accept_history: list = []
+
+    # -- compiled stages ---------------------------------------------------
+
+    def _prefill_fn(self, bucket: int):
+        """Prefill BOTH models on the prompt; target picks the first token."""
+        if bucket in self._prefill_fns:
+            return self._prefill_fns[bucket]
+        cfg_t, cfg_d, max_seq = self.cfg_t, self.cfg_d, self._max_seq
+
+        def run(params_t, params_d, tokens, true_len):
+            b, s = tokens.shape
+            positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+
+            def seed_cache(cfg, params):
+                hidden, (k_all, v_all) = transformer.prefill(
+                    cfg, params, tokens, positions)
+                cache = transformer.init_kv_cache(cfg, b, max_seq)
+                cache = {
+                    "k": jax.lax.dynamic_update_slice(
+                        cache["k"], k_all, (0, 0, 0, 0, 0)),
+                    "v": jax.lax.dynamic_update_slice(
+                        cache["v"], v_all, (0, 0, 0, 0, 0)),
+                }
+                return hidden, cache
+
+            hidden_t, cache_t = seed_cache(cfg_t, params_t)
+            _, cache_d = seed_cache(cfg_d, params_d)
+            last = hidden_t[jnp.arange(b), true_len - 1]
+            first = jnp.argmax(
+                transformer.logits_from_hidden(params_t, last), -1)
+            return first, cache_t, cache_d
+
+        fn = jax.jit(run)
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    def _spec_step(self):
+        """One speculative round, fully on device:
+        draft γ tokens → target verifies γ+1 positions → accept prefix."""
+        if self._spec_fn is not None:
+            return self._spec_fn
+        cfg_t, cfg_d, gamma = self.cfg_t, self.cfg_d, self.gamma
+
+        def run(params_t, params_d, cache_t, cache_d, cur, pos):
+            # cur [B]: last accepted token; pos [B]: its position.
+            def draft_one(carry, _):
+                cache, tok, p = carry
+                logits, cache = transformer.decode_step(
+                    cfg_d, params_d, tok, p, cache)
+                nxt = jnp.argmax(logits, -1)
+                return (cache, nxt, p + 1), nxt
+
+            # γ+1 steps, not γ: the extra step writes drafted[γ-1]'s K/V
+            # into the draft cache at pos+γ.  Without it a fully-accepted
+            # round advances past that slot and leaves a permanent zero
+            # hole the overwrite-later invariant can never repair.
+            (cache_d, _, _), drafted = jax.lax.scan(
+                draft_one, (cache_d, cur, pos), None, length=gamma + 1)
+            drafted = jnp.swapaxes(drafted, 0, 1)[:, :gamma]  # [B, γ]
+
+            # Target verifies [cur, drafted[:-1]] + scores the bonus slot:
+            # chunk = γ+1 tokens starting at pos.
+            chunk = jnp.concatenate([cur[:, None], drafted], axis=1)
+            logits, cache_t = decode_chunk(cfg_t, params_t, chunk, pos,
+                                           cache_t)
+            target_pick = jnp.argmax(logits, -1)              # [B, γ+1]
+
+            # Greedy acceptance: drafted[i] survives iff it equals the
+            # target's pick at slot i AND all earlier slots survived.
+            agree = drafted == target_pick[:, :gamma]         # [B, γ]
+            n_acc = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1),
+                            axis=1)                           # [B] in [0, γ]
+            # Output tokens: accepted draft prefix, then the target's pick
+            # at the first disagreement (or the bonus token if all agreed).
+            idx = jnp.arange(gamma + 1)[None]
+            out = jnp.where(idx < n_acc[:, None],
+                            jnp.pad(drafted, ((0, 0), (0, 1))),
+                            jnp.take_along_axis(target_pick, jnp.minimum(
+                                idx, n_acc[:, None]), axis=1))
+            # Everything after slot n_acc is unused this round.
+            new_cur = jnp.take_along_axis(out, n_acc[:, None], axis=1)[:, 0]
+            new_pos = pos + n_acc + 1
+            return out, n_acc, new_cur, new_pos, cache_t, cache_d
+
+        self._spec_fn = jax.jit(run)
+        return self._spec_fn
+
+    # -- host orchestration ------------------------------------------------
+
+    def generate(self, history, max_new_tokens: Optional[int] = None,
+                 temperature: Optional[float] = None) -> GenerationResult:
+        if temperature:
+            raise NotImplementedError(
+                "speculative engine is greedy-only (reference default, "
+                "src/devices/nano_api.py:21)")
+        t0 = time.perf_counter()
+        ids, bucket = prepare_prompt(
+            self.tokenizer, history, self.target.prefill_buckets,
+            self._max_seq, self.target.max_new_tokens)
+        n = len(ids)
+        budget = self.target.max_new_tokens
+        if max_new_tokens and max_new_tokens > 0:
+            budget = min(budget, max_new_tokens)
+
+        tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+        tokens[0, :n] = ids
+        first, cache_t, cache_d = self._prefill_fn(bucket)(
+            self.params_t, self.params_d, jnp.asarray(tokens),
+            jnp.asarray([n], np.int32))
+        first = int(jax.block_until_ready(first)[0])
+        ttft_ms = (time.perf_counter() - t0) * 1000.0
+
+        out_tokens = [first]
+        cur = jnp.asarray([first], jnp.int32)
+        pos = jnp.asarray([n], jnp.int32)
+        step = self._spec_step()
+        while (len(out_tokens) < budget
+               and out_tokens[-1] != self.tokenizer.eos_id
+               and int(pos[0]) + self.gamma + 1 < self._max_seq):
+            out, n_acc, cur, pos, cache_t, cache_d = step(
+                self.params_t, self.params_d, cache_t, cache_d, cur, pos)
+            n_acc_i = int(n_acc[0])
+            self.accept_history.append(n_acc_i)
+            for tok in np.asarray(out)[0][:n_acc_i + 1].tolist():
+                out_tokens.append(int(tok))
+                if out_tokens[-1] == self.tokenizer.eos_id:
+                    break
+
+        total_ms = (time.perf_counter() - t0) * 1000.0
+        gen_ids = trim_at_eos(out_tokens[:budget], self.tokenizer.eos_id,
+                              self.tokenizer.pad_id)
+        return GenerationResult(
+            text=self.tokenizer.decode(gen_ids), token_ids=gen_ids,
+            prompt_tokens=n, gen_tokens=len(gen_ids),
+            ttft_ms=ttft_ms, total_ms=total_ms)
+
+    @property
+    def acceptance_rate(self) -> float:
+        """Mean accepted draft tokens per round / γ."""
+        if not self.accept_history:
+            return 0.0
+        return float(np.mean(self.accept_history)) / self.gamma
+
+    def warmup(self) -> None:
+        self.generate("warmup", max_new_tokens=self.gamma + 2)
+        self.accept_history.clear()   # don't skew acceptance_rate
