@@ -1,0 +1,3 @@
+"""Bare-module alias for the routing cache (reference src/cache.py)."""
+from distributed_llm_tpu.routing.cache import (  # noqa: F401
+    CacheEntry, CacheLookupResult, QueryCache, RoutingRecord)

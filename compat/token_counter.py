@@ -1,0 +1,3 @@
+"""Bare-module alias: `from token_counter import TokenCounter`
+(reference src/router.py:7)."""
+from distributed_llm_tpu.routing.token_counter import TokenCounter  # noqa: F401

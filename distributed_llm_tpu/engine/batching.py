@@ -61,6 +61,9 @@ class _Request:
     result: Optional[GenerationResult] = None
     error: Optional[BaseException] = None
     t_submit: float = dataclasses.field(default_factory=time.perf_counter)
+    # Streaming: when set, every accepted token id is pushed here as it is
+    # produced; None terminates the stream (see generate_stream).
+    token_queue: Optional["queue.Queue"] = None
 
 
 @dataclasses.dataclass
@@ -218,6 +221,8 @@ class ContinuousBatchingEngine:
 
         slot = _Slot(request=req, blocks=blocks, prompt_len=n, budget=budget,
                      temperature=temp, ttft_ms=ttft_ms, tokens=[first])
+        if req.token_queue is not None:
+            req.token_queue.put(first)
         self._slots[slot_ix] = slot
         row = np.full(self.paged.blocks_per_slot, TRASH_BLOCK, np.int32)
         row[:len(blocks)] = blocks
@@ -243,6 +248,8 @@ class ContinuousBatchingEngine:
             total_ms=(time.perf_counter() - req.t_submit) * 1000.0,
         )
         self._release(slot_ix)
+        if req.token_queue is not None:
+            req.token_queue.put(None)        # end-of-stream sentinel
         req.done.set()
 
     def _release(self, slot_ix: int) -> None:
@@ -257,6 +264,8 @@ class ContinuousBatchingEngine:
         req = self._slots[slot_ix].request
         self._release(slot_ix)
         req.error = exc
+        if req.token_queue is not None:
+            req.token_queue.put(None)
         req.done.set()
 
     def _loop(self) -> None:
@@ -277,6 +286,8 @@ class ContinuousBatchingEngine:
                     admitted_any = True
                 except BaseException as exc:     # surface to the caller
                     req.error = exc
+                    if req.token_queue is not None:
+                        req.token_queue.put(None)
                     req.done.set()
 
             active = [ix for ix, s in enumerate(self._slots) if s is not None]
@@ -304,6 +315,8 @@ class ContinuousBatchingEngine:
                 slot = self._slots[ix]
                 tok = int(nxt[ix])
                 slot.tokens.append(tok)
+                if slot.request.token_queue is not None:
+                    slot.request.token_queue.put(tok)
                 self._pos[ix] += 1
                 self._cur[ix] = tok
                 hit_cap = len(slot.tokens) >= slot.budget
@@ -342,14 +355,17 @@ class ContinuousBatchingEngine:
                 except queue.Empty:
                     break
                 req.error = shutdown
+                if req.token_queue is not None:
+                    req.token_queue.put(None)
                 req.done.set()
 
     def submit(self, history: History,
                max_new_tokens: Optional[int] = None,
-               temperature: Optional[float] = None) -> _Request:
+               temperature: Optional[float] = None,
+               token_queue: Optional["queue.Queue"] = None) -> _Request:
         self.start()
         req = _Request(history=history, max_new_tokens=max_new_tokens,
-                       temperature=temperature)
+                       temperature=temperature, token_queue=token_queue)
         self._queue.put(req)
         self._wake.set()
         return req
@@ -363,5 +379,54 @@ class ContinuousBatchingEngine:
             raise req.error
         return req.result
 
+    def generate_stream(self, history: History,
+                        max_new_tokens: Optional[int] = None,
+                        temperature: Optional[float] = None):
+        """Yield text deltas as tokens come off the shared decode loop
+        (SURVEY.md §7 hard part 6 — the reference API is non-streaming,
+        but TTFT-aware serving wants streaming internals).  The final
+        GenerationResult is ``.result`` on the returned generator's
+        request once exhausted; multi-byte UTF-8 sequences are held back
+        until complete."""
+        import codecs
+        req = self.submit(history, max_new_tokens, temperature,
+                          token_queue=queue.Queue())
+
+        def deltas():
+            decoder = codecs.getincrementaldecoder("utf-8")("replace")
+            while True:
+                tok = req.token_queue.get()
+                if tok is None:
+                    break
+                if tok in (self.tokenizer.eos_id, self.tokenizer.pad_id):
+                    continue
+                if 0 <= tok < 256:
+                    text = decoder.decode(bytes([tok]))
+                    if text:
+                        yield text
+            tail = decoder.decode(b"", final=True)
+            if tail:
+                yield tail
+            if req.error is not None:
+                raise req.error
+
+        return StreamHandle(deltas(), req)
+
     def warmup(self) -> None:
         self.generate("warmup", max_new_tokens=2)
+
+
+class StreamHandle:
+    """Iterable of text deltas; ``.request`` exposes the final
+    GenerationResult / error once the stream is exhausted."""
+
+    def __init__(self, gen, request: _Request):
+        self._gen = gen
+        self.request = request
+
+    def __iter__(self):
+        return self._gen
+
+    @property
+    def result(self) -> Optional[GenerationResult]:
+        return self.request.result

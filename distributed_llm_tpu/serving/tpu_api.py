@@ -23,8 +23,10 @@ import argparse
 import logging
 from typing import Any, Dict, Optional
 
+import json as _json
+
 from ..config import ClusterConfig
-from ..utils.http_compat import Flask, jsonify, request
+from ..utils.http_compat import Flask, jsonify, request, streaming_response
 from ..engine.manager import EngineManager
 from .router import default_cluster
 from .tiers import build_tiers
@@ -86,6 +88,46 @@ def create_tier_app(tier_name: str,
         except Exception as exc:
             logger.exception("inference failed")
             return jsonify({"error": f"Inference failed: {exc}"}), 500
+
+    @app.route("/query/stream", methods=["POST"])
+    def process_query_stream():
+        """SSE token streaming (batched tiers only): `data: {"delta"}`
+        events, then a final `data: {"done", "tokens", "ttft_ms"}`.  The
+        reference API is non-streaming (stream:false, src/devices/
+        nano_api.py:67); this is the TTFT-native extension."""
+        data: Dict[str, Any] = request.get_json(silent=True) or {}
+        query = data.get("query")
+        if not query or not isinstance(query, (list, str)):
+            return jsonify({"error": "No/invalid query provided"}), 400
+        engine = manager.engine()
+        if not hasattr(engine, "generate_stream"):
+            return jsonify({"error": "streaming needs a batched tier "
+                                     "(decode_batch > 1)"}), 501
+        try:
+            num_predict = int(data.get("num_predict") or DEFAULT_NUM_PREDICT)
+            temperature = float(data.get("temperature")
+                                or DEFAULT_TEMPERATURE)
+        except (TypeError, ValueError):
+            return jsonify({"error": "num_predict/temperature must be "
+                                     "numeric"}), 400
+        max_new = num_predict if num_predict > 0 else None
+        handle = engine.generate_stream(query, max_new_tokens=max_new,
+                                        temperature=temperature)
+
+        def events():
+            try:
+                for delta in handle:
+                    yield f"data: {_json.dumps({'delta': delta})}\n\n"
+                result = handle.result
+                yield "data: " + _json.dumps({
+                    "done": True,
+                    "tokens": result.gen_tokens if result else 0,
+                    "ttft_ms": round(result.ttft_ms, 2) if result else None,
+                }) + "\n\n"
+            except Exception as exc:
+                yield f"data: {_json.dumps({'error': str(exc)})}\n\n"
+
+        return streaming_response(events())
 
     return app
 

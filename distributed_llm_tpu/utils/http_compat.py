@@ -15,6 +15,15 @@ except ImportError:
     HAVE_FLASK = False
 
 
+def streaming_response(chunks, content_type: str = "text/event-stream"):
+    """A chunked/SSE response on either backend."""
+    if HAVE_FLASK:
+        from flask import Response
+        return Response(chunks, mimetype=content_type)
+    from .webapp import StreamingResponse
+    return StreamingResponse(chunks, content_type)
+
+
 def static_response(body: bytes, content_type: str):
     """A raw-body response with an explicit content type, on either
     backend (used to serve the frontend files)."""

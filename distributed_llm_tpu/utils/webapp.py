@@ -81,6 +81,27 @@ def jsonify(obj: Any = None, **kwargs: Any) -> Response:
     return Response(json.dumps(payload).encode("utf-8"))
 
 
+class StreamingResponse(Response):
+    """Chunked response: body is produced by an iterator of str/bytes
+    (used for SSE streaming; WSGI yields each chunk as it arrives)."""
+
+    def __init__(self, chunks: Iterable[Any],
+                 content_type: str = "text/event-stream"):
+        super().__init__(b"", 200, content_type)
+        self.chunks = chunks
+
+    def iter_encoded(self) -> Iterable[bytes]:
+        for chunk in self.chunks:
+            yield chunk.encode("utf-8") if isinstance(chunk, str) else chunk
+
+    @property
+    def text(self) -> str:
+        # Draining for tests: consume the iterator once.
+        if not self.body:
+            self.body = b"".join(self.iter_encoded())
+        return self.body.decode("utf-8", errors="replace")
+
+
 def _coerce(rv: Any) -> Response:
     status = 200
     if isinstance(rv, tuple):
@@ -156,16 +177,20 @@ class Flask:
             content_type=environ.get("CONTENT_TYPE", ""),
         )
         resp = self._dispatch(req)
+        streaming = isinstance(resp, StreamingResponse)
         headers = [("Content-Type", resp.content_type),
-                   ("Content-Length", str(len(resp.body))),
                    ("Access-Control-Allow-Origin", "*"),
                    ("Access-Control-Allow-Headers", "Content-Type")]
+        if not streaming:
+            headers.append(("Content-Length", str(len(resp.body))))
         allow = getattr(resp, "allow_methods", None)
         if allow:
             headers.append(("Access-Control-Allow-Methods", allow))
         start_response(
             f"{resp.status_code} {_STATUS.get(resp.status_code, 'OK')}",
             headers)
+        if streaming:
+            return resp.iter_encoded()
         return [resp.body]
 
     def run(self, host: str = "127.0.0.1", port: int = 8000,

@@ -1,0 +1,43 @@
+"""Reference import-compatibility layer: code written against the
+reference's flat src/ layout (bare `router`, `query_router_engine`, ...
+modules) must run unchanged with compat/ on the path."""
+
+import subprocess
+import sys
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# A faithful reduction of the reference's consumption pattern:
+# src/app.py:3 + src/router.py:7-10 + routing_chatbot_tester.py:33-35.
+REFERENCE_STYLE_PROGRAM = """
+import jax; jax.config.update("jax_platforms", "cpu")
+from router import Router
+from query_router_engine import QueryRouter, BENCHMARK_CFG, PRODUCTION_CFG
+from query_sets import query_sets
+from cache import QueryCache
+from token_counter import TokenCounter
+
+router = Router(strategy="heuristic", config=dict(BENCHMARK_CFG),
+                threshold_fallback=1000, benchmark_mode=True)
+history = [{"role": "user", "content": query_sets["general_knowledge"][0]["query"]}]
+response, tokens, device = router.route_query(history)
+assert device in ("nano", "orin"), device
+assert isinstance(response, dict) and "response" in response
+router.nano.server_manager.stop_server()
+router.orin.server_manager.stop_server()
+print("COMPAT_OK", device, tokens)
+"""
+
+
+def test_reference_style_program_runs_via_compat():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.join(REPO, "compat"), REPO,
+         env.get("PYTHONPATH", "")])
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run([sys.executable, "-c", REFERENCE_STYLE_PROGRAM],
+                         capture_output=True, text=True, timeout=300,
+                         env=env, cwd=REPO)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "COMPAT_OK" in res.stdout

@@ -1,0 +1,97 @@
+"""Token streaming: engine-level deltas and the SSE /query/stream tier
+endpoint."""
+
+import json
+
+import pytest
+
+from distributed_llm_tpu.config import ClusterConfig, TierConfig
+from distributed_llm_tpu.engine.batching import ContinuousBatchingEngine
+from distributed_llm_tpu.serving.tpu_api import create_tier_app
+
+
+def _tier(**kw):
+    defaults = dict(name="nano", model_preset="nano_test", max_new_tokens=8,
+                    prefill_buckets=(16, 32, 64), decode_batch=2,
+                    kv_block_size=16)
+    defaults.update(kw)
+    return TierConfig(**defaults)
+
+
+def test_stream_deltas_concatenate_to_generate_output():
+    engine = ContinuousBatchingEngine(_tier(), seed=21)
+    try:
+        ref = engine.generate("user: stream me", max_new_tokens=6)
+        handle = engine.generate_stream("user: stream me", max_new_tokens=6)
+        text = "".join(handle)
+        assert text == ref.text              # greedy → identical
+        assert handle.result is not None
+        assert handle.result.gen_tokens == ref.gen_tokens
+        assert handle.result.ttft_ms > 0
+    finally:
+        engine.stop()
+
+
+def test_stream_handles_multibyte_utf8():
+    # The byte tokenizer can split multi-byte chars across deltas; the
+    # incremental decoder must never emit broken sequences.
+    engine = ContinuousBatchingEngine(_tier(), seed=22)
+    try:
+        handle = engine.generate_stream("user: héllo wörld", max_new_tokens=8)
+        deltas = list(handle)
+        for d in deltas:
+            d.encode("utf-8")                # every delta is valid UTF-8
+        assert "".join(deltas) == handle.result.text
+    finally:
+        engine.stop()
+
+
+def test_sse_endpoint_streams_and_terminates():
+    cluster = ClusterConfig(nano=_tier(),
+                            orin=_tier(name="orin",
+                                       model_preset="orin_test"))
+    app = create_tier_app("nano", cluster=cluster)
+    c = app.test_client()
+    resp = c.post("/query/stream", json={"query": "user: sse", "num_predict": 5})
+    assert resp.status_code == 200
+    assert "text/event-stream" in resp.content_type
+    events = [json.loads(line[len("data: "):])
+              for line in resp.text.strip().split("\n\n")
+              if line.startswith("data: ")]
+    assert events, "no SSE events"
+    assert events[-1].get("done") is True
+    assert events[-1]["tokens"] >= 1
+    deltas = "".join(e.get("delta", "") for e in events[:-1])
+    assert isinstance(deltas, str)
+    app.extensions["dllm_manager"].stop_server()
+
+
+def test_sse_endpoint_rejects_unbatched_tier():
+    cluster = ClusterConfig(
+        nano=_tier(decode_batch=1),
+        orin=_tier(name="orin", model_preset="orin_test", decode_batch=1))
+    app = create_tier_app("nano", cluster=cluster)
+    resp = app.test_client().post("/query/stream",
+                                  json={"query": "user: x"})
+    assert resp.status_code == 501
+    app.extensions["dllm_manager"].stop_server()
+
+
+def test_stream_terminates_when_admission_fails():
+    """A request that explodes in _admit (malformed history items) must
+    end the stream with the error, not hang the consumer."""
+    engine = ContinuousBatchingEngine(_tier(), seed=23)
+    try:
+        handle = engine.generate_stream(["not-a-dict"], max_new_tokens=4)
+        with pytest.raises(Exception):
+            list(handle)                     # returns promptly, re-raises
+    finally:
+        engine.stop()
+
+
+def test_batched_engine_still_has_warmup():
+    engine = ContinuousBatchingEngine(_tier(), seed=24)
+    try:
+        engine.warmup()                      # regression: method exists
+    finally:
+        engine.stop()
