@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import TierConfig
+from .. import models
 from ..models import transformer
 from .inference import (GenerationResult, prepare_prompt, trim_at_eos,
                         upgrade_attention_impl)
@@ -92,10 +93,6 @@ class ContinuousBatchingEngine:
                 "use InferenceEngine for tensor-sharded meshes")
         self.tier = tier
         self.cfg = upgrade_attention_impl(tier.model(), mesh)
-        if self.cfg.num_experts > 1:
-            raise NotImplementedError(
-                "continuous batching currently serves dense models; "
-                "MoE tiers use the sequential InferenceEngine")
         bad = [b for b in tier.prefill_buckets if b % tier.kv_block_size]
         if bad:
             raise ValueError(
@@ -109,7 +106,7 @@ class ContinuousBatchingEngine:
                                  max_slots=tier.decode_batch,
                                  max_seq_len=self.cfg.max_seq_len)
         if params is None:
-            init = jax.jit(partial(transformer.init_params, self.cfg),
+            init = jax.jit(partial(models.init_params, self.cfg),
                            static_argnames=("seed",))
             params = init(seed=seed)
         self.params = params
@@ -144,7 +141,7 @@ class ContinuousBatchingEngine:
         def run(params, tokens, true_len, rng, temp):
             b, s = tokens.shape
             positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
-            hidden, (k_all, v_all) = transformer.prefill(
+            hidden, (k_all, v_all) = models.serving_prefill(
                 cfg, params, tokens, positions)
             last = hidden[jnp.arange(b), true_len - 1]
             logits = transformer.logits_from_hidden(params, last)
@@ -320,7 +317,10 @@ class ContinuousBatchingEngine:
                 self._pos[ix] += 1
                 self._cur[ix] = tok
                 hit_cap = len(slot.tokens) >= slot.budget
-                hit_end = (tok == self.tokenizer.eos_id
+                # PAD ends generation like EOS: trim_at_eos truncates the
+                # result there, so streaming past it would diverge.
+                hit_end = (tok in (self.tokenizer.eos_id,
+                                   self.tokenizer.pad_id)
                            or self._pos[ix] >= self.cfg.max_seq_len - 1)
                 if hit_cap or hit_end:
                     self._finish(ix)

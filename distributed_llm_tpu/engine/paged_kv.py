@@ -152,9 +152,13 @@ def decode_step_paged(
         attn = attention.decode(q, k_seq, v_seq, pos, impl=cfg.attention_impl)
 
         x = x + attn.reshape(b, cfg.num_heads * d) @ lp["wo"]
-        x = x + transformer._swiglu(
-            transformer.rms_norm(x, lp["ln2"], cfg.norm_eps),
-            lp["w_gate"], lp["w_up"], lp["w_down"])
+        h_ffn = transformer.rms_norm(x, lp["ln2"], cfg.norm_eps)
+        if cfg.num_experts > 1:
+            from ..models.moe import moe_ffn_decode
+            x = x + moe_ffn_decode(cfg, lp, h_ffn)
+        else:
+            x = x + transformer._swiglu(h_ffn, lp["w_gate"], lp["w_up"],
+                                        lp["w_down"])
         return x, (k_pool, v_pool)
 
     x, (k_new, v_new) = jax.lax.scan(

@@ -1,2 +1,4 @@
 from .trainer import TrainConfig, Trainer, lm_loss, make_optimizer  # noqa: F401
-from .data import batches, synthetic_text  # noqa: F401
+from .data import (batches, corpus_batches, pack_documents,  # noqa: F401
+                   synthetic_text)
+from .pipeline_trainer import PipelineTrainer  # noqa: F401

@@ -9,7 +9,7 @@ measurably within tens of steps), and batches are deterministic in
 
 from __future__ import annotations
 
-from typing import Iterator, Tuple
+from typing import Iterator, Sequence, Tuple
 
 import numpy as np
 
@@ -54,3 +54,50 @@ def batches(batch_size: int, seq_len: int, seed: int = 0
             mask[b, : len(ids)] = 1.0
         yield toks, mask
         step += 1
+
+
+def pack_documents(texts: Sequence[str], seq_len: int,
+                   tokenizer: ByteTokenizer = None) -> np.ndarray:
+    """Tokenize documents and pack them into [N, seq_len] rows with EOS
+    separators — the standard LM pretraining layout (no padding waste;
+    a document may span row boundaries)."""
+    tok = tokenizer or ByteTokenizer()
+    stream: list = []
+    for text in texts:
+        stream.extend(tok.encode(text))
+        stream.append(tok.eos_id)
+    n = len(stream) // seq_len
+    if n == 0:
+        raise ValueError(f"corpus too small to fill one {seq_len}-token row")
+    return np.asarray(stream[: n * seq_len], np.int32).reshape(n, seq_len)
+
+
+def corpus_batches(paths: Sequence[str], batch_size: int, seq_len: int,
+                   seed: int = 0, loop: bool = True
+                   ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Stream (tokens, loss_mask) batches from text files on disk.
+
+    Documents are split on blank lines, packed densely (pack_documents),
+    and row order is reshuffled each epoch; every position carries loss
+    (mask of ones) since packing leaves no padding.
+    """
+    texts: list = []
+    for path in paths:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            raw = f.read()
+        texts.extend(t.strip() for t in raw.split("\n\n") if t.strip())
+    rows = pack_documents(texts, seq_len)
+    if len(rows) < batch_size:
+        raise ValueError(f"corpus packs to {len(rows)} rows < "
+                         f"batch_size={batch_size}")
+    epoch = 0
+    while True:
+        rng = np.random.default_rng(seed + epoch)
+        order = rng.permutation(len(rows))
+        for start in range(0, len(rows) - batch_size + 1, batch_size):
+            # Fresh mask per batch: consumers may mask in place.
+            yield (rows[order[start:start + batch_size]],
+                   np.ones((batch_size, seq_len), np.float32))
+        if not loop:
+            return
+        epoch += 1

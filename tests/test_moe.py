@@ -108,13 +108,23 @@ def test_moe_checkpoint_roundtrip(tmp_path):
                for a, b in zip(flat_a, flat_b))
 
 
-def test_batching_engine_rejects_moe():
+def test_moe_batched_matches_sequential():
+    """MoE under continuous batching: token-identical to the sequential
+    engine under greedy decoding (paging/batching change memory, not math)."""
     from distributed_llm_tpu.engine.batching import ContinuousBatchingEngine
     tier = TierConfig(name="nano", model_preset="moe_test",
-                      prefill_buckets=(16, 32), decode_batch=2,
-                      kv_block_size=16)
-    with pytest.raises(NotImplementedError):
-        ContinuousBatchingEngine(tier)
+                      max_new_tokens=8, prefill_buckets=(16, 32),
+                      decode_batch=2, kv_block_size=16)
+    ref = InferenceEngine(
+        TierConfig(name="nano", model_preset="moe_test", max_new_tokens=8,
+                   prefill_buckets=(16, 32)), seed=15
+    ).generate("user: batched experts", max_new_tokens=6)
+    engine = ContinuousBatchingEngine(tier, seed=15)
+    try:
+        got = engine.generate("user: batched experts", max_new_tokens=6)
+    finally:
+        engine.stop()
+    assert got.token_ids == ref.token_ids
 
 
 def test_moe_serves_on_tensor_parallel_tier():
