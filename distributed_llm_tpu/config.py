@@ -183,6 +183,22 @@ class TierConfig:
     # engine/speculative.py).  None = plain decoding.
     draft_preset: Optional[str] = None
     speculative_gamma: int = 4
+    # Session KV prefix reuse (engine/prefix_cache.py): park each request's
+    # KV cache and re-prefill only the suffix when the next prompt extends
+    # it (multi-turn chats).  Semantically equivalent to a cold prefill
+    # (same math; kernel rounding may differ where the cold path uses the
+    # Pallas kernels), so it stays on even in benchmark mode; dense models
+    # only.  Each parked entry pins one [L, 1, S_max, N_kv, D] ×2 cache in
+    # HBM (≈1 GB for an 8B-class model at 8k context) — the default of 2
+    # serves the common alternating-session chat pattern while bounding the
+    # steady-state cost; raise it only with measured HBM headroom, or set
+    # enable_prefix_cache=False for pure single-turn traffic.
+    enable_prefix_cache: bool = True
+    prefix_cache_entries: int = 2
+    # Weight-only quantization for serving ("none" | "int8", ops/quant.py):
+    # int8 halves decode's HBM weight traffic.  Unsharded dense tiers only
+    # (sharding rules and the trainer see full-precision leaf paths).
+    quantize: str = "none"
 
     def model(self) -> ModelConfig:
         return MODEL_PRESETS[self.model_preset]

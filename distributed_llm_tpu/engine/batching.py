@@ -109,7 +109,8 @@ class ContinuousBatchingEngine:
             init = jax.jit(partial(models.init_params, self.cfg),
                            static_argnames=("seed",))
             params = init(seed=seed)
-        self.params = params
+        from ..ops.quant import maybe_quantize
+        self.params = maybe_quantize(params, tier, self.cfg)
         self.pool = init_pool(self.cfg, self.paged)
         self.allocator = BlockAllocator(self.paged.num_blocks)
 

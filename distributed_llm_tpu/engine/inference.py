@@ -129,15 +129,29 @@ class InferenceEngine:
 
         if params is None:
             params = self._init_params(seed)
-        self.params = params
+        from ..ops.quant import maybe_quantize
+        self.params = maybe_quantize(params, tier, self.cfg, mesh=mesh)
 
-        self._prefill_fns: Dict[int, Any] = {}
+        self._prefill_fns: Dict[Any, Any] = {}
         self._decode_fn = None
         self._max_seq = self.cfg.max_seq_len
+        # Usable prefill buckets, ascending — the single source for both
+        # generate()'s suffix-bucket choice and warmup()'s precompiles.
+        self._buckets = sorted(set(
+            b for b in tier.prefill_buckets if b <= self._max_seq))
         # Per-phase wall-time attribution (tokenize/prefill/decode/detok) —
         # the jax.profiler-adjacent view surfaced at GET /stats (§5.1/§5.5).
         from ..utils.telemetry import PhaseTimer
         self.phases = PhaseTimer()
+
+        # Session KV prefix reuse (engine/prefix_cache.py): dense models
+        # only (moe.py has no chunk_prefill yet).  Each parked entry pins a
+        # full KV cache in HBM, so capacity is a tier knob.
+        from .prefix_cache import PrefixCache
+        self.prefix_cache = (
+            PrefixCache(capacity=tier.prefix_cache_entries)
+            if tier.enable_prefix_cache and tier.prefix_cache_entries > 0
+            and self.cfg.num_experts == 1 else None)
 
     # ------------------------------------------------------------------
 
@@ -187,6 +201,38 @@ class InferenceEngine:
         self._prefill_fns[bucket] = fn
         return fn
 
+    def _suffix_prefill_fn(self, bucket: int, window: int):
+        """Jitted per (suffix bucket, attention window): forward only a
+        prompt SUFFIX against a parked prefix cache (session KV reuse — see
+        engine/prefix_cache.py), then sample the first token.  ``window``
+        statically bounds the attended cache prefix so cost is O(prefix
+        bucket), not O(max_seq).  The cache is donated: the entry was
+        removed from the prefix cache by take(), so no live alias remains."""
+        key = ("suffix", bucket, window)
+        if key in self._prefill_fns:
+            return self._prefill_fns[key]
+
+        cfg = self.cfg
+
+        def run(params, cache, tokens, start, true_len, rng, temperature):
+            b = tokens.shape[0]
+            hidden, cache = transformer.chunk_prefill(
+                cfg, params, tokens, start, true_len, cache, window=window)
+            last = hidden[jnp.arange(b), true_len - start - 1]
+            logits = transformer.logits_from_hidden(params, last)
+            first = sample_token_dynamic(logits, rng, temperature)
+            return first, cache
+
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        fn = jax.jit(run, donate_argnums=donate)
+        self._prefill_fns[key] = fn
+        return fn
+
+    def _suffix_window(self, needed: int) -> int:
+        """Smallest bucketed attention window covering ``needed`` cache
+        positions (falls back to the full sequence)."""
+        return next((b for b in self._buckets if b >= needed), self._max_seq)
+
     def _decode_loop(self):
         """Jitted once: the full generation loop as one device call."""
         if self._decode_fn is not None:
@@ -226,7 +272,9 @@ class InferenceEngine:
 
             step, out, cache, done, rng = jax.lax.while_loop(
                 cond, body, (jnp.int32(1), out, cache, done, rng))
-            return out, step
+            # The cache is returned (not dropped) so the host can park it
+            # for session prefix reuse; donation still updates it in place.
+            return out, step, cache
 
         # Donate the KV cache so the loop updates it in place in HBM.
         # (CPU can't donate these buffers and warns, so gate on backend.)
@@ -257,8 +305,6 @@ class InferenceEngine:
                                          self._max_seq,
                                          self.tier.max_new_tokens)
         n = len(ids)
-        tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
-        tokens[0, :n] = ids
         true_len = np.array([n], np.int32)
 
         self._rng, rng1, rng2 = jax.random.split(self._rng, 3)
@@ -268,19 +314,56 @@ class InferenceEngine:
         if max_new_tokens and max_new_tokens > 0:
             budget = min(budget, max_new_tokens)
 
+        # Session prefix reuse: reclaim a parked KV cache covering a prefix
+        # of this prompt and forward only the suffix (O(delta) prefill
+        # instead of O(history) — the reference re-prefills everything
+        # through Ollama every turn, SURVEY.md §3.1).
+        reused = None
+        if self.prefix_cache is not None and self._buckets:
+            entry, m = self.prefix_cache.take(
+                ids, max_len=self._max_seq - self._buckets[0])
+            if entry is not None:
+                suffix = ids[m:]
+                sb = next((b for b in self._buckets
+                           if len(suffix) <= b and m + b <= self._max_seq),
+                          None)
+                if sb is None:   # no bucket fits — restore entry, prefill in full
+                    self.prefix_cache.untake(entry, m)
+                else:
+                    reused = (entry.cache, m, suffix, sb)
+
         with self.phases.phase("prefill"):
-            first, cache = self._prefill_fn(bucket)(
-                self.params, jnp.asarray(tokens), jnp.asarray(true_len),
-                rng1, temp)
+            if reused is not None:
+                cache0, m, suffix, sb = reused
+                tokens = np.full((1, sb), self.tokenizer.pad_id, np.int32)
+                tokens[0, :len(suffix)] = suffix
+                window = self._suffix_window(m + sb)
+                first, cache = self._suffix_prefill_fn(sb, window)(
+                    self.params, cache0, jnp.asarray(tokens),
+                    jnp.asarray([m], np.int32), jnp.asarray(true_len),
+                    rng1, temp)
+            else:
+                tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+                tokens[0, :n] = ids
+                first, cache = self._prefill_fn(bucket)(
+                    self.params, jnp.asarray(tokens), jnp.asarray(true_len),
+                    rng1, temp)
             first = jax.block_until_ready(first)
         ttft_ms = (time.perf_counter() - t0) * 1000.0
 
         with self.phases.phase("decode"):
-            out, steps = self._decode_loop()(
+            out, steps, cache = self._decode_loop()(
                 self.params, cache, first, jnp.asarray(true_len), rng2, temp,
                 jnp.int32(budget))
             out = np.asarray(jax.block_until_ready(out))[0]
         total_ms = (time.perf_counter() - t0) * 1000.0
+
+        if self.prefix_cache is not None:
+            # Park the post-decode cache: its first n positions hold this
+            # prompt's KV (decode wrote past n; masks hide it until the next
+            # suffix overwrites).  Next turn's history extends this prompt,
+            # so it reclaims everything but the new turn.
+            self.prefix_cache.put(ids, cache)
 
         with self.phases.phase("detokenize"):
             gen_ids = trim_at_eos(out.tolist()[:budget],
@@ -298,9 +381,25 @@ class InferenceEngine:
         )
 
     def warmup(self) -> None:
-        """Compile the smallest prefill bucket + the decode loop."""
+        """Compile the smallest prefill bucket + the decode loop, and (when
+        prefix reuse is on) the suffix-prefill programs for the two smallest
+        buckets — typical chat turns land there, and compiling them now
+        keeps the first cache hit's TTFT at O(delta) instead of paying an
+        XLA trace inside the request."""
         from ..utils.telemetry import PhaseTimer
         self.generate("warmup", max_new_tokens=1)
+        if self.prefix_cache is not None:
+            for sb in self._buckets[:2]:
+                # A short-history hit's window is the bucket above the
+                # suffix bucket (prefix m + suffix sb rounds up one step).
+                window = self._suffix_window(sb + 1)
+                cache = transformer.init_kv_cache(self.cfg, 1, self._max_seq)
+                first, _ = self._suffix_prefill_fn(sb, window)(
+                    self.params, cache,
+                    jnp.full((1, sb), self.tokenizer.pad_id, jnp.int32),
+                    jnp.asarray([0], jnp.int32), jnp.asarray([1], jnp.int32),
+                    jax.random.PRNGKey(0), jnp.float32(0.0))
+                jax.block_until_ready(first)
         # Compile time lands in the warmup call's phases; reset so /stats
         # attribution reflects steady-state serving only.
         self.phases = PhaseTimer()

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 
 from ..config import ModelConfig
 from ..models import transformer
-from ..ops import attention
+from ..ops import attention, quant
 
 KVPool = Dict[str, jax.Array]    # {"k","v": [L, NB, bs, N_kv, D]}
 
@@ -123,7 +123,7 @@ def decode_step_paged(
     bs = pool["k"].shape[2]
     mb = tables.shape[1]
 
-    x = params["embed"][token]                         # [B, H]
+    x = quant.embed_rows(params["embed"], token)       # [B, H]
     sin, cos = transformer.rope_sincos(pos, d, cfg.rope_theta)
 
     blk = jnp.take_along_axis(tables, (pos // bs)[:, None], axis=1)[:, 0]
@@ -133,9 +133,9 @@ def decode_step_paged(
     def layer(x, scanned):
         lp, k_pool, v_pool = scanned                   # pools: [NB, bs, nkv, d]
         h_in = transformer.rms_norm(x, lp["ln1"], cfg.norm_eps)
-        q = (h_in @ lp["wq"]).reshape(b, cfg.num_heads, d)
-        k = (h_in @ lp["wk"]).reshape(b, cfg.num_kv_heads, d)
-        v = (h_in @ lp["wv"]).reshape(b, cfg.num_kv_heads, d)
+        q = quant.matmul(h_in, lp["wq"]).reshape(b, cfg.num_heads, d)
+        k = quant.matmul(h_in, lp["wk"]).reshape(b, cfg.num_kv_heads, d)
+        v = quant.matmul(h_in, lp["wv"]).reshape(b, cfg.num_kv_heads, d)
         q = transformer.apply_rope(q, sin, cos)
         k = transformer.apply_rope(k, sin, cos)
 
@@ -151,7 +151,7 @@ def decode_step_paged(
         v_seq = v_pool[tables].reshape(b, mb * bs, cfg.num_kv_heads, d)
         attn = attention.decode(q, k_seq, v_seq, pos, impl=cfg.attention_impl)
 
-        x = x + attn.reshape(b, cfg.num_heads * d) @ lp["wo"]
+        x = x + quant.matmul(attn.reshape(b, cfg.num_heads * d), lp["wo"])
         h_ffn = transformer.rms_norm(x, lp["ln2"], cfg.norm_eps)
         if cfg.num_experts > 1:
             from ..models.moe import moe_ffn_decode

@@ -1,0 +1,127 @@
+"""Session KV prefix reuse: skip re-prefilling shared prompt prefixes.
+
+The reference's serving loop re-runs the FULL conversation history through
+Ollama's prefill on every turn (src/router.py:199 builds the whole history
+prompt; src/devices/nano_api.py:49-56 joins it; Ollama prefills it all) — so
+turn N pays O(history) prefill even though turns 1..N-1 were already
+processed.  Owning the KV cache lets us fix that the TPU way:
+
+- after a generation, the engine parks the request's (prompt token ids,
+  post-decode KV cache) here;
+- the next prompt that *extends* a parked prompt (the multi-turn chat
+  pattern: new prompt = old prompt + assistant reply + new user turn)
+  reclaims the cache and only forwards the suffix through
+  ``transformer.chunk_prefill`` — prefill cost drops from O(total) to
+  O(delta), which is what bounds TTFT on deep conversations.
+
+Entries hold real HBM buffers, so capacity is small and LRU.  A reclaimed
+entry is REMOVED from the cache (the jitted suffix-prefill donates its
+buffers); the engine re-parks the updated cache after decoding.  Matching is
+exact-prefix on token ids — tail-truncated prompts simply miss (the prefix
+property is broken by truncation, and correctness never depends on a hit).
+
+Thread safety: a plain lock around the entry list; the arrays themselves are
+only touched by the engine that reclaimed them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class PrefixEntry:
+    ids: Tuple[int, ...]     # prompt token ids whose KV the cache holds
+    cache: Any               # KVCache pytree [L,1,S_max,N_kv,D]
+
+
+class PrefixCache:
+    """Small LRU of (token-id prefix → KV cache) for one engine."""
+
+    def __init__(self, capacity: int = 4, min_prefix: int = 16):
+        self.capacity = capacity
+        self.min_prefix = min_prefix
+        self._entries: List[PrefixEntry] = []   # LRU order: oldest first
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        # Token count actually skipped via reuse (for /stats).
+        self.tokens_saved = 0
+
+    def take(self, ids: Sequence[int],
+             max_len: Optional[int] = None) -> Tuple[Optional[PrefixEntry], int]:
+        """Longest parked prefix of ``ids``, removed from the cache.
+
+        Returns (entry, matched_len) or (None, 0); the KV pytree is
+        ``entry.cache``, and the entry doubles as the token for ``untake``
+        (thread-safe: each caller can only restore the entry IT took).
+        matched_len is capped at len(ids)-1 so the caller always has ≥1
+        suffix token to forward (the model needs a query position to produce
+        next-token logits), and at ``max_len`` (caller's headroom for the
+        suffix bucket).  Partial reuse of a longer entry is sound: KV at
+        position i depends only on tokens 0..i, so the first m positions
+        serve any prompt sharing that m-token prefix.
+        """
+        ids = tuple(ids)
+        cap = len(ids) - 1
+        if max_len is not None:
+            cap = min(cap, max_len)
+        with self._lock:
+            best_i, best_len = -1, 0
+            for i, e in enumerate(self._entries):
+                m = min(len(e.ids), cap)
+                if m < max(self.min_prefix, best_len + 1):
+                    continue
+                if e.ids[:m] == ids[:m]:
+                    best_i, best_len = i, m
+            if best_i < 0:
+                self.misses += 1
+                return None, 0
+            entry = self._entries.pop(best_i)
+            self.hits += 1
+            self.tokens_saved += best_len
+            return entry, best_len
+
+    def untake(self, entry: PrefixEntry, matched_len: int) -> None:
+        """Undo a take(): the caller found it could not use the reclaimed
+        cache (e.g. no suffix bucket fits) and its buffers were NOT donated.
+        Restores the ORIGINAL entry — full ids, so future prompts still
+        match its whole length — and reverses the hit accounting.  Only the
+        entry returned by the caller's own take() may be passed, so
+        concurrent take/untake pairs on different entries cannot cross."""
+        with self._lock:
+            self.hits -= 1
+            self.tokens_saved -= matched_len
+            self.misses += 1
+            self._entries.append(entry)
+            while len(self._entries) > self.capacity:
+                self._entries.pop(0)
+
+    def put(self, ids: Sequence[int], cache: Any) -> None:
+        """Park a cache whose first len(ids) positions hold KV for ``ids``."""
+        if len(ids) < self.min_prefix:
+            return
+        ids = tuple(ids)
+        with self._lock:
+            # Replace any entry this one extends (or duplicates): the longer
+            # prefix serves every prompt the shorter one could.
+            self._entries = [
+                e for e in self._entries if ids[:len(e.ids)] != e.ids]
+            self._entries.append(PrefixEntry(ids, cache))
+            while len(self._entries) > self.capacity:
+                self._entries.pop(0)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "tokens_saved": self.tokens_saved,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()

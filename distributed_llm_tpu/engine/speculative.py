@@ -33,6 +33,7 @@ import numpy as np
 
 from ..config import TierConfig
 from ..models import transformer
+from ..ops import quant
 from .inference import (GenerationResult, prepare_prompt, trim_at_eos,
                         upgrade_attention_impl)
 from .tokenizer import ByteTokenizer
@@ -48,15 +49,15 @@ def decode_chunk(cfg, params, tokens: jax.Array, start_pos: jax.Array,
     b, g = tokens.shape
     d = cfg.head_dim
     pos = start_pos[:, None] + jnp.arange(g)[None]            # [B, G]
-    x = params["embed"][tokens]                               # [B, G, H]
+    x = quant.embed_rows(params["embed"], tokens)             # [B, G, H]
     sin, cos = transformer.rope_sincos(pos, d, cfg.rope_theta)
 
     def layer(x, scanned):
         lp, k_cache, v_cache = scanned                        # [B, S, NKV, D]
         h_in = transformer.rms_norm(x, lp["ln1"], cfg.norm_eps)
-        q = (h_in @ lp["wq"]).reshape(b, g, cfg.num_heads, d)
-        k = (h_in @ lp["wk"]).reshape(b, g, cfg.num_kv_heads, d)
-        v = (h_in @ lp["wv"]).reshape(b, g, cfg.num_kv_heads, d)
+        q = quant.matmul(h_in, lp["wq"]).reshape(b, g, cfg.num_heads, d)
+        k = quant.matmul(h_in, lp["wk"]).reshape(b, g, cfg.num_kv_heads, d)
+        v = quant.matmul(h_in, lp["wv"]).reshape(b, g, cfg.num_kv_heads, d)
         q = transformer.apply_rope(q, sin, cos)
         k = transformer.apply_rope(k, sin, cos)
 
@@ -80,7 +81,7 @@ def decode_chunk(cfg, params, tokens: jax.Array, start_pos: jax.Array,
         probs = jax.nn.softmax(logits, axis=-1).astype(v_exp.dtype)
         attn = jnp.einsum("bngk,bknd->bgnd", probs, v_exp)
 
-        x = x + attn.reshape(b, g, cfg.num_heads * d) @ lp["wo"]
+        x = x + quant.matmul(attn.reshape(b, g, cfg.num_heads * d), lp["wo"])
         x = x + transformer._swiglu(
             transformer.rms_norm(x, lp["ln2"], cfg.norm_eps),
             lp["w_gate"], lp["w_up"], lp["w_down"])
@@ -124,6 +125,10 @@ class SpeculativeEngine:
             return jax.jit(lambda: transformer.init_params(cfg, seed + salt))()
         self.params_t = init(self.cfg_t, target_params, 0)
         self.params_d = init(self.cfg_d, draft_params, 1)
+        # The target tier's quantize mode applies to both models (the draft
+        # gains the most: it runs gamma small decode steps per target step).
+        self.params_t = quant.maybe_quantize(self.params_t, target, self.cfg_t)
+        self.params_d = quant.maybe_quantize(self.params_d, target, self.cfg_d)
 
         self._prefill_fns: Dict[int, Any] = {}
         self._spec_fn = None

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from ..config import ModelConfig
 from ..ops import attention
+from ..ops import quant
 
 Params = Dict[str, Any]
 KVCache = Dict[str, jax.Array]   # {"k": [L,B,S,N_kv,D], "v": [L,B,S,N_kv,D]}
@@ -93,8 +94,9 @@ def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
-def _swiglu(x: jax.Array, gate: jax.Array, up: jax.Array, down: jax.Array) -> jax.Array:
-    return (jax.nn.silu(x @ gate) * (x @ up)) @ down
+def _swiglu(x: jax.Array, gate, up, down) -> jax.Array:
+    return quant.matmul(
+        jax.nn.silu(quant.matmul(x, gate)) * quant.matmul(x, up), down)
 
 
 # =============================================================================
@@ -110,19 +112,19 @@ def prefill(cfg: ModelConfig, params: Params, tokens: jax.Array,
     """
     b, s = tokens.shape
     d = cfg.head_dim
-    x = params["embed"][tokens]                       # [B,S,H]
+    x = quant.embed_rows(params["embed"], tokens)                       # [B,S,H]
     sin, cos = rope_sincos(positions, d, cfg.rope_theta)
 
     def layer(x, lp):
         h_in = rms_norm(x, lp["ln1"], cfg.norm_eps)
-        q = (h_in @ lp["wq"]).reshape(b, s, cfg.num_heads, d)
-        k = (h_in @ lp["wk"]).reshape(b, s, cfg.num_kv_heads, d)
-        v = (h_in @ lp["wv"]).reshape(b, s, cfg.num_kv_heads, d)
+        q = quant.matmul(h_in, lp["wq"]).reshape(b, s, cfg.num_heads, d)
+        k = quant.matmul(h_in, lp["wk"]).reshape(b, s, cfg.num_kv_heads, d)
+        v = quant.matmul(h_in, lp["wv"]).reshape(b, s, cfg.num_kv_heads, d)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
         attn = attention.causal(q, k, v, impl=cfg.attention_impl
                                 ).reshape(b, s, cfg.num_heads * d)
-        x = x + attn @ lp["wo"]
+        x = x + quant.matmul(attn, lp["wo"])
         x = x + _swiglu(rms_norm(x, lp["ln2"], cfg.norm_eps),
                         lp["w_gate"], lp["w_up"], lp["w_down"])
         return x, (k, v)
@@ -133,7 +135,7 @@ def prefill(cfg: ModelConfig, params: Params, tokens: jax.Array,
 
 def logits_from_hidden(params: Params, hidden: jax.Array) -> jax.Array:
     """Tied LM head: [..., H] -> [..., V] in float32."""
-    return (hidden @ params["embed"].T).astype(jnp.float32)
+    return quant.tied_head(params["embed"], hidden)
 
 
 # =============================================================================
@@ -151,15 +153,15 @@ def decode_step(cfg: ModelConfig, params: Params, token: jax.Array,
     """
     b = token.shape[0]
     d = cfg.head_dim
-    x = params["embed"][token]                        # [B,H]
+    x = quant.embed_rows(params["embed"], token)      # [B,H]
     sin, cos = rope_sincos(pos, d, cfg.rope_theta)    # [B, D/2]
 
     def layer(x, scanned):
         lp, k_cache, v_cache = scanned
         h_in = rms_norm(x, lp["ln1"], cfg.norm_eps)
-        q = (h_in @ lp["wq"]).reshape(b, cfg.num_heads, d)
-        k = (h_in @ lp["wk"]).reshape(b, cfg.num_kv_heads, d)
-        v = (h_in @ lp["wv"]).reshape(b, cfg.num_kv_heads, d)
+        q = quant.matmul(h_in, lp["wq"]).reshape(b, cfg.num_heads, d)
+        k = quant.matmul(h_in, lp["wk"]).reshape(b, cfg.num_kv_heads, d)
+        v = quant.matmul(h_in, lp["wv"]).reshape(b, cfg.num_kv_heads, d)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
 
@@ -173,7 +175,7 @@ def decode_step(cfg: ModelConfig, params: Params, token: jax.Array,
 
         attn = attention.decode(q, k_cache, v_cache, pos,
                                 impl=cfg.attention_impl)
-        x = x + attn.reshape(b, cfg.num_heads * d) @ lp["wo"]
+        x = x + quant.matmul(attn.reshape(b, cfg.num_heads * d), lp["wo"])
         x = x + _swiglu(rms_norm(x, lp["ln2"], cfg.norm_eps),
                         lp["w_gate"], lp["w_up"], lp["w_down"])
         return x, (k_cache, v_cache)
@@ -182,6 +184,67 @@ def decode_step(cfg: ModelConfig, params: Params, token: jax.Array,
         layer, x, (params["layers"], kv["k"], kv["v"]))
     hidden = rms_norm(x, params["final_ln"], cfg.norm_eps)
     return logits_from_hidden(params, hidden), {"k": k_new, "v": v_new}
+
+
+def chunk_prefill(cfg: ModelConfig, params: Params, tokens: jax.Array,
+                  start: jax.Array, true_len: jax.Array, kv: KVCache,
+                  window: int = 0) -> Tuple[jax.Array, KVCache]:
+    """Prefill a CHUNK of a prompt against an existing KV cache.
+
+    The op behind session prefix reuse (engine/prefix_cache.py): when a new
+    prompt extends a previously-served one (the multi-turn chat pattern —
+    the reference re-prefills the whole history through Ollama every turn,
+    SURVEY.md §3.1), only the suffix is forwarded here, attending to the
+    cached prefix at absolute positions.  Also serves as plain chunked
+    prefill (start=0 over successive chunks).
+
+    tokens: [B, S_c] right-padded chunk; start: [B] absolute position of the
+    chunk's first token (prefix length already in ``kv``); true_len: [B]
+    total valid length (start + real chunk tokens); kv: [L,B,S_max,N_kv,D]
+    cache, written in place at [start, start+S_c).
+    ``window`` (static): attend only to cache positions < window instead of
+    all S_max — callers pass a bucketed bound ≥ start+S_c so attention cost
+    is O(prefix bucket), not O(max_seq).  0 = full cache.
+    Returns (hidden [B,S_c,H], updated cache).
+    """
+    b, s_c = tokens.shape
+    d = cfg.head_dim
+    x = quant.embed_rows(params["embed"], tokens)                                    # [B,S_c,H]
+    positions = start[:, None] + jnp.arange(s_c)[None, :]          # [B,S_c]
+    # Queries past each sequence's true length are padding; clamp their mask
+    # frontier to the last real position (their outputs are never read).
+    q_pos = jnp.minimum(positions, jnp.maximum(true_len, 1)[:, None] - 1)
+    sin, cos = rope_sincos(positions, d, cfg.rope_theta)
+
+    def layer(x, scanned):
+        lp, k_cache, v_cache = scanned
+        h_in = rms_norm(x, lp["ln1"], cfg.norm_eps)
+        q = quant.matmul(h_in, lp["wq"]).reshape(b, s_c, cfg.num_heads, d)
+        k = quant.matmul(h_in, lp["wk"]).reshape(b, s_c, cfg.num_kv_heads, d)
+        v = quant.matmul(h_in, lp["wv"]).reshape(b, s_c, cfg.num_kv_heads, d)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+
+        def write(cache, new):
+            def one(c, n, p):
+                return jax.lax.dynamic_update_slice(c, n, (p, 0, 0))
+            return jax.vmap(one)(cache, new, start)
+        k_cache = write(k_cache, k)
+        v_cache = write(v_cache, v)
+
+        k_att = k_cache[:, :window] if window else k_cache
+        v_att = v_cache[:, :window] if window else v_cache
+        attn = attention.chunk(q, k_att, v_att, q_pos,
+                               impl=cfg.attention_impl)
+        x = x + quant.matmul(attn.reshape(b, s_c, cfg.num_heads * d), lp["wo"])
+        x = x + _swiglu(rms_norm(x, lp["ln2"], cfg.norm_eps),
+                        lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, (params["layers"], kv["k"], kv["v"]))
+    hidden = rms_norm(x, params["final_ln"], cfg.norm_eps)
+    return hidden, {"k": k_new, "v": v_new}
 
 
 def init_kv_cache(cfg: ModelConfig, batch: int, max_seq: int) -> KVCache:

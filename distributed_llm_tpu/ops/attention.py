@@ -62,6 +62,17 @@ def decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     return decode_attention(q, k_cache, v_cache, pos)
 
 
+def chunk(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+          q_positions: jax.Array, impl: str = "auto") -> jax.Array:
+    """Dispatching chunked-prefill attention (suffix queries vs full cache).
+
+    XLA-only today: the masked einsum fuses well and GSPMD can shard it; a
+    Pallas variant would mirror flash_decode_attention with a q-block grid.
+    """
+    del impl
+    return chunk_attention(q, k_cache, v_cache, q_positions)
+
+
 def _expand_kv(x: jax.Array, groups: int) -> jax.Array:
     """[B, S, N_kv, D] -> [B, S, N_kv*groups, D] by repeating each kv head."""
     if groups == 1:
@@ -88,6 +99,46 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     s = q.shape[1]
     causal = jnp.tril(jnp.ones((s, s), dtype=bool))
     logits = jnp.where(causal[None, None], logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bnqk,bknd->bqnd", probs, v)
+
+
+def chunk_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    q_positions: jax.Array,
+) -> jax.Array:
+    """Chunked-prefill attention: a chunk of new queries against the full
+    KV cache (prefix + the chunk itself, already written).
+
+    This is the op behind session KV prefix reuse and chunked prefill: only
+    the suffix of a prompt is run as queries, attending causally to the
+    cached prefix at absolute positions.  Generalizes ``decode_attention``
+    (chunk of 1) and ``causal_attention`` (chunk = whole sequence, empty
+    prefix).
+
+    q: [B, S_c, N_q, D] (the chunk's queries, RoPE already applied at
+       absolute positions)
+    k_cache/v_cache: [B, S_max, N_kv, D] with positions < start holding the
+       prefix and [start, start+S_c) holding the chunk's own K/V
+    q_positions: [B, S_c] absolute position of each query token; cache
+       indices > position are masked (slots not yet valid for that query).
+       Right-padding is harmless: padded queries produce garbage rows that
+       the caller never reads.
+    Returns [B, S_c, N_q, D].
+    """
+    groups = q.shape[2] // k_cache.shape[2]
+    k = _expand_kv(k_cache, groups)
+    v = _expand_kv(v_cache, groups)
+
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqnd,bknd->bnqk", q, k).astype(jnp.float32) * scale
+
+    s_max = k.shape[1]
+    valid = jnp.arange(s_max)[None, None, :] <= q_positions[:, :, None]
+    logits = jnp.where(valid[:, None, :, :], logits, NEG_INF)
 
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bnqk,bknd->bqnd", probs, v)

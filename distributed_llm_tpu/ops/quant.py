@@ -1,0 +1,145 @@
+"""Weight-only int8 quantization for the serving path.
+
+Autoregressive decode is HBM-bandwidth-bound: every step streams the full
+weight set through the MXU for one token.  Storing weights as int8 with a
+per-output-channel scale halves that traffic versus bfloat16 (the reference
+leans on Ollama's GGML quantized formats for exactly this reason —
+SURVEY.md §2.1); XLA fuses the dequantize cast into the matmul read, so the
+compute stays MXU-shaped.
+
+Representation: a quantized tensor is the dict ``{"q": int8, "s": scale}``
+with ``w ≈ q * s`` broadcast over the contraction dimension — ``s`` has the
+weight's trailing (output) dimension and the model dtype, so dequantization
+is one cast + multiply.  Per-layer stacked weights [L, in, out] carry
+``s: [L, 1, out]`` and slice cleanly through ``lax.scan``.
+
+Serving-only: the trainer always sees full-precision params, and sharded
+(tp>1) tiers skip quantization — parallel/sharding.py maps full-precision
+leaf paths (a quantized pytree would need its own PartitionSpec map).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+QTensor = Dict[str, jax.Array]   # {"q": int8, "s": model-dtype scale}
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and "q" in w and "s" in w
+
+
+def quantize_tensor(w: jax.Array, contract_axis: int = -2) -> QTensor:
+    """Per-output-channel symmetric int8: scale over the contraction axis.
+
+    ``contract_axis`` is the axis summed over in ``x @ w`` (default -2, the
+    'in' dim of an [in, out] or [L, in, out] weight); each output channel
+    gets max|w|/127.
+    """
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=contract_axis, keepdims=True)
+    # Round the scale to its storage dtype FIRST, then quantize with the
+    # rounded value: for bf16 params the stored scale has 8 mantissa bits,
+    # and quantizing against the unrounded f32 scale would bake a
+    # per-channel multiplicative error into every reconstructed weight.
+    scale = (jnp.maximum(amax, 1e-8) / 127.0).astype(w.dtype)
+    sf = scale.astype(jnp.float32)
+    q = jnp.clip(jnp.round(wf / sf), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale}
+
+
+def dequantize(w: Any) -> jax.Array:
+    if not is_quantized(w):
+        return w
+    return w["q"].astype(w["s"].dtype) * w["s"]
+
+
+def matmul(x: jax.Array, w: Any) -> jax.Array:
+    """``x @ w`` for a plain or quantized weight.
+
+    The int8→dtype cast sits inside the contraction, so XLA reads int8 from
+    HBM and widens in registers; the per-channel scale applies to the
+    (much smaller) output.
+    """
+    if not is_quantized(w):
+        return x @ w
+    y = x @ w["q"].astype(x.dtype)
+    return y * jnp.squeeze(w["s"], axis=-2)
+
+
+def embed_rows(embed: Any, tokens: jax.Array) -> jax.Array:
+    """Embedding-table row lookup for a plain or quantized table [V, H]."""
+    if not is_quantized(embed):
+        return embed[tokens]
+    return embed["q"][tokens].astype(embed["s"].dtype) * jnp.squeeze(
+        embed["s"], axis=-2)
+
+
+def tied_head(embed: Any, hidden: jax.Array) -> jax.Array:
+    """``hidden @ embed.T`` (tied LM head) for plain or quantized table.
+
+    With column scales s[H]: hidden @ (q·s).T == (hidden·s) @ q.T — the
+    scale folds into the small activation instead of the [V, H] table.
+    """
+    if not is_quantized(embed):
+        return (hidden @ embed.T).astype(jnp.float32)
+    scaled = hidden * jnp.squeeze(embed["s"], axis=-2)
+    return (scaled @ embed["q"].T.astype(hidden.dtype)).astype(jnp.float32)
+
+
+# Leaves quantized in a transformer params tree; norms stay full precision
+# (tiny, and rsqrt precision matters).
+_QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def maybe_quantize(params: Dict[str, Any], tier, cfg,
+                   mesh=None) -> Dict[str, Any]:
+    """Apply a tier's quantize mode with central validation — the one
+    entry point every engine uses, so modes and support guards can't drift.
+
+    Unknown modes raise; supported-but-inapplicable combinations (sharded
+    mesh, MoE) WARN and serve full precision, so an operator who asked for
+    int8 can see in the logs that it did not take effect.
+    """
+    import logging
+
+    mode = getattr(tier, "quantize", "none")
+    if mode == "none":
+        return params
+    if mode != "int8":
+        raise ValueError(f"unknown quantize mode {mode!r} "
+                         "(expected 'none' or 'int8')")
+    log = logging.getLogger(__name__)
+    if mesh is not None:
+        log.warning(
+            "tier %s: quantize='int8' ignored — sharded tiers serve full "
+            "precision (sharding rules map full-precision leaf paths)",
+            getattr(tier, "name", "?"))
+        return params
+    if cfg.num_experts > 1:
+        log.warning(
+            "tier %s: quantize='int8' ignored — MoE models serve full "
+            "precision (expert FFN quantization not implemented)",
+            getattr(tier, "name", "?"))
+        return params
+    return jax.jit(quantize_params)(params)
+
+
+def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize a dense-transformer params tree for serving.
+
+    Matmul weights and the (tied) embedding table go int8; norm gains pass
+    through.  Idempotent on already-quantized trees.
+    """
+    out = dict(params)
+    if not is_quantized(params["embed"]):
+        out["embed"] = quantize_tensor(params["embed"])
+    layers = dict(params["layers"])
+    for k in _QUANT_LAYER_KEYS:
+        if k in layers and not is_quantized(layers[k]):
+            layers[k] = quantize_tensor(layers[k])
+    out["layers"] = layers
+    return out

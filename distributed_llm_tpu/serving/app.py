@@ -177,6 +177,8 @@ def create_app(router: Optional[Router] = None,
             engine = mgr._engine          # peek without lazy-starting it
             if engine is not None and hasattr(engine, "phases"):
                 entry["phases"] = engine.phases.summary()
+            if engine is not None and getattr(engine, "prefix_cache", None):
+                entry["prefix_cache"] = engine.prefix_cache.stats()
             tiers[name] = entry
         try:
             cache_stats = router_.query_router.get_cache_stats()

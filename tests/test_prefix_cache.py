@@ -1,0 +1,209 @@
+"""Session KV prefix reuse (engine/prefix_cache.py + chunk_prefill).
+
+The reference re-prefills the whole conversation through Ollama every turn
+(SURVEY.md §3.1); owning the KV cache lets the engine forward only the new
+turn.  These tests pin (a) the chunked-prefill math against the full
+forward, (b) the PrefixCache data structure, and (c) the engine-level
+behavior: identical outputs with reuse on/off, and hits on multi-turn
+histories.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_tpu.config import MODEL_PRESETS, TierConfig
+from distributed_llm_tpu.engine.inference import InferenceEngine
+from distributed_llm_tpu.engine.prefix_cache import PrefixCache
+from distributed_llm_tpu.models import transformer
+
+
+CFG = MODEL_PRESETS["nano_test"]
+
+
+# =============================================================================
+# chunk_prefill numerics
+# =============================================================================
+
+def test_chunk_prefill_matches_full_prefill():
+    params = transformer.init_params(CFG, seed=3)
+    total, split = 48, 32
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, size=total)
+    tokens = jnp.asarray(ids[None], jnp.int32)
+    positions = jnp.arange(total)[None]
+
+    hidden_full, (k_all, v_all) = transformer.prefill(
+        CFG, params, tokens, positions)
+
+    # Seed a cache with the first `split` positions, then chunk the rest.
+    cache = transformer.init_kv_cache(CFG, 1, CFG.max_seq_len)
+    cache = {
+        "k": jax.lax.dynamic_update_slice(
+            cache["k"], k_all[:, :, :split], (0, 0, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(
+            cache["v"], v_all[:, :, :split], (0, 0, 0, 0, 0)),
+    }
+    hidden_chunk, cache = transformer.chunk_prefill(
+        CFG, params, tokens[:, split:], jnp.asarray([split]),
+        jnp.asarray([total]), cache)
+
+    np.testing.assert_allclose(
+        np.asarray(hidden_chunk, np.float32),
+        np.asarray(hidden_full[:, split:], np.float32),
+        atol=5e-2, rtol=5e-2)
+    # The chunk's K/V landed at the right cache positions.
+    np.testing.assert_allclose(
+        np.asarray(cache["k"][:, :, split:total], np.float32),
+        np.asarray(k_all[:, :, split:], np.float32),
+        atol=5e-2, rtol=5e-2)
+
+    # A bucketed attention window covering the sequence gives the same
+    # result as attending the full cache (positions past it are masked).
+    cache2 = transformer.init_kv_cache(CFG, 1, CFG.max_seq_len)
+    cache2 = {
+        "k": jax.lax.dynamic_update_slice(
+            cache2["k"], k_all[:, :, :split], (0, 0, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(
+            cache2["v"], v_all[:, :, :split], (0, 0, 0, 0, 0)),
+    }
+    hidden_win, _ = transformer.chunk_prefill(
+        CFG, params, tokens[:, split:], jnp.asarray([split]),
+        jnp.asarray([total]), cache2, window=64)
+    np.testing.assert_allclose(
+        np.asarray(hidden_win, np.float32),
+        np.asarray(hidden_chunk, np.float32), atol=1e-3, rtol=1e-3)
+
+
+def test_chunk_prefill_start_zero_is_full_prefill():
+    params = transformer.init_params(CFG, seed=4)
+    n = 24
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, 256, size=(1, n)), jnp.int32)
+    hidden_full, _ = transformer.prefill(
+        CFG, params, tokens, jnp.arange(n)[None])
+    cache = transformer.init_kv_cache(CFG, 1, CFG.max_seq_len)
+    hidden_chunk, _ = transformer.chunk_prefill(
+        CFG, params, tokens, jnp.asarray([0]), jnp.asarray([n]), cache)
+    np.testing.assert_allclose(
+        np.asarray(hidden_chunk, np.float32),
+        np.asarray(hidden_full, np.float32), atol=5e-2, rtol=5e-2)
+
+
+# =============================================================================
+# PrefixCache structure
+# =============================================================================
+
+def test_prefix_cache_take_removes_and_caps():
+    pc = PrefixCache(capacity=2, min_prefix=4)
+    pc.put(tuple(range(20)), "cacheA")
+    got, m = pc.take(tuple(range(30)))
+    assert got.cache == "cacheA" and m == 20
+    # removed on take
+    got2, m2 = pc.take(tuple(range(30)))
+    assert got2 is None and m2 == 0
+    assert pc.stats()["hits"] == 1 and pc.stats()["misses"] == 1
+    assert pc.stats()["tokens_saved"] == 20
+
+
+def test_prefix_cache_partial_and_exact_match():
+    pc = PrefixCache(capacity=2, min_prefix=4)
+    pc.put(tuple(range(20)), "A")
+    # identical prompt: matched length capped at len-1 (one query token left)
+    got, m = pc.take(tuple(range(20)))
+    assert got.cache == "A" and m == 19
+    # partial reuse of a longer entry under max_len
+    pc.put(tuple(range(20)), "B")
+    got, m = pc.take(tuple(range(40)), max_len=10)
+    assert got.cache == "B" and m == 10
+
+
+def test_prefix_cache_untake_restores_entry_and_stats():
+    pc = PrefixCache(capacity=2, min_prefix=4)
+    pc.put(tuple(range(20)), "A")
+    e1, m1 = pc.take(tuple(range(30)))
+    assert e1.cache == "A" and m1 == 20
+    pc.untake(e1, m1)
+    st = pc.stats()
+    assert st["hits"] == 0 and st["tokens_saved"] == 0 and st["misses"] == 1
+    # the ORIGINAL entry (full 20 ids) is back
+    e2, m2 = pc.take(tuple(range(30)))
+    assert e2.cache == "A" and m2 == 20
+
+
+def test_prefix_cache_untake_restores_the_callers_entry_only():
+    # Two interleaved take()s must untake independently (threaded serving).
+    pc = PrefixCache(capacity=4, min_prefix=2)
+    pc.put((1, 2, 3, 4), "A")
+    pc.put((7, 8, 9, 10), "B")
+    ea, ma = pc.take((1, 2, 3, 4, 5))
+    eb, mb = pc.take((7, 8, 9, 10, 11))
+    assert ea.cache == "A" and eb.cache == "B"
+    pc.untake(ea, ma)                 # caller A aborts; B stays checked out
+    got, _ = pc.take((7, 8, 9, 10, 11))
+    assert got is None                # B is NOT back
+    got, _ = pc.take((1, 2, 3, 4, 5))
+    assert got.cache == "A"           # A is back, unchanged
+
+
+def test_prefix_cache_mismatch_and_lru():
+    pc = PrefixCache(capacity=2, min_prefix=2)
+    pc.put((1, 2, 3, 4), "A")
+    got, m = pc.take((9, 9, 9, 9, 9))
+    assert got is None
+    pc.put((5, 6, 7, 8), "B")
+    pc.put((7, 8, 9, 10), "C")            # evicts A (capacity 2)
+    got, _ = pc.take((1, 2, 3, 4, 5))
+    assert got is None
+    # extension replaces the shorter entry it extends
+    pc.put((5, 6, 7, 8, 9, 10), "B2")
+    assert pc.stats()["entries"] == 2     # B replaced, C kept
+
+
+# =============================================================================
+# Engine integration
+# =============================================================================
+
+def _tier(**kw):
+    # Buckets must reach max_seq_len: prompts past the largest bucket get
+    # tail-truncated (prepare_prompt), which breaks the prefix property and
+    # turns reuse into a (correct) miss.
+    return TierConfig(name="nano", model_preset="nano_test", tp=1,
+                      max_new_tokens=8, prefill_buckets=(32, 64, 128, 256),
+                      **kw)
+
+
+def test_engine_multiturn_reuses_prefix_and_matches_cold_engine():
+    history = [
+        {"role": "user", "content": "tell me about mountains and rivers"},
+    ]
+    warm = InferenceEngine(_tier(), seed=11)
+    cold = InferenceEngine(_tier(enable_prefix_cache=False), seed=11)
+    assert warm.prefix_cache is not None and cold.prefix_cache is None
+
+    for turn in range(3):
+        r_warm = warm.generate(history)
+        r_cold = cold.generate(history)
+        assert r_warm.text == r_cold.text, f"turn {turn} diverged"
+        history = history + [
+            {"role": "assistant", "content": r_warm.text or "ok"},
+            {"role": "user", "content": f"follow-up question {turn} please"},
+        ]
+
+    st = warm.prefix_cache.stats()
+    assert st["hits"] >= 2, st          # turns 2 and 3 extend turn 1's prompt
+    assert st["tokens_saved"] > 0
+
+
+def test_engine_prefix_reuse_across_sessions_no_crosstalk():
+    eng = InferenceEngine(_tier(), seed=12)
+    a = eng.generate("user: what is the capital of France and why")
+    b = eng.generate("user: explain how tides work in the ocean")
+    # Different prompts: second must not hit the first's entry.
+    assert eng.prefix_cache.stats()["hits"] == 0
+    # Re-running session A's extended history hits its parked entry.
+    eng.generate("user: what is the capital of France and why\n"
+                 "assistant: " + (a.text or "x") + "\nuser: more detail")
+    assert eng.prefix_cache.stats()["hits"] == 1
+    assert b.text is not None

@@ -1,0 +1,126 @@
+"""Weight-only int8 quantization (ops/quant.py).
+
+Pins the dequantization error bound, the algebraic identities used by the
+fused helpers (matmul / embed_rows / tied_head), and the engine-level path:
+a quantized tier serves requests and its logits track full precision.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_llm_tpu.config import MODEL_PRESETS, TierConfig
+from distributed_llm_tpu.engine.inference import InferenceEngine
+from distributed_llm_tpu.models import transformer
+from distributed_llm_tpu.ops import quant
+
+
+def test_quantize_tensor_roundtrip_error():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32)
+    qt = quant.quantize_tensor(w)
+    assert qt["q"].dtype == jnp.int8 and qt["s"].shape == (1, 32)
+    err = np.abs(np.asarray(quant.dequantize(qt), np.float32)
+                 - np.asarray(w))
+    # symmetric per-channel int8: worst case half a quantization step
+    step = np.asarray(qt["s"], np.float32)
+    assert (err <= 0.51 * step).all()
+
+
+def test_matmul_matches_dequantized():
+    k = jax.random.PRNGKey(1)
+    x = jax.random.normal(k, (4, 64), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(2), (64, 32), jnp.float32)
+    qt = quant.quantize_tensor(w)
+    got = np.asarray(quant.matmul(x, qt))
+    want = np.asarray(x @ quant.dequantize(qt))
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_embed_rows_and_tied_head_identities():
+    e = jax.random.normal(jax.random.PRNGKey(3), (48, 16), jnp.float32)
+    qe = quant.quantize_tensor(e)
+    deq = np.asarray(quant.dequantize(qe))
+    toks = jnp.asarray([0, 5, 47])
+    np.testing.assert_allclose(
+        np.asarray(quant.embed_rows(qe, toks)), deq[np.asarray(toks)],
+        atol=1e-5, rtol=1e-5)
+    h = jax.random.normal(jax.random.PRNGKey(4), (2, 16), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(quant.tied_head(qe, h)), np.asarray(h) @ deq.T,
+        atol=1e-4, rtol=1e-4)
+
+
+def test_quantize_params_is_idempotent_and_keeps_norms():
+    cfg = MODEL_PRESETS["nano_test"]
+    params = transformer.init_params(cfg, seed=0)
+    qp = quant.quantize_params(params)
+    assert quant.is_quantized(qp["embed"])
+    assert quant.is_quantized(qp["layers"]["wq"])
+    assert not quant.is_quantized(qp["layers"]["ln1"])
+    assert qp["layers"]["ln1"] is params["layers"]["ln1"]
+    qp2 = quant.quantize_params(qp)
+    assert qp2["embed"] is qp["embed"]
+
+
+def test_quantized_forward_tracks_full_precision():
+    cfg = MODEL_PRESETS["nano_test"]
+    params = transformer.init_params(cfg, seed=5)
+    qp = quant.quantize_params(params)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (1, 16)), jnp.int32)
+    pos = jnp.arange(16)[None]
+    h_full, _ = transformer.prefill(cfg, params, tokens, pos)
+    h_q, _ = transformer.prefill(cfg, qp, tokens, pos)
+    lf = np.asarray(transformer.logits_from_hidden(params, h_full[:, -1]))
+    lq = np.asarray(transformer.logits_from_hidden(qp, h_q[:, -1]))
+    cos = (lf * lq).sum() / (np.linalg.norm(lf) * np.linalg.norm(lq) + 1e-9)
+    assert cos > 0.98, cos
+
+
+def test_unknown_quantize_mode_rejected_everywhere():
+    import pytest
+
+    from distributed_llm_tpu.engine.batching import ContinuousBatchingEngine
+    from distributed_llm_tpu.engine.speculative import SpeculativeEngine
+
+    bad = TierConfig(name="nano", model_preset="nano_test", quantize="int4")
+    with pytest.raises(ValueError, match="quantize"):
+        InferenceEngine(bad, seed=0)
+    with pytest.raises(ValueError, match="quantize"):
+        ContinuousBatchingEngine(
+            TierConfig(name="nano", model_preset="nano_test",
+                       quantize="int4", decode_batch=2, kv_block_size=16),
+            seed=0)
+    with pytest.raises(ValueError, match="quantize"):
+        SpeculativeEngine(
+            TierConfig(name="orin", model_preset="orin_test", quantize="int4"),
+            TierConfig(name="nano", model_preset="nano_test"), seed=0)
+
+
+def test_speculative_engine_quantizes_both_models():
+    from distributed_llm_tpu.engine.speculative import SpeculativeEngine
+
+    eng = SpeculativeEngine(
+        TierConfig(name="orin", model_preset="orin_test", quantize="int8",
+                   max_new_tokens=6),
+        TierConfig(name="nano", model_preset="nano_test"), gamma=2, seed=3)
+    assert quant.is_quantized(eng.params_t["embed"])
+    assert quant.is_quantized(eng.params_d["embed"])
+    r = eng.generate("user: short question about stars")
+    assert r.gen_tokens <= 6
+
+
+def test_engine_serves_quantized_tier():
+    tier = TierConfig(name="nano", model_preset="nano_test", tp=1,
+                      max_new_tokens=6, prefill_buckets=(32, 64, 128, 256),
+                      quantize="int8")
+    eng = InferenceEngine(tier, seed=7)
+    assert quant.is_quantized(eng.params["embed"])
+    r = eng.generate([{"role": "user", "content": "hello quantized world"}])
+    assert r.gen_tokens <= 6 and r.ttft_ms > 0
+    # prefix reuse interoperates with quantized weights
+    r2 = eng.generate([{"role": "user", "content": "hello quantized world"},
+                       {"role": "assistant", "content": r.text or "x"},
+                       {"role": "user", "content": "and a follow-up"}])
+    assert eng.prefix_cache.stats()["hits"] >= 1
+    assert r2.total_ms > 0
