@@ -185,13 +185,16 @@ class TierConfig:
     speculative_gamma: int = 4
     # Session KV prefix reuse (engine/prefix_cache.py): park each request's
     # KV cache and re-prefill only the suffix when the next prompt extends
-    # it (multi-turn chats).  Semantically equivalent to a cold prefill
-    # (same math; kernel rounding may differ where the cold path uses the
-    # Pallas kernels), so it stays on even in benchmark mode; dense models
-    # only.  Each parked entry pins one [L, 1, S_max, N_kv, D] ×2 cache in
-    # HBM (≈1 GB for an 8B-class model at 8k context) — the default of 2
-    # serves the common alternating-session chat pattern while bounding the
-    # steady-state cost; raise it only with measured HBM headroom, or set
+    # it (multi-turn chats).  For DENSE models this is the same math as a
+    # cold prefill (kernel rounding may differ between the Pallas and XLA
+    # paths); for MoE models it is approximate — expert capacity dispatch
+    # sees only the suffix's tokens, so capacity drops can differ from a
+    # full-history prefill (moe.chunk_prefill documents this) — disable it
+    # on MoE tiers where bit-stable replay matters.  Each parked entry pins
+    # one [L, 1, S_max, N_kv, D] ×2 cache in HBM (≈1 GB for an 8B-class
+    # model at 8k context) — the default of 2 serves the common
+    # alternating-session chat pattern while bounding the steady-state
+    # cost; raise it only with measured HBM headroom, or set
     # enable_prefix_cache=False for pure single-turn traffic.
     enable_prefix_cache: bool = True
     prefix_cache_entries: int = 2
@@ -223,12 +226,17 @@ class ClusterConfig:
 
 
 def bench_cluster() -> ClusterConfig:
-    """Cluster sized for the single-chip bench environment."""
+    """Cluster sized for the single-chip bench environment.
+
+    int8 weight-only serving mirrors the reference deployment (Ollama runs
+    GGML-quantized models on the Jetsons) and roughly halves decode's HBM
+    weight traffic on the bandwidth-bound decode loop.
+    """
     return ClusterConfig(
         nano=TierConfig(name="nano", model_preset="nano_bench", tp=1,
-                        max_new_tokens=64),
+                        max_new_tokens=64, quantize="int8"),
         orin=TierConfig(name="orin", model_preset="orin_bench", tp=1,
-                        max_new_tokens=128),
+                        max_new_tokens=128, quantize="int8"),
     )
 
 

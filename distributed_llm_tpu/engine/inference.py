@@ -144,14 +144,15 @@ class InferenceEngine:
         from ..utils.telemetry import PhaseTimer
         self.phases = PhaseTimer()
 
-        # Session KV prefix reuse (engine/prefix_cache.py): dense models
-        # only (moe.py has no chunk_prefill yet).  Each parked entry pins a
-        # full KV cache in HBM, so capacity is a tier knob.
+        # Session KV prefix reuse (engine/prefix_cache.py), both model
+        # families (transformer/moe each export chunk_prefill).  Each
+        # parked entry pins a full KV cache in HBM, so capacity is a tier
+        # knob.
         from .prefix_cache import PrefixCache
         self.prefix_cache = (
             PrefixCache(capacity=tier.prefix_cache_entries)
             if tier.enable_prefix_cache and tier.prefix_cache_entries > 0
-            and self.cfg.num_experts == 1 else None)
+            else None)
 
     # ------------------------------------------------------------------
 
@@ -216,7 +217,7 @@ class InferenceEngine:
 
         def run(params, cache, tokens, start, true_len, rng, temperature):
             b = tokens.shape[0]
-            hidden, cache = transformer.chunk_prefill(
+            hidden, cache = models.model_module(cfg).chunk_prefill(
                 cfg, params, tokens, start, true_len, cache, window=window)
             last = hidden[jnp.arange(b), true_len - start - 1]
             logits = transformer.logits_from_hidden(params, last)
@@ -381,13 +382,22 @@ class InferenceEngine:
         )
 
     def warmup(self) -> None:
-        """Compile the smallest prefill bucket + the decode loop, and (when
-        prefix reuse is on) the suffix-prefill programs for the two smallest
-        buckets — typical chat turns land there, and compiling them now
-        keeps the first cache hit's TTFT at O(delta) instead of paying an
-        XLA trace inside the request."""
+        """Compile EVERY prefill bucket + the decode loop, and (when prefix
+        reuse is on) the suffix-prefill programs for the two smallest
+        buckets — typical chat turns land there.  Compiling everything at
+        startup keeps every request's TTFT free of XLA traces: lazy
+        per-bucket compiles otherwise land inside whichever strategy run
+        first crosses each prompt-length bucket (visible as a TTFT spike on
+        the benchmark's first strategy)."""
         from ..utils.telemetry import PhaseTimer
         self.generate("warmup", max_new_tokens=1)
+        for bucket in self._buckets[1:]:
+            first, _ = self._prefill_fn(bucket)(
+                self.params,
+                jnp.full((1, bucket), self.tokenizer.pad_id, jnp.int32),
+                jnp.asarray([1], np.int32), jax.random.PRNGKey(0),
+                jnp.float32(0.0))
+            jax.block_until_ready(first)
         if self.prefix_cache is not None:
             for sb in self._buckets[:2]:
                 # A short-history hit's window is the bucket above the

@@ -71,10 +71,22 @@ class PrefixCache:
         with self._lock:
             best_i, best_len = -1, 0
             for i, e in enumerate(self._entries):
-                m = min(len(e.ids), cap)
-                if m < max(self.min_prefix, best_len + 1):
+                bound = min(len(e.ids), cap)
+                if bound < max(self.min_prefix, best_len + 1):
                     continue
-                if e.ids[:m] == ids[:m]:
+                # True longest COMMON prefix: an entry that diverges
+                # partway (edited/regenerated turn) still donates the
+                # shared part — KV at position i depends only on tokens
+                # 0..i, so any common prefix is reusable.
+                if e.ids[:bound] == ids[:bound]:
+                    m = bound
+                else:
+                    m = 0
+                    for x, y in zip(e.ids[:bound], ids[:bound]):
+                        if x != y:
+                            break
+                        m += 1
+                if m >= max(self.min_prefix, best_len + 1):
                     best_i, best_len = i, m
             if best_i < 0:
                 self.misses += 1

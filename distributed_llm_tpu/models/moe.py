@@ -166,6 +166,59 @@ def prefill(cfg: ModelConfig, params: Params, tokens: jax.Array,
     return hidden, (k_all, v_all), aux
 
 
+def chunk_prefill(cfg: ModelConfig, params: Params, tokens: jax.Array,
+                  start: jax.Array, true_len: jax.Array,
+                  kv: transformer.KVCache, window: int = 0
+                  ) -> Tuple[jax.Array, transformer.KVCache]:
+    """Prefill a chunk against an existing cache — MoE twin of
+    ``transformer.chunk_prefill`` (same contract; the chunk's tokens go
+    through capacity-dispatch MoE FFN, aux loss dropped as in serving).
+    Enables session KV prefix reuse (engine/prefix_cache.py) for MoE tiers.
+
+    APPROXIMATE vs a cold full-history prefill: expert capacity is computed
+    from the chunk's token count, so which tokens get capacity-dropped can
+    differ from running the whole prompt at once — outputs are close
+    (cosine ≈ 1) but not bit-identical.  Tiers needing exact replay should
+    set enable_prefix_cache=False (see config.TierConfig).
+    """
+    b, s_c = tokens.shape
+    d = cfg.head_dim
+    x = params["embed"][tokens]
+    positions = start[:, None] + jnp.arange(s_c)[None, :]
+    q_pos = jnp.minimum(positions, jnp.maximum(true_len, 1)[:, None] - 1)
+    sin, cos = transformer.rope_sincos(positions, d, cfg.rope_theta)
+
+    def layer(x, scanned):
+        lp, k_cache, v_cache = scanned
+        h_in = transformer.rms_norm(x, lp["ln1"], cfg.norm_eps)
+        q = (h_in @ lp["wq"]).reshape(b, s_c, cfg.num_heads, d)
+        k = (h_in @ lp["wk"]).reshape(b, s_c, cfg.num_kv_heads, d)
+        v = (h_in @ lp["wv"]).reshape(b, s_c, cfg.num_kv_heads, d)
+        q = transformer.apply_rope(q, sin, cos)
+        k = transformer.apply_rope(k, sin, cos)
+
+        def write(cache, new):
+            def one(c, n, p):
+                return jax.lax.dynamic_update_slice(c, n, (p, 0, 0))
+            return jax.vmap(one)(cache, new, start)
+        k_cache = write(k_cache, k)
+        v_cache = write(v_cache, v)
+
+        k_att = k_cache[:, :window] if window else k_cache
+        v_att = v_cache[:, :window] if window else v_cache
+        attn = attention.chunk(q, k_att, v_att, q_pos,
+                               impl=cfg.attention_impl)
+        x = x + attn.reshape(b, s_c, cfg.num_heads * d) @ lp["wo"]
+        ffn_out, _ = moe_ffn_train(
+            cfg, lp, transformer.rms_norm(x, lp["ln2"], cfg.norm_eps))
+        return x + ffn_out, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, (params["layers"], kv["k"], kv["v"]))
+    hidden = transformer.rms_norm(x, params["final_ln"], cfg.norm_eps)
+    return hidden, {"k": k_new, "v": v_new}
+
+
 def decode_step(cfg: ModelConfig, params: Params, token: jax.Array,
                 pos: jax.Array, kv: transformer.KVCache
                 ) -> Tuple[jax.Array, transformer.KVCache]:

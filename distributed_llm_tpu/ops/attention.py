@@ -64,12 +64,13 @@ def decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
 def chunk(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
           q_positions: jax.Array, impl: str = "auto") -> jax.Array:
-    """Dispatching chunked-prefill attention (suffix queries vs full cache).
-
-    XLA-only today: the masked einsum fuses well and GSPMD can shard it; a
-    Pallas variant would mirror flash_decode_attention with a q-block grid.
-    """
-    del impl
+    """Dispatching chunked-prefill attention (suffix queries vs the cache
+    window).  The Pallas path keeps cold prefill and prefix-reuse hits on
+    the same kernel family on TPU (flash recurrence, per-query frontier);
+    the XLA path is the portable/shardable fallback."""
+    if resolve_impl(impl) == "pallas":
+        from .pallas_attention import flash_chunk_attention
+        return flash_chunk_attention(q, k_cache, v_cache, q_positions)
     return chunk_attention(q, k_cache, v_cache, q_positions)
 
 

@@ -140,6 +140,101 @@ flash_causal_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
 # =============================================================================
+# Chunked prefill: a block of suffix queries against the cache window
+# =============================================================================
+
+def _chunk_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, bq: int, bk: int,
+                  head_dim: int, scale: float, w: int):
+    """Flash recurrence over the cache window with a PER-QUERY frontier:
+    query row r attends cache cols ≤ start + r (its absolute position),
+    which covers both the reclaimed prefix and the chunk's own causal part
+    — the suffix-prefill twin of _flash_kernel's block-causal mask.
+    Positions are reconstructed from the per-sequence scalar start (SMEM
+    allows only scalar loads on TPU); the public wrapper enforces the
+    contiguity this assumes."""
+    i = pl.program_id(2)
+    start = pos_ref[0, 0]                                    # scalar in SMEM
+    q = q_ref[0, 0].astype(jnp.float32) * scale              # [BQ, D]
+    # Absolute position of each query row in this block.
+    row_pos = start + i * bq + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, 1), 0)
+
+    acc = jnp.zeros((bq, head_dim), jnp.float32)
+    m = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, 0, pl.ds(j * bk, bk), :]                # [BK, D]
+        v = v_ref[0, 0, pl.ds(j * bk, bk), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+        s = jnp.where(col <= row_pos, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        return acc, m_new, l
+
+    acc, m, l = jax.lax.fori_loop(0, w // bk, body, (acc, m, l))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_chunk_attention(q: jax.Array, k_cache: jax.Array,
+                          v_cache: jax.Array,
+                          q_positions: jax.Array) -> jax.Array:
+    """Drop-in for ops.attention.chunk_attention (q [B,S_c,Nq,D], caches
+    [B,W,Nkv,D] — the caller's bucketed window slice — q_positions [B,S_c]
+    -> [B,S_c,Nq,D]).
+
+    CONTRACT beyond the XLA version: positions must be CONTIGUOUS per
+    sequence (row r at q_positions[:, 0] + r) — the kernel reconstructs
+    them from the scalar start, since TPU SMEM only loads scalars.  This
+    holds for every chunked-prefill caller; rows whose clamped position in
+    chunk_prefill differs (right padding past true_len) get a wider
+    frontier here, which only affects their never-read outputs."""
+    b, s_c, nq, d = q.shape
+    w, nkv = k_cache.shape[1], k_cache.shape[2]
+    groups = nq // nkv
+    bq = min(s_c, 128)
+    bk = min(w, 128)
+    if s_c % bq or w % bk:
+        raise ValueError(
+            f"flash_chunk_attention: chunk {s_c} / window {w} not multiples "
+            f"of the ({bq}, {bk}) blocks — use power-of-two buckets")
+
+    qh = q.transpose(0, 2, 1, 3)                             # [B, Nq, S_c, D]
+    kh = k_cache.transpose(0, 2, 1, 3)                       # [B, Nkv, W, D]
+    vh = v_cache.transpose(0, 2, 1, 3)
+    start32 = q_positions[:, :1].astype(jnp.int32)           # [B, 1] scalars
+
+    kernel = functools.partial(_chunk_kernel, bq=bq, bk=bk, head_dim=d,
+                               scale=d ** -0.5, w=w)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, nq, s_c // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda b_, h, i: (b_, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h, i: (b_, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, w, d), lambda b_, h, i: (b_, h // groups, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, w, d), lambda b_, h, i: (b_, h // groups, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h, i: (b_, h, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        interpret=_interpret(),
+    )(start32, qh, kh, vh)
+    return out.transpose(0, 2, 1, 3)
+
+
+# =============================================================================
 # Decode: masked ("ragged") single-token attention over the KV cache
 # =============================================================================
 

@@ -71,23 +71,26 @@ def matmul(x: jax.Array, w: Any) -> jax.Array:
 
 
 def embed_rows(embed: Any, tokens: jax.Array) -> jax.Array:
-    """Embedding-table row lookup for a plain or quantized table [V, H]."""
+    """Embedding-table row lookup for a plain or quantized table [V, H].
+
+    Quantized tables carry PER-ROW scales (s [V, 1]): each token's row has
+    its own dynamic range, so rare small-norm tokens keep full int8
+    resolution instead of being crushed by a column-wide max."""
     if not is_quantized(embed):
         return embed[tokens]
-    return embed["q"][tokens].astype(embed["s"].dtype) * jnp.squeeze(
-        embed["s"], axis=-2)
+    return embed["q"][tokens].astype(embed["s"].dtype) * embed["s"][tokens]
 
 
 def tied_head(embed: Any, hidden: jax.Array) -> jax.Array:
     """``hidden @ embed.T`` (tied LM head) for plain or quantized table.
 
-    With column scales s[H]: hidden @ (q·s).T == (hidden·s) @ q.T — the
-    scale folds into the small activation instead of the [V, H] table.
-    """
+    With row scales s[V, 1]: hidden @ (q·s).T == (hidden @ q.T) · s.T —
+    the scale folds into the [.., V] logits output, keeping the big matmul
+    int8-read."""
     if not is_quantized(embed):
         return (hidden @ embed.T).astype(jnp.float32)
-    scaled = hidden * jnp.squeeze(embed["s"], axis=-2)
-    return (scaled @ embed["q"].T.astype(hidden.dtype)).astype(jnp.float32)
+    logits = (hidden @ embed["q"].T.astype(hidden.dtype)).astype(jnp.float32)
+    return logits * embed["s"][:, 0].astype(jnp.float32)
 
 
 # Leaves quantized in a transformer params tree; norms stay full precision
@@ -136,7 +139,8 @@ def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
     """
     out = dict(params)
     if not is_quantized(params["embed"]):
-        out["embed"] = quantize_tensor(params["embed"])
+        # Per-ROW scales for the embedding table (see embed_rows/tied_head).
+        out["embed"] = quantize_tensor(params["embed"], contract_axis=-1)
     layers = dict(params["layers"])
     for k in _QUANT_LAYER_KEYS:
         if k in layers and not is_quantized(layers[k]):

@@ -13,7 +13,7 @@ import pytest
 
 from distributed_llm_tpu.ops import attention
 from distributed_llm_tpu.ops.pallas_attention import (
-    flash_causal_attention, flash_decode_attention)
+    flash_causal_attention, flash_chunk_attention, flash_decode_attention)
 
 
 def _rand(key, shape):
@@ -113,6 +113,32 @@ def test_resolve_impl(monkeypatch):
     monkeypatch.delenv("DLLM_ATTENTION")
     with pytest.raises(ValueError):
         attention.resolve_impl("flash")
+
+
+@pytest.mark.parametrize("b,s_c,w,nq,nkv,d", [
+    (1, 64, 128, 4, 4, 16),     # MHA, one kv block
+    (2, 64, 256, 4, 2, 32),     # GQA, multiple kv blocks
+    (1, 128, 256, 8, 2, 16),    # multiple q blocks too
+])
+def test_flash_chunk_matches_xla(b, s_c, w, nq, nkv, d):
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = _rand(ks[0], (b, s_c, nq, d))
+    k = _rand(ks[1], (b, w, nkv, d))
+    v = _rand(ks[2], (b, w, nkv, d))
+    # suffix starting mid-window: query r sits at absolute position start+r
+    start = w - s_c - 5
+    pos = jnp.broadcast_to(start + jnp.arange(s_c)[None], (b, s_c))
+    got = flash_chunk_attention(q, k, v, pos)
+    want = attention.chunk_attention(q, k, v, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-3, rtol=2e-3)
+
+
+def test_flash_chunk_rejects_non_divisible_window():
+    q = jnp.zeros((1, 64, 4, 16))
+    k = v = jnp.zeros((1, 192, 4, 16))
+    with pytest.raises(ValueError, match="not multiples"):
+        flash_chunk_attention(q, k, v, jnp.zeros((1, 64), jnp.int32))
 
 
 def test_flash_rejects_non_divisible_seq():

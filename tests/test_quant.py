@@ -38,7 +38,7 @@ def test_matmul_matches_dequantized():
 
 def test_embed_rows_and_tied_head_identities():
     e = jax.random.normal(jax.random.PRNGKey(3), (48, 16), jnp.float32)
-    qe = quant.quantize_tensor(e)
+    qe = quant.quantize_tensor(e, contract_axis=-1)   # per-row, as served
     deq = np.asarray(quant.dequantize(qe))
     toks = jnp.asarray([0, 5, 47])
     np.testing.assert_allclose(
@@ -48,6 +48,17 @@ def test_embed_rows_and_tied_head_identities():
     np.testing.assert_allclose(
         np.asarray(quant.tied_head(qe, h)), np.asarray(h) @ deq.T,
         atol=1e-4, rtol=1e-4)
+
+
+def test_embed_per_row_scales_preserve_small_norm_rows():
+    # A rare token whose row is 100x smaller than its neighbors must keep
+    # int8 resolution (per-row scales); column scales would crush it.
+    e = np.ones((8, 16), np.float32)
+    e[3] = 0.01 * np.linspace(-1, 1, 16)
+    qe = quant.quantize_tensor(jnp.asarray(e), contract_axis=-1)
+    row = np.asarray(quant.embed_rows(qe, jnp.asarray([3])))[0]
+    rel = np.abs(row - e[3]) / (np.abs(e[3]).max())
+    assert rel.max() < 0.01, rel.max()
 
 
 def test_quantize_params_is_idempotent_and_keeps_norms():
