@@ -174,8 +174,13 @@ class TierConfig:
     # decode_batch > 1 turns on the continuous-batching engine (that many
     # concurrent sequences share one compiled decode step); kv_block_size is
     # its paged KV pool's block granularity (engine/batching.py, paged_kv.py).
+    # decode_steps_per_tick batches that many sequential decode steps into
+    # ONE device call per scheduler tick, amortizing the host↔device round
+    # trip; costs ≤T-1 wasted steps per finishing request and delays new
+    # admissions by <T steps.
     decode_batch: int = 1
     kv_block_size: int = 64
+    decode_steps_per_tick: int = 4
     # Orbax checkpoint directory to serve trained weights from; None =
     # deterministic random init (utils/checkpoint.py load_params_for_tier).
     checkpoint_path: Optional[str] = None

@@ -38,7 +38,8 @@ from ..models import transformer
 from .inference import (GenerationResult, prepare_prompt, trim_at_eos,
                         upgrade_attention_impl)
 from .paged_kv import (BlockAllocator, PagedConfig, TRASH_BLOCK,
-                       decode_step_paged, init_pool, write_prefill_blocks)
+                       chunk_prefill_paged, decode_step_paged, init_pool,
+                       write_prefill_blocks)
 from .tokenizer import ByteTokenizer
 
 History = Union[str, Sequence[Dict[str, Any]]]
@@ -76,6 +77,9 @@ class _Slot:
     temperature: float
     ttft_ms: float
     tokens: List[int] = dataclasses.field(default_factory=list)
+    # Prompt token ids, kept so the slot's prompt blocks can be parked for
+    # prefix reuse when it finishes (engine/prefix_cache.py).
+    prompt_ids: tuple = ()
 
 
 class ContinuousBatchingEngine:
@@ -105,6 +109,7 @@ class ContinuousBatchingEngine:
         self.paged = PagedConfig(block_size=tier.kv_block_size,
                                  max_slots=tier.decode_batch,
                                  max_seq_len=self.cfg.max_seq_len)
+        self.steps_per_tick = max(1, tier.decode_steps_per_tick)
         if params is None:
             init = jax.jit(partial(models.init_params, self.cfg),
                            static_argnames=("seed",))
@@ -121,9 +126,23 @@ class ContinuousBatchingEngine:
         self._temps = np.zeros(b, np.float32)
         self._slots: List[Optional[_Slot]] = [None] * b
 
-        self._prefill_fns: Dict[int, Any] = {}
+        self._prefill_fns: Dict[Any, Any] = {}
         self._writer_fns: Dict[int, Any] = {}
         self._decode_fn = None
+        self._buckets = sorted(set(
+            b for b in tier.prefill_buckets if b <= self.cfg.max_seq_len))
+
+        # Session prefix reuse over pool blocks: a finished request's
+        # prompt blocks are parked (ownership moves to the store) and a
+        # later prompt extending it chunk-prefills only the suffix into
+        # fresh blocks.  Evicted entries return their blocks via on_evict.
+        from .prefix_cache import PrefixCache
+        self.prefix_cache = (
+            PrefixCache(capacity=tier.prefix_cache_entries,
+                        on_evict=lambda e: self.allocator.free(
+                            e.cache["blocks"]))
+            if tier.enable_prefix_cache and tier.prefix_cache_entries > 0
+            else None)
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -154,20 +173,58 @@ class ContinuousBatchingEngine:
         return fn
 
     def _decode_step(self):
-        """One compiled tick for all slots."""
+        """One compiled tick for all slots: ``decode_steps_per_tick``
+        sequential decode steps inside a single device call (lax.scan), so
+        the host↔device round trip — the dominant cost of a tick on a
+        tunneled or busy chip — is amortized over T tokens per slot.
+        Returns tokens [T, B]; the host applies budget/EOS per slot and
+        discards the ≤T-1 overshoot a mid-tick finisher decodes (its writes
+        land in its own still-allocated blocks, freed on finish)."""
         if self._decode_fn is not None:
             return self._decode_fn
         cfg = self.cfg
+        max_pos = cfg.max_seq_len - 1
+        steps = self.steps_per_tick
 
         def run(params, pool, tables, pos, cur, temps, rng):
-            logits, pool = decode_step_paged(cfg, params, cur, pos, pool,
-                                             tables)
-            nxt = _sample_batched(logits, rng, temps)
-            return nxt, pool
+            def step(carry, _):
+                pool, pos, cur, rng = carry
+                logits, pool = decode_step_paged(cfg, params, cur, pos, pool,
+                                                 tables)
+                rng, sub = jax.random.split(rng)
+                nxt = _sample_batched(logits, sub, temps)
+                # Clamp: finished/overshooting slots keep writing into
+                # their own last cell instead of indexing past the table.
+                return (pool, jnp.minimum(pos + 1, max_pos), nxt, rng), nxt
+
+            (pool, _, _, _), toks = jax.lax.scan(
+                step, (pool, pos, cur, rng), None, length=steps)
+            return toks, pool                      # [T, B]
 
         donate = (1,) if jax.default_backend() != "cpu" else ()
         self._decode_fn = jax.jit(run, donate_argnums=donate)
         return self._decode_fn
+
+    def _chunk_prefill_fn(self, bucket: int, window: int):
+        """Per (suffix bucket, window): chunk-prefill a reclaimed prefix's
+        extension straight into pool blocks and sample the first token."""
+        key = ("chunk", bucket, window)
+        if key in self._prefill_fns:
+            return self._prefill_fns[key]
+        cfg = self.cfg
+
+        def run(params, pool, tokens, start, true_len, table, rng, temp):
+            hidden, pool = chunk_prefill_paged(
+                cfg, params, tokens, start, true_len, pool, table, window)
+            last = hidden[0, true_len[0] - start[0] - 1]
+            logits = transformer.logits_from_hidden(params, last)
+            first = _sample_batched(logits[None], rng, temp[None])[0]
+            return first, pool
+
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        fn = jax.jit(run, donate_argnums=donate)
+        self._prefill_fns[key] = fn
+        return fn
 
     def _writer_fn(self, nb: int):
         """Jitted pool scatter (donated pool → in-place page-in), one
@@ -180,6 +237,26 @@ class ContinuousBatchingEngine:
 
     # -- scheduler ---------------------------------------------------------
 
+    def _suffix_window(self, needed: int) -> int:
+        """Smallest bucketed attention window covering ``needed`` positions
+        (multiple of the block size by the bucket/block-size invariant)."""
+        return next((bb for bb in self._buckets if bb >= needed),
+                    self.cfg.max_seq_len)
+
+    def _table_row(self, blocks: List[int]) -> np.ndarray:
+        row = np.full(self.paged.blocks_per_slot, TRASH_BLOCK, np.int32)
+        row[:len(blocks)] = blocks
+        return row
+
+    def _alloc_evicting(self, n_blocks: int) -> Optional[List[int]]:
+        """Allocate, evicting parked prefix entries (LRU) under pressure:
+        live admissions always outrank parked caches."""
+        blocks = self.allocator.alloc(n_blocks)
+        while (blocks is None and self.prefix_cache is not None
+               and self.prefix_cache.pop_oldest() is not None):
+            blocks = self.allocator.alloc(n_blocks)
+        return blocks
+
     def _admit(self, req: _Request, slot_ix: int) -> bool:
         ids, bucket = prepare_prompt(self.tokenizer, req.history,
                                      self.tier.prefill_buckets,
@@ -191,34 +268,84 @@ class ContinuousBatchingEngine:
             budget = min(budget, req.max_new_tokens)
 
         bs = self.paged.block_size
-        need = -(-min(bucket + budget, self.cfg.max_seq_len) // bs)
-        blocks = self.allocator.alloc(need)
-        if blocks is None:
-            return False                     # KV pressure: stay queued
+        max_seq = self.cfg.max_seq_len
 
-        try:
-            tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
-            tokens[0, :n] = ids
-            self._rng, rng = jax.random.split(self._rng)
-            temp = (self.tier.temperature if req.temperature is None
-                    else req.temperature)
+        # Prefix reuse: reclaim a parked entry's blocks as this slot's
+        # leading table rows and prefill only the suffix.
+        reused = None
+        if self.prefix_cache is not None and self._buckets:
+            entry, m = self.prefix_cache.take(
+                ids, max_len=max_seq - self._buckets[0])
+            if entry is not None:
+                suffix = ids[m:]
+                sb = next((bb for bb in self._buckets
+                           if len(suffix) <= bb and m + bb <= max_seq), None)
+                if sb is None:   # no bucket fits — restore entry, go cold
+                    self.prefix_cache.untake(entry, m)
+                else:
+                    # m need not be block-aligned: the chunk overwrites its
+                    # own positions and stale entry KV past n-1 is masked.
+                    reused = (entry, m, suffix, sb)
 
-            first, k_all, v_all = self._prefill_fn(bucket)(
-                self.params, jnp.asarray(tokens), jnp.asarray([n], np.int32),
-                rng, jnp.float32(temp))
-            # Page the prefilled bucket into this slot's leading blocks.
-            nb_prefill = bucket // bs
-            self.pool = self._writer_fn(nb_prefill)(
-                self.pool, jnp.asarray(blocks[:nb_prefill], np.int32),
-                k_all, v_all)
-            first = int(jax.block_until_ready(first))
-        except BaseException:
-            self.allocator.free(blocks)      # don't leak pool blocks
-            raise
+        self._rng, rng = jax.random.split(self._rng)
+        temp = (self.tier.temperature if req.temperature is None
+                else req.temperature)
+
+        if reused is not None:
+            entry, m, suffix, sb = reused
+            owned = list(entry.cache["blocks"])
+            cover = max(m + sb, min(n + budget, max_seq))
+            need = -(-cover // bs)
+            if len(owned) < need:
+                extra = self._alloc_evicting(need - len(owned))
+                if extra is None:
+                    self.prefix_cache.untake(entry, m)
+                    return False             # KV pressure: stay queued
+                owned += extra
+            elif len(owned) > need:
+                self.allocator.free(owned[need:])
+                owned = owned[:need]
+            try:
+                row = self._table_row(owned)
+                tokens = np.full((1, sb), self.tokenizer.pad_id, np.int32)
+                tokens[0, :len(suffix)] = suffix
+                window = self._suffix_window(m + sb)
+                first, self.pool = self._chunk_prefill_fn(sb, window)(
+                    self.params, self.pool, jnp.asarray(tokens),
+                    jnp.asarray([m], np.int32), jnp.asarray([n], np.int32),
+                    jnp.asarray(row), rng, jnp.float32(temp))
+                first = int(jax.block_until_ready(first))
+            except BaseException:
+                self.allocator.free(owned)   # don't leak pool blocks
+                raise
+            blocks = owned
+        else:
+            need = -(-min(bucket + budget, max_seq) // bs)
+            blocks = self._alloc_evicting(need)
+            if blocks is None:
+                return False                 # KV pressure: stay queued
+
+            try:
+                tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+                tokens[0, :n] = ids
+
+                first, k_all, v_all = self._prefill_fn(bucket)(
+                    self.params, jnp.asarray(tokens),
+                    jnp.asarray([n], np.int32), rng, jnp.float32(temp))
+                # Page the prefilled bucket into this slot's leading blocks.
+                nb_prefill = bucket // bs
+                self.pool = self._writer_fn(nb_prefill)(
+                    self.pool, jnp.asarray(blocks[:nb_prefill], np.int32),
+                    k_all, v_all)
+                first = int(jax.block_until_ready(first))
+            except BaseException:
+                self.allocator.free(blocks)  # don't leak pool blocks
+                raise
         ttft_ms = (time.perf_counter() - req.t_submit) * 1000.0
 
         slot = _Slot(request=req, blocks=blocks, prompt_len=n, budget=budget,
-                     temperature=temp, ttft_ms=ttft_ms, tokens=[first])
+                     temperature=temp, ttft_ms=ttft_ms, tokens=[first],
+                     prompt_ids=tuple(ids))
         if req.token_queue is not None:
             req.token_queue.put(first)
         self._slots[slot_ix] = slot
@@ -245,14 +372,25 @@ class ContinuousBatchingEngine:
             ttft_ms=slot.ttft_ms,
             total_ms=(time.perf_counter() - req.t_submit) * 1000.0,
         )
-        self._release(slot_ix)
+        self._release(slot_ix, park=True)
         if req.token_queue is not None:
             req.token_queue.put(None)        # end-of-stream sentinel
         req.done.set()
 
-    def _release(self, slot_ix: int) -> None:
+    def _release(self, slot_ix: int, park: bool = False) -> None:
         slot = self._slots[slot_ix]
-        self.allocator.free(slot.blocks)
+        parked = False
+        if park and self.prefix_cache is not None and slot.prompt_ids:
+            # Park the blocks covering the prompt (ownership moves to the
+            # store); generation-only trailing blocks go back to the pool.
+            keep = -(-slot.prompt_len // self.paged.block_size)
+            if 0 < keep <= len(slot.blocks):
+                parked = self.prefix_cache.put(
+                    slot.prompt_ids, {"blocks": slot.blocks[:keep]})
+                if parked:
+                    self.allocator.free(slot.blocks[keep:])
+        if not parked:
+            self.allocator.free(slot.blocks)
         self._slots[slot_ix] = None
         self._tables[slot_ix] = TRASH_BLOCK
         self._pos[slot_ix] = 0
@@ -297,11 +435,11 @@ class ContinuousBatchingEngine:
 
             try:
                 self._rng, rng = jax.random.split(self._rng)
-                nxt, self.pool = self._decode_step()(
+                toks, self.pool = self._decode_step()(
                     self.params, self.pool, jnp.asarray(self._tables),
                     jnp.asarray(self._pos), jnp.asarray(self._cur),
                     jnp.asarray(self._temps), rng)
-                nxt = np.asarray(jax.block_until_ready(nxt))
+                toks = np.asarray(jax.block_until_ready(toks))   # [T, B]
             except BaseException as exc:
                 # A dead tick must not become a dead scheduler: fail the
                 # in-flight requests and keep serving new ones.
@@ -309,22 +447,25 @@ class ContinuousBatchingEngine:
                     self._fail_slot(ix, exc)
                 continue
 
-            for ix in active:
-                slot = self._slots[ix]
-                tok = int(nxt[ix])
-                slot.tokens.append(tok)
-                if slot.request.token_queue is not None:
-                    slot.request.token_queue.put(tok)
-                self._pos[ix] += 1
-                self._cur[ix] = tok
-                hit_cap = len(slot.tokens) >= slot.budget
-                # PAD ends generation like EOS: trim_at_eos truncates the
-                # result there, so streaming past it would diverge.
-                hit_end = (tok in (self.tokenizer.eos_id,
-                                   self.tokenizer.pad_id)
-                           or self._pos[ix] >= self.cfg.max_seq_len - 1)
-                if hit_cap or hit_end:
-                    self._finish(ix)
+            for t in range(toks.shape[0]):
+                for ix in active:
+                    slot = self._slots[ix]
+                    if slot is None:
+                        continue             # finished at an earlier t
+                    tok = int(toks[t, ix])
+                    slot.tokens.append(tok)
+                    if slot.request.token_queue is not None:
+                        slot.request.token_queue.put(tok)
+                    self._pos[ix] += 1
+                    self._cur[ix] = tok
+                    hit_cap = len(slot.tokens) >= slot.budget
+                    # PAD ends generation like EOS: trim_at_eos truncates
+                    # the result there, so streaming past it would diverge.
+                    hit_end = (tok in (self.tokenizer.eos_id,
+                                       self.tokenizer.pad_id)
+                               or self._pos[ix] >= self.cfg.max_seq_len - 1)
+                    if hit_cap or hit_end:
+                        self._finish(ix)
 
     # -- public surface (InferenceEngine parity) ---------------------------
 
@@ -347,6 +488,8 @@ class ContinuousBatchingEngine:
                 self._thread.join(timeout=5)
                 self._thread = None
             shutdown = RuntimeError(f"tier {self.tier.name}: engine stopped")
+            if self.prefix_cache is not None:
+                self.prefix_cache.clear()    # parked blocks → free list
             for ix, slot in enumerate(self._slots):
                 if slot is not None:
                     self._fail_slot(ix, shutdown)
@@ -414,7 +557,23 @@ class ContinuousBatchingEngine:
         return StreamHandle(deltas(), req)
 
     def warmup(self) -> None:
+        """Compile the decode tick + smallest cold-prefill bucket (via one
+        real request), then the chunk-prefill programs for the two smallest
+        suffix buckets so the first prefix-reuse admission doesn't pay an
+        XLA trace.  Runs before serving traffic: the scheduler is idle
+        (no active slots), so mutating the pool here doesn't race a tick."""
         self.generate("warmup", max_new_tokens=2)
+        if self.prefix_cache is not None and self._buckets:
+            row = np.full(self.paged.blocks_per_slot, TRASH_BLOCK, np.int32)
+            for sb in self._buckets[:2]:
+                window = self._suffix_window(sb + 1)
+                self._rng, rng = jax.random.split(self._rng)
+                first, self.pool = self._chunk_prefill_fn(sb, window)(
+                    self.params, self.pool,
+                    jnp.full((1, sb), self.tokenizer.pad_id, jnp.int32),
+                    jnp.asarray([0], np.int32), jnp.asarray([1], np.int32),
+                    jnp.asarray(row), rng, jnp.float32(0.0))
+                jax.block_until_ready(first)
 
 
 class StreamHandle:

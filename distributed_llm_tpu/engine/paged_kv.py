@@ -104,6 +104,75 @@ def write_prefill_blocks(pool: KVPool, blocks: jax.Array,
             "v": pool["v"].at[:, blocks].set(v_blk)}
 
 
+def chunk_prefill_paged(
+    cfg: ModelConfig,
+    params: transformer.Params,
+    tokens: jax.Array,         # [1, S_c] right-padded suffix chunk
+    start: jax.Array,          # [1] absolute position of the chunk's head
+    true_len: jax.Array,       # [1] total valid length (prefix + suffix)
+    pool: KVPool,
+    table: jax.Array,          # [MB] the slot's block-table row
+    window: int,               # static: attended positions, multiple of bs
+) -> Tuple[jax.Array, KVPool]:
+    """Prefill a prompt SUFFIX directly into pool blocks — the paged twin
+    of ``transformer.chunk_prefill``, enabling session prefix reuse in the
+    continuous-batching engine: a reclaimed entry's blocks become the
+    slot's leading table rows and only the new turn runs here.
+
+    Returns (hidden [1, S_c, H], updated pool).  The chunk's K/V scatter to
+    (table[p//bs], p%bs) per position; attention gathers the first
+    window//bs table blocks, so cost is O(window), not O(max_seq).
+    """
+    b, s_c = tokens.shape
+    d = cfg.head_dim
+    bs = pool["k"].shape[2]
+    wb = window // bs
+
+    x = quant.embed_rows(params["embed"], tokens)            # [1, S_c, H]
+    positions = start[:, None] + jnp.arange(s_c)[None, :]    # [1, S_c]
+    q_pos = jnp.minimum(positions, jnp.maximum(true_len, 1)[:, None] - 1)
+    sin, cos = transformer.rope_sincos(positions, d, cfg.rope_theta)
+
+    flat_pos = positions[0]                                  # [S_c]
+    blk = table[flat_pos // bs]                              # [S_c]
+    off = flat_pos % bs
+
+    def layer(x, scanned):
+        lp, k_pool, v_pool = scanned
+        h_in = transformer.rms_norm(x, lp["ln1"], cfg.norm_eps)
+        q = quant.matmul(h_in, lp["wq"]).reshape(b, s_c, cfg.num_heads, d)
+        k = quant.matmul(h_in, lp["wk"]).reshape(b, s_c, cfg.num_kv_heads, d)
+        v = quant.matmul(h_in, lp["wv"]).reshape(b, s_c, cfg.num_kv_heads, d)
+        q = transformer.apply_rope(q, sin, cos)
+        k = transformer.apply_rope(k, sin, cos)
+
+        # Scatter the chunk's K/V to its (block, offset) cells.
+        k_pool = k_pool.at[blk, off].set(k[0])
+        v_pool = v_pool.at[blk, off].set(v[0])
+
+        # Gather the attended window in logical order.
+        k_seq = k_pool[table[:wb]].reshape(1, window, cfg.num_kv_heads, d)
+        v_seq = v_pool[table[:wb]].reshape(1, window, cfg.num_kv_heads, d)
+        attn = attention.chunk(q, k_seq, v_seq, q_pos,
+                               impl=cfg.attention_impl)
+        x = x + quant.matmul(attn.reshape(b, s_c, cfg.num_heads * d),
+                             lp["wo"])
+        h_ffn = transformer.rms_norm(x, lp["ln2"], cfg.norm_eps)
+        if cfg.num_experts > 1:
+            from ..models.moe import moe_ffn_train
+            ffn_out, _ = moe_ffn_train(cfg, lp, h_ffn)
+            x = x + ffn_out
+        else:
+            x = x + transformer._swiglu(h_ffn, lp["w_gate"], lp["w_up"],
+                                        lp["w_down"])
+        return x, (k_pool, v_pool)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, (params["layers"], pool["k"], pool["v"]))
+    hidden = transformer.rms_norm(x, params["final_ln"], cfg.norm_eps)
+    return hidden, {"k": k_new, "v": v_new}
+
+
 def decode_step_paged(
     cfg: ModelConfig,
     params: transformer.Params,

@@ -40,9 +40,15 @@ class PrefixEntry:
 class PrefixCache:
     """Small LRU of (token-id prefix → KV cache) for one engine."""
 
-    def __init__(self, capacity: int = 4, min_prefix: int = 16):
+    def __init__(self, capacity: int = 4, min_prefix: int = 16,
+                 on_evict=None):
+        """``on_evict(entry)`` is called for every entry dropped by put()/
+        clear()/pop_oldest() — the paged engine uses it to return the
+        entry's pool blocks to the allocator (HBM-array entries just get
+        garbage-collected)."""
         self.capacity = capacity
         self.min_prefix = min_prefix
+        self.on_evict = on_evict
         self._entries: List[PrefixEntry] = []   # LRU order: oldest first
         self._lock = threading.Lock()
         self.hits = 0
@@ -103,27 +109,51 @@ class PrefixCache:
         match its whole length — and reverses the hit accounting.  Only the
         entry returned by the caller's own take() may be passed, so
         concurrent take/untake pairs on different entries cannot cross."""
+        evicted: List[PrefixEntry] = []
         with self._lock:
             self.hits -= 1
             self.tokens_saved -= matched_len
             self.misses += 1
             self._entries.append(entry)
             while len(self._entries) > self.capacity:
-                self._entries.pop(0)
+                evicted.append(self._entries.pop(0))
+        for e in evicted:          # same drop contract as put()/clear()
+            if self.on_evict is not None:
+                self.on_evict(e)
 
-    def put(self, ids: Sequence[int], cache: Any) -> None:
-        """Park a cache whose first len(ids) positions hold KV for ``ids``."""
+    def put(self, ids: Sequence[int], cache: Any) -> bool:
+        """Park a cache whose first len(ids) positions hold KV for ``ids``.
+        Returns False (and does not take ownership) for too-short prompts —
+        paged callers must free the blocks themselves in that case."""
         if len(ids) < self.min_prefix:
-            return
+            return False
         ids = tuple(ids)
+        evicted: List[PrefixEntry] = []
         with self._lock:
             # Replace any entry this one extends (or duplicates): the longer
             # prefix serves every prompt the shorter one could.
-            self._entries = [
-                e for e in self._entries if ids[:len(e.ids)] != e.ids]
-            self._entries.append(PrefixEntry(ids, cache))
-            while len(self._entries) > self.capacity:
-                self._entries.pop(0)
+            keep = []
+            for e in self._entries:
+                (evicted if ids[:len(e.ids)] == e.ids else keep).append(e)
+            keep.append(PrefixEntry(ids, cache))
+            while len(keep) > self.capacity:
+                evicted.append(keep.pop(0))
+            self._entries = keep
+        for e in evicted:
+            if self.on_evict is not None:
+                self.on_evict(e)
+        return True
+
+    def pop_oldest(self) -> Optional[PrefixEntry]:
+        """Evict (and return, after on_evict) the LRU entry — used by the
+        paged engine to reclaim pool blocks under admission pressure."""
+        with self._lock:
+            if not self._entries:
+                return None
+            entry = self._entries.pop(0)
+        if self.on_evict is not None:
+            self.on_evict(entry)
+        return entry
 
     def stats(self) -> dict:
         with self._lock:
@@ -136,4 +166,7 @@ class PrefixCache:
 
     def clear(self) -> None:
         with self._lock:
-            self._entries.clear()
+            entries, self._entries = self._entries, []
+        for e in entries:
+            if self.on_evict is not None:
+                self.on_evict(e)

@@ -153,7 +153,8 @@ def _chunk_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, bq: int, bk: int,
     allows only scalar loads on TPU); the public wrapper enforces the
     contiguity this assumes."""
     i = pl.program_id(2)
-    start = pos_ref[0, 0]                                    # scalar in SMEM
+    # Whole [B, 1] array in SMEM; scalar-load this sequence's start.
+    start = pos_ref[pl.program_id(0), 0]
     q = q_ref[0, 0].astype(jnp.float32) * scale              # [BQ, D]
     # Absolute position of each query row in this block.
     row_pos = start + i * bq + jax.lax.broadcasted_iota(
@@ -217,7 +218,7 @@ def flash_chunk_attention(q: jax.Array, k_cache: jax.Array,
         kernel,
         grid=(b, nq, s_c // bq),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda b_, h, i: (b_, 0),
+            pl.BlockSpec((b, 1), lambda b_, h, i: (0, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, bq, d), lambda b_, h, i: (b_, h, i, 0),
                          memory_space=pltpu.VMEM),
@@ -239,7 +240,9 @@ def flash_chunk_attention(q: jax.Array, k_cache: jax.Array,
 # =============================================================================
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float):
-    p = pos_ref[0, 0]                     # scalars are (1,1) 2D in SMEM
+    # pos_ref holds the WHOLE [B, 1] array in SMEM (a (1,1) block would
+    # violate Mosaic's block-shape rule for B>1); scalar-load our row.
+    p = pos_ref[pl.program_id(0), 0]
     q = q_ref[0, 0].astype(jnp.float32) * scale               # [G, D]
     k = k_ref[0, 0]                                           # [S, D]
     v = v_ref[0, 0]
@@ -274,7 +277,7 @@ def flash_decode_attention(q: jax.Array, k_cache: jax.Array,
         kernel,
         grid=(b, nkv),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda b_, h: (b_, 0),
+            pl.BlockSpec((b, 1), lambda b_, h: (0, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, groups, d), lambda b_, h: (b_, h, 0, 0),
                          memory_space=pltpu.VMEM),

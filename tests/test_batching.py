@@ -156,3 +156,101 @@ def test_mesh_not_supported():
     mesh = jax.sharding.Mesh(devs, ("tp",))
     with pytest.raises(NotImplementedError):
         ContinuousBatchingEngine(_tier(), mesh=mesh)
+
+
+def test_multi_step_tick_respects_budget_and_matches_single_step():
+    """T decode steps per device call must not change outputs: budgets are
+    enforced on host (overshoot discarded) and greedy tokens are identical
+    to a 1-step-per-tick engine."""
+    one = ContinuousBatchingEngine(_tier(decode_steps_per_tick=1), seed=21)
+    multi = ContinuousBatchingEngine(_tier(decode_steps_per_tick=4), seed=21)
+    try:
+        for budget in (2, 5, 8):             # not multiples of T=4
+            q = f"user: count some things please {budget}"
+            r1 = one.generate(q, max_new_tokens=budget)
+            r4 = multi.generate(q, max_new_tokens=budget)
+            assert r1.token_ids == r4.token_ids, (budget, r1, r4)
+            assert r4.gen_tokens <= budget
+    finally:
+        one.stop()
+        multi.stop()
+
+
+def test_multi_step_tick_concurrent_requests_complete():
+    engine = ContinuousBatchingEngine(
+        _tier(decode_batch=3, decode_steps_per_tick=4), seed=22)
+    try:
+        reqs = [engine.submit(f"user: question number {i}", max_new_tokens=6)
+                for i in range(6)]
+        for r in reqs:
+            assert r.done.wait(timeout=120)
+            assert r.error is None
+            assert 1 <= r.result.gen_tokens <= 6
+    finally:
+        engine.stop()
+
+
+def test_batched_prefix_reuse_multiturn_matches_cold_sequential():
+    """Multi-turn through the batching engine must reuse parked prompt
+    blocks (hits > 0) and stay token-identical to a cold sequential
+    engine — paging + reuse change where K/V live, not the math."""
+    import dataclasses
+
+    tier = _tier(decode_batch=2, prefill_buckets=(32, 64, 128, 256))
+    batched = ContinuousBatchingEngine(tier, seed=31)
+    cold = InferenceEngine(
+        dataclasses.replace(tier, enable_prefix_cache=False), seed=31)
+    try:
+        history = [{"role": "user", "content": "tell me about rivers"}]
+        for turn in range(3):
+            rb = batched.generate(history)
+            rc = cold.generate(history)
+            assert rb.token_ids == rc.token_ids, (turn, rb, rc)
+            history = history + [
+                {"role": "assistant", "content": rb.text or "ok"},
+                {"role": "user", "content": f"more please {turn}"}]
+        st = batched.prefix_cache.stats()
+        assert st["hits"] >= 2, st
+    finally:
+        batched.stop()
+
+
+def test_batched_prefix_reuse_evicts_under_pool_pressure():
+    """Parked entries must never starve admissions: when the allocator
+    runs dry, LRU parked blocks are reclaimed and every request
+    completes."""
+    tier = _tier(decode_batch=2, prefill_buckets=(32, 64),
+                 prefix_cache_entries=4)
+    engine = ContinuousBatchingEngine(tier, seed=33)
+    try:
+        # Fill the store with distinct prompts (each parks blocks)...
+        for i in range(4):
+            engine.generate(f"user: unique warm prompt number {i} padded out",
+                            max_new_tokens=3)
+        assert engine.prefix_cache.stats()["entries"] >= 1
+        # ...then flood with concurrent requests needing all pool blocks.
+        reqs = [engine.submit(f"user: flood question {i} with extra words",
+                              max_new_tokens=6) for i in range(5)]
+        for r in reqs:
+            assert r.done.wait(timeout=120)
+            assert r.error is None and r.result.gen_tokens >= 1
+    finally:
+        engine.stop()
+
+
+def test_batched_prefix_park_returns_trailing_blocks():
+    """After a clean finish the slot's generation-only blocks return to
+    the allocator; only ceil(prompt/bs) blocks stay parked."""
+    tier = _tier(decode_batch=1, prefill_buckets=(32, 64),
+                 max_new_tokens=8)
+    engine = ContinuousBatchingEngine(tier, seed=35)
+    try:
+        total = engine.allocator.available
+        engine.generate("user: " + "a" * 40, max_new_tokens=8)  # 47+1 ids
+        parked = engine.prefix_cache.stats()["entries"]
+        assert parked == 1
+        held = total - engine.allocator.available
+        bs = engine.paged.block_size
+        assert held == -(-48 // bs), held    # ceil(prompt/bs) blocks only
+    finally:
+        engine.stop()
