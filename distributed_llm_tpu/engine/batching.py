@@ -238,10 +238,12 @@ class ContinuousBatchingEngine:
     # -- scheduler ---------------------------------------------------------
 
     def _suffix_window(self, needed: int) -> int:
-        """Smallest bucketed attention window covering ``needed`` positions
-        (multiple of the block size by the bucket/block-size invariant)."""
+        """Smallest bucketed attention window covering ``needed`` positions.
+        Buckets are validated multiples of the block size; the fallback is
+        the table's full span (blocks_per_slot·bs — max_seq_len itself may
+        not divide evenly, and chunk_prefill_paged gathers whole blocks)."""
         return next((bb for bb in self._buckets if bb >= needed),
-                    self.cfg.max_seq_len)
+                    self.paged.blocks_per_slot * self.paged.block_size)
 
     def _table_row(self, blocks: List[int]) -> np.ndarray:
         row = np.full(self.paged.blocks_per_slot, TRASH_BLOCK, np.int32)
@@ -271,21 +273,12 @@ class ContinuousBatchingEngine:
         max_seq = self.cfg.max_seq_len
 
         # Prefix reuse: reclaim a parked entry's blocks as this slot's
-        # leading table rows and prefill only the suffix.
-        reused = None
-        if self.prefix_cache is not None and self._buckets:
-            entry, m = self.prefix_cache.take(
-                ids, max_len=max_seq - self._buckets[0])
-            if entry is not None:
-                suffix = ids[m:]
-                sb = next((bb for bb in self._buckets
-                           if len(suffix) <= bb and m + bb <= max_seq), None)
-                if sb is None:   # no bucket fits — restore entry, go cold
-                    self.prefix_cache.untake(entry, m)
-                else:
-                    # m need not be block-aligned: the chunk overwrites its
-                    # own positions and stale entry KV past n-1 is masked.
-                    reused = (entry, m, suffix, sb)
+        # leading table rows and prefill only the suffix (shared matching
+        # policy with the contiguous engine; m need not be block-aligned —
+        # the chunk overwrites its own positions and stale entry KV past
+        # n-1 is masked).
+        from .prefix_cache import select_reuse
+        reused = select_reuse(self.prefix_cache, ids, self._buckets, max_seq)
 
         self._rng, rng = jax.random.split(self._rng)
         temp = (self.tier.temperature if req.temperature is None
@@ -349,9 +342,7 @@ class ContinuousBatchingEngine:
         if req.token_queue is not None:
             req.token_queue.put(first)
         self._slots[slot_ix] = slot
-        row = np.full(self.paged.blocks_per_slot, TRASH_BLOCK, np.int32)
-        row[:len(blocks)] = blocks
-        self._tables[slot_ix] = row
+        self._tables[slot_ix] = self._table_row(blocks)
         self._pos[slot_ix] = n               # first generated token's pos
         self._cur[slot_ix] = first
         self._temps[slot_ix] = temp
@@ -564,7 +555,7 @@ class ContinuousBatchingEngine:
         (no active slots), so mutating the pool here doesn't race a tick."""
         self.generate("warmup", max_new_tokens=2)
         if self.prefix_cache is not None and self._buckets:
-            row = np.full(self.paged.blocks_per_slot, TRASH_BLOCK, np.int32)
+            row = self._table_row([])
             for sb in self._buckets[:2]:
                 window = self._suffix_window(sb + 1)
                 self._rng, rng = jax.random.split(self._rng)

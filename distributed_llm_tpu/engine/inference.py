@@ -133,12 +133,20 @@ class InferenceEngine:
         self.params = maybe_quantize(params, tier, self.cfg, mesh=mesh)
 
         self._prefill_fns: Dict[Any, Any] = {}
-        self._decode_fn = None
+        self._decode_fns: Dict[int, Any] = {}
+        self._grow_fns: Dict[Any, Any] = {}
         self._max_seq = self.cfg.max_seq_len
         # Usable prefill buckets, ascending — the single source for both
         # generate()'s suffix-bucket choice and warmup()'s precompiles.
         self._buckets = sorted(set(
             b for b in tier.prefill_buckets if b <= self._max_seq))
+        # Bucketed KV-cache lengths: decode attention reads the WHOLE cache
+        # every step, so sizing it to the conversation (next candidate ≥
+        # prompt + decode cap) instead of max_seq_len cuts decode's HBM
+        # traffic up to max_seq/256× for short chats.  A coarse ladder keeps
+        # the compile count at ≤3 decode programs per engine.
+        self._cache_lens = sorted(
+            {c for c in (256, 1024) if c < self._max_seq} | {self._max_seq})
         # Per-phase wall-time attribution (tokenize/prefill/decode/detok) —
         # the jax.profiler-adjacent view surfaced at GET /stats (§5.1/§5.5).
         from ..utils.telemetry import PhaseTimer
@@ -172,11 +180,18 @@ class InferenceEngine:
 
     # -- compiled stages ---------------------------------------------------
 
-    def _prefill_fn(self, bucket: int):
-        """Jitted per bucket: embed+forward the padded prompt, seed the
-        fixed-size KV cache, sample the first token."""
-        if bucket in self._prefill_fns:
-            return self._prefill_fns[bucket]
+    def _pick_cache_len(self, needed: int) -> int:
+        """Smallest cache-length candidate covering ``needed`` positions."""
+        return next(c for c in self._cache_lens if c >= min(needed,
+                                                            self._max_seq))
+
+    def _prefill_fn(self, bucket: int, cache_len: int):
+        """Jitted per (prompt bucket, cache length): embed+forward the
+        padded prompt, seed a cache sized for this conversation, sample the
+        first token."""
+        key = (bucket, cache_len)
+        if key in self._prefill_fns:
+            return self._prefill_fns[key]
 
         cfg = self.cfg
 
@@ -189,7 +204,7 @@ class InferenceEngine:
             logits = transformer.logits_from_hidden(params, last)
             first = sample_token_dynamic(logits, rng, temperature)
 
-            cache = transformer.init_kv_cache(cfg, b, self._max_seq)
+            cache = transformer.init_kv_cache(cfg, b, cache_len)
             cache = {
                 "k": jax.lax.dynamic_update_slice(
                     cache["k"], k_all, (0, 0, 0, 0, 0)),
@@ -199,8 +214,29 @@ class InferenceEngine:
             return first, cache
 
         fn = jax.jit(run)
-        self._prefill_fns[bucket] = fn
+        self._prefill_fns[key] = fn
         return fn
+
+    def _grow_fn(self, src_len: int, dst_len: int):
+        """Jitted per pair: copy a parked cache into a longer one (prefix
+        reuse across conversations that outgrew the parked length)."""
+        key = ("grow", src_len, dst_len)
+        if key not in self._grow_fns:
+            cfg = self.cfg
+
+            def run(cache):
+                b = cache["k"].shape[1]
+                big = transformer.init_kv_cache(cfg, b, dst_len)
+                return {
+                    "k": jax.lax.dynamic_update_slice(
+                        big["k"], cache["k"], (0, 0, 0, 0, 0)),
+                    "v": jax.lax.dynamic_update_slice(
+                        big["v"], cache["v"], (0, 0, 0, 0, 0)),
+                }
+
+            donate = (0,) if jax.default_backend() != "cpu" else ()
+            self._grow_fns[key] = jax.jit(run, donate_argnums=donate)
+        return self._grow_fns[key]
 
     def _suffix_prefill_fn(self, bucket: int, window: int):
         """Jitted per (suffix bucket, attention window): forward only a
@@ -234,10 +270,12 @@ class InferenceEngine:
         positions (falls back to the full sequence)."""
         return next((b for b in self._buckets if b >= needed), self._max_seq)
 
-    def _decode_loop(self):
-        """Jitted once: the full generation loop as one device call."""
-        if self._decode_fn is not None:
-            return self._decode_fn
+    def _decode_loop(self, cache_len: int):
+        """Jitted per cache length: the full generation loop as one device
+        call (the loop body's shapes are fixed by the cache, so one program
+        serves every conversation at that length)."""
+        if cache_len in self._decode_fns:
+            return self._decode_fns[cache_len]
 
         cfg = self.cfg
         eos = self.tokenizer.eos_id
@@ -280,8 +318,8 @@ class InferenceEngine:
         # Donate the KV cache so the loop updates it in place in HBM.
         # (CPU can't donate these buffers and warns, so gate on backend.)
         donate = (1,) if jax.default_backend() != "cpu" else ()
-        self._decode_fn = jax.jit(run, donate_argnums=donate)
-        return self._decode_fn
+        self._decode_fns[cache_len] = jax.jit(run, donate_argnums=donate)
+        return self._decode_fns[cache_len]
 
     # -- host orchestration ------------------------------------------------
 
@@ -319,26 +357,31 @@ class InferenceEngine:
         # of this prompt and forward only the suffix (O(delta) prefill
         # instead of O(history) — the reference re-prefills everything
         # through Ollama every turn, SURVEY.md §3.1).
-        reused = None
-        if self.prefix_cache is not None and self._buckets:
-            entry, m = self.prefix_cache.take(
-                ids, max_len=self._max_seq - self._buckets[0])
-            if entry is not None:
-                suffix = ids[m:]
-                sb = next((b for b in self._buckets
-                           if len(suffix) <= b and m + b <= self._max_seq),
-                          None)
-                if sb is None:   # no bucket fits — restore entry, prefill in full
-                    self.prefix_cache.untake(entry, m)
-                else:
-                    reused = (entry.cache, m, suffix, sb)
+        from .prefix_cache import select_reuse
+        sel = select_reuse(self.prefix_cache, ids, self._buckets,
+                           self._max_seq)
+        reused = (sel[0].cache, sel[1], sel[2], sel[3]) if sel else None
+
+        # Size the cache for this conversation, not the model maximum —
+        # decode streams the whole cache per step.  Sized with the TIER's
+        # decode cap (not the per-request override) so repeat prompt shapes
+        # always reuse the warmed compiles.
+        needed = max(n + self.tier.max_new_tokens, bucket)
+        if reused is not None:
+            needed = max(needed, reused[1] + reused[3])     # m + sb
+        cache_len = self._pick_cache_len(needed)
 
         with self.phases.phase("prefill"):
             if reused is not None:
                 cache0, m, suffix, sb = reused
+                parked_len = int(cache0["k"].shape[2])
+                if parked_len < cache_len:
+                    cache0 = self._grow_fn(parked_len, cache_len)(cache0)
+                else:
+                    cache_len = parked_len    # bigger parked cache: keep it
                 tokens = np.full((1, sb), self.tokenizer.pad_id, np.int32)
                 tokens[0, :len(suffix)] = suffix
-                window = self._suffix_window(m + sb)
+                window = min(self._suffix_window(m + sb), cache_len)
                 first, cache = self._suffix_prefill_fn(sb, window)(
                     self.params, cache0, jnp.asarray(tokens),
                     jnp.asarray([m], np.int32), jnp.asarray(true_len),
@@ -346,14 +389,19 @@ class InferenceEngine:
             else:
                 tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
                 tokens[0, :n] = ids
-                first, cache = self._prefill_fn(bucket)(
+                first, cache = self._prefill_fn(bucket, cache_len)(
                     self.params, jnp.asarray(tokens), jnp.asarray(true_len),
                     rng1, temp)
             first = jax.block_until_ready(first)
         ttft_ms = (time.perf_counter() - t0) * 1000.0
 
+        # The decode cap must fit the sized cache (it always does when the
+        # cache was sized fresh; a reclaimed shorter conversation's cache
+        # was sized with the same tier cap).
+        budget = min(budget, cache_len - n)
+
         with self.phases.phase("decode"):
-            out, steps, cache = self._decode_loop()(
+            out, steps, cache = self._decode_loop(cache_len)(
                 self.params, cache, first, jnp.asarray(true_len), rng2, temp,
                 jnp.int32(budget))
             out = np.asarray(jax.block_until_ready(out))[0]
@@ -391,20 +439,36 @@ class InferenceEngine:
         the benchmark's first strategy)."""
         from ..utils.telemetry import PhaseTimer
         self.generate("warmup", max_new_tokens=1)
+        cap = self.tier.max_new_tokens
+        # The warmup generate above recorded exactly which decode lengths
+        # are compiled — seed from that, not a re-derivation that can skew.
+        seen_lens = set(self._decode_fns)
         for bucket in self._buckets[1:]:
-            first, _ = self._prefill_fn(bucket)(
+            cache_len = self._pick_cache_len(max(bucket + cap, bucket))
+            first, cache = self._prefill_fn(bucket, cache_len)(
                 self.params,
                 jnp.full((1, bucket), self.tokenizer.pad_id, jnp.int32),
                 jnp.asarray([1], np.int32), jax.random.PRNGKey(0),
                 jnp.float32(0.0))
-            jax.block_until_ready(first)
+            if cache_len not in seen_lens:   # compile this length's decode
+                seen_lens.add(cache_len)
+                out, _, _ = self._decode_loop(cache_len)(
+                    self.params, cache, jnp.asarray([0], np.int32),
+                    jnp.asarray([1], np.int32), jax.random.PRNGKey(0),
+                    jnp.float32(0.0), jnp.int32(1))
+                jax.block_until_ready(out)
+            else:
+                jax.block_until_ready(first)
         if self.prefix_cache is not None:
             for sb in self._buckets[:2]:
                 # A short-history hit's window is the bucket above the
-                # suffix bucket (prefix m + suffix sb rounds up one step).
+                # suffix bucket (prefix m + suffix sb rounds up one step),
+                # against the cache length such a conversation would use.
                 window = self._suffix_window(sb + 1)
-                cache = transformer.init_kv_cache(self.cfg, 1, self._max_seq)
-                first, _ = self._suffix_prefill_fn(sb, window)(
+                cache_len = self._pick_cache_len(max(sb + 1 + cap, window))
+                cache = transformer.init_kv_cache(self.cfg, 1, cache_len)
+                first, _ = self._suffix_prefill_fn(
+                    sb, min(window, cache_len))(
                     self.params, cache,
                     jnp.full((1, sb), self.tokenizer.pad_id, jnp.int32),
                     jnp.asarray([0], jnp.int32), jnp.asarray([1], jnp.int32),

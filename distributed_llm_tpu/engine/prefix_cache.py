@@ -37,6 +37,29 @@ class PrefixEntry:
     cache: Any               # KVCache pytree [L,1,S_max,N_kv,D]
 
 
+def select_reuse(store: "Optional[PrefixCache]", ids: Sequence[int],
+                 buckets: Sequence[int], max_seq: int):
+    """Shared take + suffix-bucket policy for both engines.
+
+    Returns (entry, matched_len, suffix_ids, suffix_bucket) when a parked
+    prefix can be extended within ``buckets``/``max_seq``, else None (any
+    taken entry is restored).  Keeping the policy here means the contiguous
+    and paged engines cannot drift apart on matching rules.
+    """
+    if store is None or not buckets:
+        return None
+    entry, m = store.take(ids, max_len=max_seq - buckets[0])
+    if entry is None:
+        return None
+    suffix = ids[m:]
+    sb = next((b for b in buckets
+               if len(suffix) <= b and m + b <= max_seq), None)
+    if sb is None:       # no bucket fits — restore entry, caller goes cold
+        store.untake(entry, m)
+        return None
+    return entry, m, suffix, sb
+
+
 class PrefixCache:
     """Small LRU of (token-id prefix → KV cache) for one engine."""
 

@@ -151,5 +151,28 @@ def test_bucket_selection(engine):
 def test_prefill_jit_cached_per_bucket(engine):
     engine.generate("user: aaaa")
     engine.generate("user: " + "a" * 40)
-    assert 16 in engine._prefill_fns and 32 in engine._prefill_fns
-    assert engine._decode_fn is not None   # decode loop compiled once
+    keyed = {k[0] for k in engine._prefill_fns if isinstance(k, tuple)
+             and isinstance(k[0], int)}
+    assert 16 in keyed and 32 in keyed
+    # one decode program per cache length; both prompts share one length
+    assert len(engine._decode_fns) == 1
+
+
+def test_grow_fn_copies_prefix_and_zero_fills():
+    from distributed_llm_tpu.config import TierConfig
+    from distributed_llm_tpu.engine.inference import InferenceEngine
+    from distributed_llm_tpu.models import transformer
+    import jax.numpy as jnp
+    import numpy as np
+
+    tier = TierConfig(name="nano", model_preset="nano_test",
+                      max_new_tokens=8, prefill_buckets=(16, 32, 64))
+    eng = InferenceEngine(tier, seed=0)
+    small = transformer.init_kv_cache(eng.cfg, 1, 32)
+    small = {"k": small["k"].at[:, :, :5].set(1.0),
+             "v": small["v"].at[:, :, :5].set(2.0)}
+    big = eng._grow_fn(32, 64)(small)
+    assert big["k"].shape[2] == 64
+    np.testing.assert_array_equal(np.asarray(big["k"][:, :, :5]), 1.0)
+    np.testing.assert_array_equal(np.asarray(big["v"][:, :, :5]), 2.0)
+    np.testing.assert_array_equal(np.asarray(big["k"][:, :, 32:]), 0.0)
