@@ -426,8 +426,16 @@ class ContinuousBatchingEngine:
 
             try:
                 self._rng, rng = jax.random.split(self._rng)
+                # Bound the per-step pool gather by a bucketed high-water
+                # mark over active slots (positions written this tick stay
+                # < window); jit retraces per distinct width, one compile
+                # per bucket crossed as conversations grow.
+                w_need = int(max(self._pos[ix] for ix in active)) \
+                    + self.steps_per_tick
+                wb = self._suffix_window(w_need) // self.paged.block_size
                 toks, self.pool = self._decode_step()(
-                    self.params, self.pool, jnp.asarray(self._tables),
+                    self.params, self.pool,
+                    jnp.asarray(self._tables[:, :wb]),
                     jnp.asarray(self._pos), jnp.asarray(self._cur),
                     jnp.asarray(self._temps), rng)
                 toks = np.asarray(jax.block_until_ready(toks))   # [T, B]

@@ -440,25 +440,28 @@ class InferenceEngine:
         from ..utils.telemetry import PhaseTimer
         self.generate("warmup", max_new_tokens=1)
         cap = self.tier.max_new_tokens
-        # The warmup generate above recorded exactly which decode lengths
-        # are compiled — seed from that, not a re-derivation that can skew.
-        seen_lens = set(self._decode_fns)
-        for bucket in self._buckets[1:]:
-            cache_len = self._pick_cache_len(max(bucket + cap, bucket))
-            first, cache = self._prefill_fn(bucket, cache_len)(
-                self.params,
-                jnp.full((1, bucket), self.tokenizer.pad_id, jnp.int32),
-                jnp.asarray([1], np.int32), jax.random.PRNGKey(0),
-                jnp.float32(0.0))
-            if cache_len not in seen_lens:   # compile this length's decode
-                seen_lens.add(cache_len)
-                out, _, _ = self._decode_loop(cache_len)(
-                    self.params, cache, jnp.asarray([0], np.int32),
+        # generate() sizes caches as pick(max(n + cap, bucket)) with
+        # prev_bucket < n <= bucket, so each bucket can land on the ladder
+        # rung of `bucket` or of `bucket + cap` — compile BOTH ends (the
+        # range spans at most those rungs for any cap below the ladder
+        # gap), plus each length's decode program.
+        for bucket in self._buckets:
+            for cache_len in {self._pick_cache_len(bucket),
+                              self._pick_cache_len(bucket + cap)}:
+                fresh = (bucket, cache_len) not in self._prefill_fns
+                first, cache = self._prefill_fn(bucket, cache_len)(
+                    self.params,
+                    jnp.full((1, bucket), self.tokenizer.pad_id, jnp.int32),
                     jnp.asarray([1], np.int32), jax.random.PRNGKey(0),
-                    jnp.float32(0.0), jnp.int32(1))
-                jax.block_until_ready(out)
-            else:
-                jax.block_until_ready(first)
+                    jnp.float32(0.0))
+                if fresh or cache_len not in self._decode_fns:
+                    out, _, _ = self._decode_loop(cache_len)(
+                        self.params, cache, jnp.asarray([0], np.int32),
+                        jnp.asarray([1], np.int32), jax.random.PRNGKey(0),
+                        jnp.float32(0.0), jnp.int32(1))
+                    jax.block_until_ready(out)
+                else:
+                    jax.block_until_ready(first)
         if self.prefix_cache is not None:
             for sb in self._buckets[:2]:
                 # A short-history hit's window is the bucket above the

@@ -185,7 +185,10 @@ def decode_step_paged(
 
     Returns (logits [B, V] float32, updated pool).  Idle slots point their
     whole table at the trash block; their writes land there and their
-    logits are ignored by the scheduler.
+    logits are ignored by the scheduler.  Callers bound the attention
+    gather by passing a TRUNCATED table ([B, wb] covering every active
+    position) — the scheduler slices to a bucketed high-water mark so
+    short conversations don't stream max_seq_len of pool per step.
     """
     b = token.shape[0]
     d = cfg.head_dim
