@@ -65,19 +65,23 @@ def pick_bucket(buckets: Sequence[int], n: int, max_seq: int) -> int:
 
 
 def prepare_prompt(tokenizer: ByteTokenizer, history, buckets: Sequence[int],
-                   max_seq: int, reserve: int) -> Tuple[List[int], int]:
+                   max_seq: int, reserve: int,
+                   allow_long: bool = False) -> Tuple[List[int], int]:
     """Tokenize + tail-truncate a prompt and pick its bucket.
 
     ``reserve`` tokens are kept free for generation; overlong prompts keep
     their TAIL (most recent turns), mirroring the reference's silent
-    context truncation (SURVEY.md §5.7).
+    context truncation (SURVEY.md §5.7).  With ``allow_long`` the bucket
+    cap does NOT truncate: prompts beyond the largest bucket keep their
+    full (max_seq-bounded) length for chunked prefill — only engines that
+    implement the chunk loop pass this.
     """
     ids = tokenizer.encode_history(history)
     max_prompt = max_seq - reserve
     if len(ids) > max_prompt:
         ids = ids[-max_prompt:]
     bucket = pick_bucket(buckets, len(ids), max_seq)
-    if len(ids) > bucket:
+    if len(ids) > bucket and not allow_long:
         ids = ids[-bucket:]
     return ids, bucket
 
@@ -217,6 +221,49 @@ class InferenceEngine:
         self._prefill_fns[key] = fn
         return fn
 
+    def _init_cache_fn(self, cache_len: int):
+        """Jitted per length: a fresh zero cache (chunked long prefill
+        starts from one instead of a prefill-seeded cache)."""
+        key = ("init", cache_len)
+        if key not in self._grow_fns:
+            cfg = self.cfg
+            self._grow_fns[key] = jax.jit(
+                lambda: transformer.init_kv_cache(cfg, 1, cache_len))
+        return self._grow_fns[key]
+
+    def _long_prefill(self, ids, cache_len: int, rng, temp,
+                      cache=None, start0: int = 0):
+        """Chunked prefill for prompts beyond the largest bucket: stride
+        the prompt through the suffix-prefill program in largest-bucket
+        chunks (each attending the bucketed window of everything before
+        it).  The reference silently truncates here (Ollama's context
+        window, SURVEY.md §5.7); owning the engine, we serve the model's
+        whole max_seq_len with a handful of compiled programs.
+
+        ``cache``/``start0``: resume from a reclaimed prefix cache holding
+        positions < start0 (long-suffix prefix reuse) instead of a fresh
+        zero cache.
+
+        Returns (first sampled token, seeded cache) like a prefill fn —
+        only the LAST chunk's sample (at the true final position) is
+        meaningful, and only it is used.
+        """
+        n = len(ids)
+        cb = self._buckets[-1]
+        if cache is None:
+            cache = self._init_cache_fn(cache_len)()
+        first = None
+        for start in range(start0, n, cb):
+            chunk = ids[start:start + cb]
+            tokens = np.full((1, cb), self.tokenizer.pad_id, np.int32)
+            tokens[0, :len(chunk)] = chunk
+            window = min(self._suffix_window(start + cb), cache_len)
+            first, cache = self._suffix_prefill_fn(cb, window)(
+                self.params, cache, jnp.asarray(tokens),
+                jnp.asarray([start], np.int32), jnp.asarray([n], np.int32),
+                rng, temp)
+        return first, cache
+
     def _grow_fn(self, src_len: int, dst_len: int):
         """Jitted per pair: copy a parked cache into a longer one (prefix
         reuse across conversations that outgrew the parked length)."""
@@ -342,8 +389,22 @@ class InferenceEngine:
             ids, bucket = prepare_prompt(self.tokenizer, history,
                                          self.tier.prefill_buckets,
                                          self._max_seq,
-                                         self.tier.max_new_tokens)
+                                         self.tier.max_new_tokens,
+                                         allow_long=True)
         n = len(ids)
+        # Chunked long prefill strides in largest-bucket steps; if the
+        # strided span cannot fit max_seq (non-dividing bucket sizes),
+        # keep the largest chunk-able tail (reference-style truncation,
+        # but only of what the chunk loop genuinely cannot serve).
+        cb = self._buckets[-1] if self._buckets else bucket
+        span = -(-n // cb) * cb
+        if n > cb and span > self._max_seq:
+            limit = min((self._max_seq // cb) * cb,
+                        self._max_seq - self.tier.max_new_tokens)
+            ids = ids[-limit:]
+            n = len(ids)
+            span = -(-n // cb) * cb
+        is_long = bool(self._buckets) and n > cb
         true_len = np.array([n], np.int32)
 
         self._rng, rng1, rng2 = jax.random.split(self._rng, 3)
@@ -359,7 +420,7 @@ class InferenceEngine:
         # through Ollama every turn, SURVEY.md §3.1).
         from .prefix_cache import select_reuse
         sel = select_reuse(self.prefix_cache, ids, self._buckets,
-                           self._max_seq)
+                           self._max_seq, allow_long_suffix=True)
         reused = (sel[0].cache, sel[1], sel[2], sel[3]) if sel else None
 
         # Size the cache for this conversation, not the model maximum —
@@ -367,8 +428,14 @@ class InferenceEngine:
         # decode cap (not the per-request override) so repeat prompt shapes
         # always reuse the warmed compiles.
         needed = max(n + self.tier.max_new_tokens, bucket)
+        if is_long:
+            needed = max(needed, span)
         if reused is not None:
-            needed = max(needed, reused[1] + reused[3])     # m + sb
+            m, sb = reused[1], reused[3]
+            if sb is None:     # bucket-exceeding suffix, chunked from m
+                needed = max(needed, m + -(-(n - m) // cb) * cb)
+            else:
+                needed = max(needed, m + sb)
         cache_len = self._pick_cache_len(needed)
 
         with self.phases.phase("prefill"):
@@ -379,13 +446,19 @@ class InferenceEngine:
                     cache0 = self._grow_fn(parked_len, cache_len)(cache0)
                 else:
                     cache_len = parked_len    # bigger parked cache: keep it
-                tokens = np.full((1, sb), self.tokenizer.pad_id, np.int32)
-                tokens[0, :len(suffix)] = suffix
-                window = min(self._suffix_window(m + sb), cache_len)
-                first, cache = self._suffix_prefill_fn(sb, window)(
-                    self.params, cache0, jnp.asarray(tokens),
-                    jnp.asarray([m], np.int32), jnp.asarray(true_len),
-                    rng1, temp)
+                if sb is None:   # long new turn: chunk-stride from m
+                    first, cache = self._long_prefill(
+                        ids, cache_len, rng1, temp, cache=cache0, start0=m)
+                else:
+                    tokens = np.full((1, sb), self.tokenizer.pad_id, np.int32)
+                    tokens[0, :len(suffix)] = suffix
+                    window = min(self._suffix_window(m + sb), cache_len)
+                    first, cache = self._suffix_prefill_fn(sb, window)(
+                        self.params, cache0, jnp.asarray(tokens),
+                        jnp.asarray([m], np.int32), jnp.asarray(true_len),
+                        rng1, temp)
+            elif is_long:        # beyond the largest bucket: chunked stride
+                first, cache = self._long_prefill(ids, cache_len, rng1, temp)
             else:
                 tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
                 tokens[0, :n] = ids
@@ -476,6 +549,31 @@ class InferenceEngine:
                     jnp.full((1, sb), self.tokenizer.pad_id, jnp.int32),
                     jnp.asarray([0], jnp.int32), jnp.asarray([1], jnp.int32),
                     jax.random.PRNGKey(0), jnp.float32(0.0))
+                jax.block_until_ready(first)
+        if self._buckets and self._buckets[-1] < self._max_seq:
+            # Chunked-long-prefill programs: the largest-bucket chunk at
+            # every window rung a max-length prompt walks through, plus
+            # the zero-cache init and that length's decode loop.
+            cb = self._buckets[-1]
+            limit = min((self._max_seq // cb) * cb, self._max_seq - cap)
+            cache_len = self._pick_cache_len(
+                max(limit + cap, -(-limit // cb) * cb))
+            cache = self._init_cache_fn(cache_len)()
+            for window in sorted({
+                    min(self._suffix_window(s + cb), cache_len)
+                    for s in range(0, limit, cb)}):
+                first, cache = self._suffix_prefill_fn(cb, window)(
+                    self.params, cache,
+                    jnp.full((1, cb), self.tokenizer.pad_id, jnp.int32),
+                    jnp.asarray([0], jnp.int32), jnp.asarray([1], jnp.int32),
+                    jax.random.PRNGKey(0), jnp.float32(0.0))
+            if cache_len not in self._decode_fns:
+                out, _, _ = self._decode_loop(cache_len)(
+                    self.params, cache, jnp.asarray([0], np.int32),
+                    jnp.asarray([1], np.int32), jax.random.PRNGKey(0),
+                    jnp.float32(0.0), jnp.int32(1))
+                jax.block_until_ready(out)
+            else:
                 jax.block_until_ready(first)
         # Compile time lands in the warmup call's phases; reset so /stats
         # attribution reflects steady-state serving only.

@@ -38,13 +38,19 @@ class PrefixEntry:
 
 
 def select_reuse(store: "Optional[PrefixCache]", ids: Sequence[int],
-                 buckets: Sequence[int], max_seq: int):
+                 buckets: Sequence[int], max_seq: int,
+                 allow_long_suffix: bool = False):
     """Shared take + suffix-bucket policy for both engines.
 
     Returns (entry, matched_len, suffix_ids, suffix_bucket) when a parked
     prefix can be extended within ``buckets``/``max_seq``, else None (any
     taken entry is restored).  Keeping the policy here means the contiguous
     and paged engines cannot drift apart on matching rules.
+
+    ``allow_long_suffix``: when no single bucket holds the suffix, return
+    suffix_bucket=None instead of restoring — the caller (contiguous
+    engine) chunk-prefills the suffix in largest-bucket strides from the
+    matched position, so even bucket-exceeding turns keep O(delta) cost.
     """
     if store is None or not buckets:
         return None
@@ -54,8 +60,12 @@ def select_reuse(store: "Optional[PrefixCache]", ids: Sequence[int],
     suffix = ids[m:]
     sb = next((b for b in buckets
                if len(suffix) <= b and m + b <= max_seq), None)
-    if sb is None:       # no bucket fits — restore entry, caller goes cold
-        store.untake(entry, m)
+    if sb is None:
+        cb = buckets[-1]
+        span = m + -(-len(suffix) // cb) * cb
+        if allow_long_suffix and span <= max_seq:
+            return entry, m, suffix, None
+        store.untake(entry, m)   # caller goes cold
         return None
     return entry, m, suffix, sb
 

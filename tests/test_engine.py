@@ -176,3 +176,57 @@ def test_grow_fn_copies_prefix_and_zero_fills():
     np.testing.assert_array_equal(np.asarray(big["k"][:, :, :5]), 1.0)
     np.testing.assert_array_equal(np.asarray(big["v"][:, :, :5]), 2.0)
     np.testing.assert_array_equal(np.asarray(big["k"][:, :, 32:]), 0.0)
+
+
+def test_long_prompt_chunked_prefill_matches_single_shot():
+    """Prompts beyond the largest bucket prefill in chunks instead of
+    being tail-truncated; output matches a single-shot engine whose
+    bucket holds the whole prompt."""
+    text = "user: " + " ".join(f"word{i}" for i in range(25))   # ~180 ids
+    chunked = InferenceEngine(
+        TierConfig(name="nano", model_preset="nano_test", max_new_tokens=8,
+                   prefill_buckets=(16, 32, 64)), seed=40)
+    single = InferenceEngine(
+        TierConfig(name="nano", model_preset="nano_test", max_new_tokens=8,
+                   prefill_buckets=(256,)), seed=40)
+    r1 = chunked.generate(text)
+    r2 = single.generate(text)
+    assert r1.prompt_tokens == r2.prompt_tokens > 64   # nothing truncated
+    assert r1.token_ids == r2.token_ids
+
+
+def test_long_prompt_then_prefix_reuse():
+    """A long chunked prompt parks its cache; the follow-up turn reuses it
+    and only prefills the new turn."""
+    eng = InferenceEngine(
+        TierConfig(name="nano", model_preset="nano_test", max_new_tokens=8,
+                   prefill_buckets=(16, 32, 64)), seed=41)
+    text = "user: " + " ".join(f"item{i}" for i in range(22))
+    r1 = eng.generate(text)
+    assert r1.prompt_tokens > 64
+    r2 = eng.generate(text + "\nassistant: " + (r1.text or "x")
+                      + "\nuser: short follow up")
+    assert eng.prefix_cache.stats()["hits"] == 1
+    assert r2.prompt_tokens > r1.prompt_tokens
+
+
+def test_long_suffix_reuse_chunks_from_matched_prefix():
+    """A new turn LONGER than the largest bucket still reuses the parked
+    prefix (chunk-strided from the matched position) and matches a cold
+    engine token for token."""
+    mk = lambda: TierConfig(name="nano", model_preset="nano_test",
+                            max_new_tokens=8, prefill_buckets=(16, 32, 64))
+    warm = InferenceEngine(mk(), seed=42)
+    t1 = "user: " + " ".join(f"alpha{i}" for i in range(12))     # ~100 ids
+    r1 = warm.generate(t1)
+    follow = (t1 + "\nassistant: " + (r1.text or "x")
+              + "\nuser: " + " ".join(f"beta{i}" for i in range(12)))
+    r2 = warm.generate(follow)
+    assert warm.prefix_cache.stats()["hits"] == 1
+    import dataclasses
+    cold = InferenceEngine(
+        dataclasses.replace(mk(), enable_prefix_cache=False), seed=42)
+    cold.generate(t1)                     # align rng consumption
+    r2c = cold.generate(follow)
+    assert r2.token_ids == r2c.token_ids
+    assert r2.prompt_tokens == r2c.prompt_tokens > 64
