@@ -5,7 +5,11 @@ context per server process (SURVEY.md §2.1); a continuous-batching engine
 needs many sequences of very different lengths resident at once, so the
 TPU-native design is vLLM-style paging adapted to XLA's static shapes:
 
-- One HBM **pool** per tier, ``[L, num_blocks, block_size, N_kv, D]``.
+- One HBM **pool** per tier, ``[L, N_kv, num_blocks, block_size, D]``.
+  Head-major: each (head, block) is a contiguous ``[block_size, D]`` tile —
+  the TPU-native (sublane, lane) shape — so the Pallas paged-attention
+  kernel DMAs exactly the blocks it attends, and a 'tp' mesh axis can
+  shard the pool on the head dim like the contiguous cache.
 - A host-side **BlockAllocator** (free list) hands fixed-size blocks to
   slots; block 0 is reserved as a trash block that idle batch slots write
   into, so the batched decode step needs no host-side compaction.
@@ -36,7 +40,7 @@ from ..config import ModelConfig
 from ..models import transformer
 from ..ops import attention, quant
 
-KVPool = Dict[str, jax.Array]    # {"k","v": [L, NB, bs, N_kv, D]}
+KVPool = Dict[str, jax.Array]    # {"k","v": [L, N_kv, NB, bs, D]}
 
 TRASH_BLOCK = 0
 
@@ -58,8 +62,8 @@ class PagedConfig:
 
 
 def init_pool(cfg: ModelConfig, pcfg: PagedConfig) -> KVPool:
-    shape = (cfg.num_layers, pcfg.num_blocks, pcfg.block_size,
-             cfg.num_kv_heads, cfg.head_dim)
+    shape = (cfg.num_layers, cfg.num_kv_heads, pcfg.num_blocks,
+             pcfg.block_size, cfg.head_dim)
     dtype = jnp.dtype(cfg.dtype)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
@@ -98,10 +102,11 @@ def write_prefill_blocks(pool: KVPool, blocks: jax.Array,
     l, s, nkv, d = k_all.shape
     nb = blocks.shape[0]
     bs = s // nb
-    k_blk = k_all.reshape(l, nb, bs, nkv, d)
-    v_blk = v_all.reshape(l, nb, bs, nkv, d)
-    return {"k": pool["k"].at[:, blocks].set(k_blk),
-            "v": pool["v"].at[:, blocks].set(v_blk)}
+    # [L, S, N_kv, D] -> [L, N_kv, nb, bs, D] (head-major pool tiles).
+    k_blk = k_all.reshape(l, nb, bs, nkv, d).transpose(0, 3, 1, 2, 4)
+    v_blk = v_all.reshape(l, nb, bs, nkv, d).transpose(0, 3, 1, 2, 4)
+    return {"k": pool["k"].at[:, :, blocks].set(k_blk),
+            "v": pool["v"].at[:, :, blocks].set(v_blk)}
 
 
 def chunk_prefill_paged(
@@ -125,7 +130,7 @@ def chunk_prefill_paged(
     """
     b, s_c = tokens.shape
     d = cfg.head_dim
-    bs = pool["k"].shape[2]
+    bs = pool["k"].shape[3]
     wb = window // bs
 
     x = quant.embed_rows(params["embed"], tokens)            # [1, S_c, H]
@@ -146,13 +151,17 @@ def chunk_prefill_paged(
         q = transformer.apply_rope(q, sin, cos)
         k = transformer.apply_rope(k, sin, cos)
 
-        # Scatter the chunk's K/V to its (block, offset) cells.
-        k_pool = k_pool.at[blk, off].set(k[0])
-        v_pool = v_pool.at[blk, off].set(v[0])
+        # Scatter the chunk's K/V to its (head, block, offset) cells.
+        k_pool = k_pool.at[:, blk, off].set(jnp.swapaxes(k[0], 0, 1))
+        v_pool = v_pool.at[:, blk, off].set(jnp.swapaxes(v[0], 0, 1))
 
         # Gather the attended window in logical order.
-        k_seq = k_pool[table[:wb]].reshape(1, window, cfg.num_kv_heads, d)
-        v_seq = v_pool[table[:wb]].reshape(1, window, cfg.num_kv_heads, d)
+        k_seq = jnp.swapaxes(
+            k_pool[:, table[:wb]].reshape(cfg.num_kv_heads, window, d),
+            0, 1)[None]
+        v_seq = jnp.swapaxes(
+            v_pool[:, table[:wb]].reshape(cfg.num_kv_heads, window, d),
+            0, 1)[None]
         attn = attention.chunk(q, k_seq, v_seq, q_pos,
                                impl=cfg.attention_impl)
         x = x + quant.matmul(attn.reshape(b, s_c, cfg.num_heads * d),
@@ -192,8 +201,7 @@ def decode_step_paged(
     """
     b = token.shape[0]
     d = cfg.head_dim
-    bs = pool["k"].shape[2]
-    mb = tables.shape[1]
+    bs = pool["k"].shape[3]
 
     x = quant.embed_rows(params["embed"], token)       # [B, H]
     sin, cos = transformer.rope_sincos(pos, d, cfg.rope_theta)
@@ -203,7 +211,7 @@ def decode_step_paged(
     batch_ix = jnp.arange(b)
 
     def layer(x, scanned):
-        lp, k_pool, v_pool = scanned                   # pools: [NB, bs, nkv, d]
+        lp, k_pool, v_pool = scanned                   # pools: [nkv, NB, bs, d]
         h_in = transformer.rms_norm(x, lp["ln1"], cfg.norm_eps)
         q = quant.matmul(h_in, lp["wq"]).reshape(b, cfg.num_heads, d)
         k = quant.matmul(h_in, lp["wk"]).reshape(b, cfg.num_kv_heads, d)
@@ -211,17 +219,16 @@ def decode_step_paged(
         q = transformer.apply_rope(q, sin, cos)
         k = transformer.apply_rope(k, sin, cos)
 
-        # Write-before-attend at (block, offset); batched scatter — active
-        # slots hit distinct blocks, idle slots collide harmlessly in trash.
-        k_pool = k_pool.at[blk, off].set(k)
-        v_pool = v_pool.at[blk, off].set(v)
+        # Write-before-attend at (head, block, offset); batched scatter —
+        # active slots hit distinct blocks, idle ones collide in trash.
+        k_pool = k_pool.at[:, blk, off].set(jnp.swapaxes(k, 0, 1))
+        v_pool = v_pool.at[:, blk, off].set(jnp.swapaxes(v, 0, 1))
 
-        # Gather this slot's logical window back in order: position p is
-        # (table[p//bs], p%bs), so reshaping the gathered blocks gives the
-        # sequence axis directly.
-        k_seq = k_pool[tables].reshape(b, mb * bs, cfg.num_kv_heads, d)
-        v_seq = v_pool[tables].reshape(b, mb * bs, cfg.num_kv_heads, d)
-        attn = attention.decode(q, k_seq, v_seq, pos, impl=cfg.attention_impl)
+        # Attend this slot's logical window: position p is
+        # (table[p//bs], p%bs).  The Pallas path streams table blocks
+        # through VMEM in-kernel; the XLA path gathers them contiguous.
+        attn = attention.paged_decode(q, k_pool, v_pool, tables, pos,
+                                      impl=cfg.attention_impl)
 
         x = x + quant.matmul(attn.reshape(b, cfg.num_heads * d), lp["wo"])
         h_ffn = transformer.rms_norm(x, lp["ln2"], cfg.norm_eps)

@@ -74,6 +74,25 @@ def chunk(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     return chunk_attention(q, k_cache, v_cache, q_positions)
 
 
+def paged_decode(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                 tables: jax.Array, pos: jax.Array,
+                 impl: str = "auto") -> jax.Array:
+    """Dispatching batched decode attention over a paged KV pool
+    (engine/paged_kv.py): q [B, Nq, D], pools [Nkv, NB, bs, D], tables
+    [B, MB], pos [B] -> [B, Nq, D].  The Pallas path walks the block table
+    in-kernel; the XLA path gathers the table into a contiguous view and
+    reuses ``decode_attention`` (portable / GSPMD-shardable fallback)."""
+    if resolve_impl(impl) == "pallas":
+        from .pallas_attention import paged_decode_attention
+        return paged_decode_attention(q, k_pool, v_pool, tables, pos)
+    b, mb = tables.shape
+    nkv, bs, d = k_pool.shape[0], k_pool.shape[2], k_pool.shape[3]
+    # [Nkv, B, MB, bs, D] -> [B, S, Nkv, D]
+    k_seq = k_pool[:, tables].reshape(nkv, b, mb * bs, d).transpose(1, 2, 0, 3)
+    v_seq = v_pool[:, tables].reshape(nkv, b, mb * bs, d).transpose(1, 2, 0, 3)
+    return decode_attention(q, k_seq, v_seq, pos)
+
+
 def _expand_kv(x: jax.Array, groups: int) -> jax.Array:
     """[B, S, N_kv, D] -> [B, S, N_kv*groups, D] by repeating each kv head."""
     if groups == 1:

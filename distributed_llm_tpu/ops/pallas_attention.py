@@ -236,6 +236,113 @@ def flash_chunk_attention(q: jax.Array, k_cache: jax.Array,
 
 
 # =============================================================================
+# Paged decode: block-table attention straight out of the KV pool
+# =============================================================================
+
+def _paged_decode_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *, bs: int, scale: float):
+    """Flash recurrence over one slot's block table (grid: B × Nkv × MB,
+    table-block index j innermost).  The pipeline DMAs pool block
+    ``tables[b, j]`` into VMEM via the scalar-prefetched index map — the
+    gather that the XLA path materializes in HBM never exists here."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Per-slot frontier: blocks past this slot's length are mapped by the
+    # index_map onto the frontier block (the DMA dedupes on the repeated
+    # index) and skipped here, so each slot pays for ITS length, not the
+    # batch max.
+    @pl.when(j * bs <= pos_ref[b])
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32) * scale          # [G, D]
+        k = k_ref[0, 0]                                      # [bs, D]
+        v = v_ref[0, 0]
+
+        s = jnp.dot(q, k.T.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)      # [G, bs]
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bs
+        s = jnp.where(col <= pos_ref[b], s, NEG_INF)         # ragged mask
+
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+
+    @pl.when(j == nb - 1)
+    def _done():
+        o_ref[0, 0] = (acc_ref[...] /
+                       jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
+                           v_pool: jax.Array, tables: jax.Array,
+                           pos: jax.Array) -> jax.Array:
+    """Batched one-token decode attention over a paged KV pool
+    (engine/paged_kv.py head-major layout): q [B, Nq, D], pools
+    [Nkv, NB, bs, D], tables [B, MB] pool block ids, pos [B] -> [B, Nq, D].
+
+    Logical position p of slot b lives at pool cell
+    ``(h, tables[b, p // bs], p % bs)``; cells past ``pos[b]`` (and trash/
+    garbage blocks the table points at beyond the allocation) are masked by
+    the in-kernel ragged frontier.  Replaces the XLA path's
+    ``pool[:, tables]`` gather — which materializes [B, MB·bs, Nkv, D] in
+    HBM every layer of every decode step — with per-(head, block) VMEM
+    streaming: each grid step DMAs exactly one [bs, D] tile."""
+    b, nq, d = q.shape
+    nkv, bs = k_pool.shape[0], k_pool.shape[2]
+    mb = tables.shape[1]
+    groups = nq // nkv
+
+    qh = q.reshape(b, nkv, groups, d)                        # group-major
+    tables32 = tables.astype(jnp.int32)
+    pos32 = pos.astype(jnp.int32)
+
+    kernel = functools.partial(_paged_decode_kernel, bs=bs, scale=d ** -0.5)
+
+    def kv_index(b_, h, j, tbl, p):
+        # Clamp to the slot's frontier block: overshoot iterations repeat
+        # the previous index, so their DMA is elided and their compute is
+        # pl.when-skipped in the kernel.
+        return (h, tbl[b_, jnp.minimum(j, p[b_] // bs)], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nkv, mb),
+        in_specs=[
+            pl.BlockSpec((1, 1, groups, d),
+                         lambda b_, h, j, tbl, p: (b_, h, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d), kv_index),
+            pl.BlockSpec((1, 1, bs, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, groups, d),
+                               lambda b_, h, j, tbl, p: (b_, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((groups, d), jnp.float32),
+            pltpu.VMEM((groups, 1), jnp.float32),
+            pltpu.VMEM((groups, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        interpret=_interpret(),
+    )(tables32, pos32, qh, k_pool, v_pool)
+    return out.reshape(b, nq, d)
+
+
+# =============================================================================
 # Decode: masked ("ragged") single-token attention over the KV cache
 # =============================================================================
 

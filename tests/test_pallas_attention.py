@@ -100,6 +100,54 @@ def test_flash_decode_masks_future_cache_slots():
     np.testing.assert_allclose(np.asarray(base), np.asarray(pert), atol=1e-6)
 
 
+@pytest.mark.parametrize("b,nq,nkv,d,bs,mb", [
+    (1, 4, 4, 16, 16, 4),
+    (3, 8, 2, 32, 32, 4),
+])
+def test_paged_decode_matches_xla_gather(b, nq, nkv, d, bs, mb):
+    """The in-kernel block-table walk must equal gather-then-attend, with
+    shuffled non-contiguous tables, trash rows past the allocation, and
+    ragged per-slot positions."""
+    nb = b * mb + 1                          # + trash block 0
+    ks = jax.random.split(jax.random.PRNGKey(5), 4)
+    q = _rand(ks[0], (b, nq, d))
+    k_pool = _rand(ks[1], (nkv, nb, bs, d))
+    v_pool = _rand(ks[2], (nkv, nb, bs, d))
+    # Slot tables: disjoint shuffled block ids; last row trash for slot 0.
+    perm = np.asarray(jax.random.permutation(ks[3], nb - 1) + 1)
+    tables = np.asarray(perm[:b * mb]).reshape(b, mb).astype(np.int32)
+    tables[0, -1] = 0                        # unallocated tail → trash block
+    pos = jnp.asarray([min((mb - 1) * bs - 2, 5 + 11 * i) for i in range(b)],
+                      jnp.int32)
+    got = attention.paged_decode(q, k_pool, v_pool, jnp.asarray(tables), pos,
+                                 impl="pallas")
+    want = attention.paged_decode(q, k_pool, v_pool, jnp.asarray(tables), pos,
+                                  impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_paged_decode_masks_past_pos():
+    """Garbage in cells beyond pos (and in trash-pointed blocks) must be
+    invisible."""
+    b, nq, nkv, d, bs, mb = 1, 2, 2, 16, 16, 3
+    nb = mb + 1
+    ks = jax.random.split(jax.random.PRNGKey(6), 3)
+    q = _rand(ks[0], (b, nq, d))
+    k_pool = _rand(ks[1], (nkv, nb, bs, d))
+    v_pool = _rand(ks[2], (nkv, nb, bs, d))
+    tables = jnp.asarray([[2, 1, 0]], jnp.int32)
+    pos = jnp.asarray([bs + 3], jnp.int32)   # mid second block
+    from distributed_llm_tpu.ops.pallas_attention import paged_decode_attention
+    base = paged_decode_attention(q, k_pool, v_pool, tables, pos)
+    # Garbage in the trash block and in cells past pos within the current
+    # block must be invisible (pos = bs+3 → block 1 cells > 3 are unwritten).
+    k2 = k_pool.at[:, 0].set(1e4).at[:, 1, 4:].set(1e4)
+    v2 = v_pool.at[:, 0].set(-1e4).at[:, 1, 4:].set(-1e4)
+    pert = paged_decode_attention(q, k2, v2, tables, pos)
+    np.testing.assert_allclose(np.asarray(base), np.asarray(pert), atol=1e-6)
+
+
 def test_resolve_impl(monkeypatch):
     assert attention.resolve_impl("xla") == "xla"
     assert attention.resolve_impl("pallas") == "pallas"
