@@ -85,17 +85,21 @@ class _Slot:
 class ContinuousBatchingEngine:
     """Drop-in for InferenceEngine (same generate()/warmup() surface) with
     a shared batched decode loop behind it.  Built by EngineManager when
-    ``tier.decode_batch > 1``."""
+    ``tier.decode_batch > 1``.
+
+    With a ``mesh`` the engine runs tensor-parallel over the tier submesh:
+    params follow parallel/sharding.py's Megatron rules and the paged pool
+    shards its kv-head axis (kv_pool_specs), so many concurrent requests
+    share one batched decode loop across the tier's chips."""
 
     def __init__(self, tier: TierConfig, seed: int = 0,
                  params: Optional[Dict[str, Any]] = None,
                  mesh: Optional[jax.sharding.Mesh] = None,
                  devices: Optional[Sequence[jax.Device]] = None):
-        if mesh is not None:
-            raise NotImplementedError(
-                "continuous batching currently targets unsharded tiers; "
-                "use InferenceEngine for tensor-sharded meshes")
         self.tier = tier
+        self.mesh = mesh
+        # Under a mesh, "auto" stays on the GSPMD-partitionable XLA path
+        # (upgrade_attention_impl only opts unsharded engines into Pallas).
         self.cfg = upgrade_attention_impl(tier.model(), mesh)
         bad = [b for b in tier.prefill_buckets if b % tier.kv_block_size]
         if bad:
@@ -111,12 +115,32 @@ class ContinuousBatchingEngine:
                                  max_seq_len=self.cfg.max_seq_len)
         self.steps_per_tick = max(1, tier.decode_steps_per_tick)
         if params is None:
-            init = jax.jit(partial(models.init_params, self.cfg),
-                           static_argnames=("seed",))
+            if mesh is not None:
+                from ..parallel.sharding import param_shardings
+                init = jax.jit(partial(models.init_params, self.cfg),
+                               static_argnames=("seed",),
+                               out_shardings=param_shardings(self.cfg, mesh))
+            else:
+                init = jax.jit(partial(models.init_params, self.cfg),
+                               static_argnames=("seed",))
             params = init(seed=seed)
         from ..ops.quant import maybe_quantize
-        self.params = maybe_quantize(params, tier, self.cfg)
+        self.params = maybe_quantize(params, tier, self.cfg, mesh=mesh)
         self.pool = init_pool(self.cfg, self.paged)
+        self._pool_shardings = None
+        self._replicated = None
+        if mesh is not None:
+            # Tensor-parallel tier: the pool shards on its kv-head axis, so
+            # every scatter/gather in decode_step_paged stays shard-local
+            # and GSPMD's only collectives are the two per-layer matmul
+            # all-reduces (same as the contiguous TP engine).  Pool-valued
+            # jit outputs are pinned to this sharding (out_shardings) —
+            # left unconstrained, XLA may replicate the output pool, which
+            # silently multiplies KV memory by the mesh size.
+            from ..parallel.sharding import kv_pool_shardings, replicated
+            self._pool_shardings = kv_pool_shardings(mesh)
+            self._replicated = replicated(mesh)
+            self.pool = jax.device_put(self.pool, self._pool_shardings)
         self.allocator = BlockAllocator(self.paged.num_blocks)
 
         b, mb = self.paged.max_slots, self.paged.blocks_per_slot
@@ -202,7 +226,10 @@ class ContinuousBatchingEngine:
             return toks, pool                      # [T, B]
 
         donate = (1,) if jax.default_backend() != "cpu" else ()
-        self._decode_fn = jax.jit(run, donate_argnums=donate)
+        kw = {}
+        if self._pool_shardings is not None:
+            kw["out_shardings"] = (self._replicated, self._pool_shardings)
+        self._decode_fn = jax.jit(run, donate_argnums=donate, **kw)
         return self._decode_fn
 
     def _chunk_prefill_fn(self, bucket: int, window: int):
@@ -222,7 +249,10 @@ class ContinuousBatchingEngine:
             return first, pool
 
         donate = (1,) if jax.default_backend() != "cpu" else ()
-        fn = jax.jit(run, donate_argnums=donate)
+        kw = {}
+        if self._pool_shardings is not None:
+            kw["out_shardings"] = (self._replicated, self._pool_shardings)
+        fn = jax.jit(run, donate_argnums=donate, **kw)
         self._prefill_fns[key] = fn
         return fn
 
@@ -231,8 +261,11 @@ class ContinuousBatchingEngine:
         compile per prefill block count."""
         if nb not in self._writer_fns:
             donate = (0,) if jax.default_backend() != "cpu" else ()
+            kw = {}
+            if self._pool_shardings is not None:
+                kw["out_shardings"] = self._pool_shardings
             self._writer_fns[nb] = jax.jit(write_prefill_blocks,
-                                           donate_argnums=donate)
+                                           donate_argnums=donate, **kw)
         return self._writer_fns[nb]
 
     # -- scheduler ---------------------------------------------------------
@@ -531,23 +564,22 @@ class ContinuousBatchingEngine:
         GenerationResult is ``.result`` on the returned generator's
         request once exhausted; multi-byte UTF-8 sequences are held back
         until complete."""
-        import codecs
+        from .tokenizer import StreamDecoder
         req = self.submit(history, max_new_tokens, temperature,
                           token_queue=queue.Queue())
 
         def deltas():
-            decoder = codecs.getincrementaldecoder("utf-8")("replace")
+            decoder = StreamDecoder()
             while True:
                 tok = req.token_queue.get()
                 if tok is None:
                     break
                 if tok in (self.tokenizer.eos_id, self.tokenizer.pad_id):
                     continue
-                if 0 <= tok < 256:
-                    text = decoder.decode(bytes([tok]))
-                    if text:
-                        yield text
-            tail = decoder.decode(b"", final=True)
+                text = decoder.feed(tok)
+                if text:
+                    yield text
+            tail = decoder.flush()
             if tail:
                 yield tail
             if req.error is not None:

@@ -370,20 +370,11 @@ class InferenceEngine:
 
     # -- host orchestration ------------------------------------------------
 
-    def generate(
-        self,
-        history: Union[str, Sequence[Dict[str, Any]]],
-        max_new_tokens: Optional[int] = None,
-        temperature: Optional[float] = None,
-    ) -> GenerationResult:
-        """Synchronous generation from a prompt string or chat history.
-
-        ``max_new_tokens`` may only shrink below the tier's compiled cap
-        (the loop exits early), mirroring the reference's per-request
-        ``num_predict`` override (src/devices/nano_api.py:62).
-        ``temperature`` likewise overrides the tier default per request;
-        both are runtime operands — no recompilation.
-        """
+    def _prepare_and_prefill(self, history, max_new_tokens, temperature):
+        """Shared front half of generate()/generate_stream(): tokenize,
+        pick cache length, run (reuse-aware / chunked / bucketed) prefill.
+        Returns (first token, cache, cache_len, ids, budget, rng, temp,
+        ttft_ms, t0)."""
         t0 = time.perf_counter()
         with self.phases.phase("tokenize"):
             ids, bucket = prepare_prompt(self.tokenizer, history,
@@ -472,11 +463,30 @@ class InferenceEngine:
         # cache was sized fresh; a reclaimed shorter conversation's cache
         # was sized with the same tier cap).
         budget = min(budget, cache_len - n)
+        return first, cache, cache_len, ids, budget, rng2, temp, ttft_ms, t0
+
+    def generate(
+        self,
+        history: Union[str, Sequence[Dict[str, Any]]],
+        max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+    ) -> GenerationResult:
+        """Synchronous generation from a prompt string or chat history.
+
+        ``max_new_tokens`` may only shrink below the tier's compiled cap
+        (the loop exits early), mirroring the reference's per-request
+        ``num_predict`` override (src/devices/nano_api.py:62).
+        ``temperature`` likewise overrides the tier default per request;
+        both are runtime operands — no recompilation.
+        """
+        (first, cache, cache_len, ids, budget, rng2, temp, ttft_ms,
+         t0) = self._prepare_and_prefill(history, max_new_tokens, temperature)
+        n = len(ids)
 
         with self.phases.phase("decode"):
             out, steps, cache = self._decode_loop(cache_len)(
-                self.params, cache, first, jnp.asarray(true_len), rng2, temp,
-                jnp.int32(budget))
+                self.params, cache, first, jnp.asarray([n], np.int32), rng2,
+                temp, jnp.int32(budget))
             out = np.asarray(jax.block_until_ready(out))[0]
         total_ms = (time.perf_counter() - t0) * 1000.0
 
@@ -501,6 +511,90 @@ class InferenceEngine:
             ttft_ms=ttft_ms,
             total_ms=total_ms,
         )
+
+    def generate_stream(
+        self,
+        history: Union[str, Sequence[Dict[str, Any]]],
+        max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        segment: int = 8,
+    ):
+        """Token streaming for the sequential engine: same prefill as
+        ``generate`` (TTFT = one device call), then the compiled decode
+        loop runs in ``segment``-token slices — ``token_budget`` is a
+        runtime operand, so slicing reuses the SAME compiled program, at
+        one host round-trip per ``segment`` tokens.  Returns a
+        StreamHandle (iterable of text deltas, ``.result`` once
+        exhausted) with the same surface as the batching engine's."""
+        from .batching import StreamHandle, _Request
+
+        req = _Request(history=history, max_new_tokens=max_new_tokens,
+                       temperature=temperature)
+
+        def deltas():
+            from .tokenizer import StreamDecoder
+            decoder = StreamDecoder()
+            eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
+            try:
+                (first, cache, cache_len, ids, budget, rng, temp, ttft_ms,
+                 t0) = self._prepare_and_prefill(history, max_new_tokens,
+                                                 temperature)
+            except BaseException as exc:
+                req.error = exc
+                req.done.set()
+                raise
+            n = len(ids)
+            gen: List[int] = [int(np.asarray(first)[0])]
+            decode = self._decode_loop(cache_len)
+
+            try:
+                if gen[-1] not in (eos, pad):
+                    text = decoder.feed(gen[-1])
+                    if text:
+                        yield text
+                while len(gen) < budget and gen[-1] not in (eos, pad):
+                    # Continue from the last token at its absolute
+                    # position: pos(gen[-1]) == n + len(gen) - 1.
+                    seg = min(segment, budget - len(gen))
+                    rng, sub = jax.random.split(rng)
+                    with self.phases.phase("decode"):
+                        out, steps, cache = decode(
+                            self.params, cache,
+                            jnp.asarray([gen[-1]], np.int32),
+                            jnp.asarray([n + len(gen) - 1], np.int32),
+                            sub, temp, jnp.int32(seg + 1))
+                        out = np.asarray(jax.block_until_ready(out))[0]
+                    for tok in out[1:int(steps)].tolist():
+                        gen.append(tok)
+                        if tok in (eos, pad):
+                            break
+                        text = decoder.feed(tok)
+                        if text:
+                            yield text
+                tail = decoder.flush()
+                if tail:
+                    yield tail
+
+                if self.prefix_cache is not None:
+                    self.prefix_cache.put(ids, cache)
+                with self.phases.phase("detokenize"):
+                    gen_ids = trim_at_eos(gen, eos, pad)
+                    text_all = self.tokenizer.decode(gen_ids)
+                req.result = GenerationResult(
+                    text=text_all,
+                    token_ids=gen_ids,
+                    prompt_tokens=n,
+                    gen_tokens=len(gen_ids),
+                    ttft_ms=ttft_ms,
+                    total_ms=(time.perf_counter() - t0) * 1000.0,
+                )
+            except BaseException as exc:
+                req.error = exc
+                raise
+            finally:
+                req.done.set()
+
+        return StreamHandle(deltas(), req)
 
     def warmup(self) -> None:
         """Compile EVERY prefill bucket + the decode loop, and (when prefix

@@ -83,18 +83,12 @@ class EngineManager:
                 engine = SpeculativeEngine(
                     self.tier, draft, gamma=self.tier.speculative_gamma,
                     seed=self.seed, target_params=params)
-            elif self.tier.decode_batch > 1 and self.mesh is None:
+            elif self.tier.decode_batch > 1:
                 from .batching import ContinuousBatchingEngine
                 engine = ContinuousBatchingEngine(
-                    self.tier, seed=self.seed, devices=self.devices,
-                    params=params)
+                    self.tier, seed=self.seed, mesh=self.mesh,
+                    devices=self.devices, params=params)
             else:
-                if self.tier.decode_batch > 1:
-                    logger.warning(
-                        "tier %s: decode_batch=%d requested but tier is "
-                        "mesh-sharded — continuous batching is not supported "
-                        "there yet, using the sequential engine",
-                        self.tier.name, self.tier.decode_batch)
                 engine = InferenceEngine(
                     self.tier, seed=self.seed, mesh=self.mesh,
                     devices=self.devices, params=params)

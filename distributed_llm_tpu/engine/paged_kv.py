@@ -131,7 +131,6 @@ def chunk_prefill_paged(
     b, s_c = tokens.shape
     d = cfg.head_dim
     bs = pool["k"].shape[3]
-    wb = window // bs
 
     x = quant.embed_rows(params["embed"], tokens)            # [1, S_c, H]
     positions = start[:, None] + jnp.arange(s_c)[None, :]    # [1, S_c]
@@ -151,19 +150,13 @@ def chunk_prefill_paged(
         q = transformer.apply_rope(q, sin, cos)
         k = transformer.apply_rope(k, sin, cos)
 
-        # Scatter the chunk's K/V to its (head, block, offset) cells.
+        # Scatter the chunk's K/V to its (head, block, offset) cells, then
+        # attend the table window (Pallas: in-kernel block walk; XLA:
+        # gather-then-attend).
         k_pool = k_pool.at[:, blk, off].set(jnp.swapaxes(k[0], 0, 1))
         v_pool = v_pool.at[:, blk, off].set(jnp.swapaxes(v[0], 0, 1))
-
-        # Gather the attended window in logical order.
-        k_seq = jnp.swapaxes(
-            k_pool[:, table[:wb]].reshape(cfg.num_kv_heads, window, d),
-            0, 1)[None]
-        v_seq = jnp.swapaxes(
-            v_pool[:, table[:wb]].reshape(cfg.num_kv_heads, window, d),
-            0, 1)[None]
-        attn = attention.chunk(q, k_seq, v_seq, q_pos,
-                               impl=cfg.attention_impl)
+        attn = attention.paged_chunk(q, k_pool, v_pool, table, start, q_pos,
+                                     window, impl=cfg.attention_impl)
         x = x + quant.matmul(attn.reshape(b, s_c, cfg.num_heads * d),
                              lp["wo"])
         h_ffn = transformer.rms_norm(x, lp["ln2"], cfg.norm_eps)

@@ -23,7 +23,6 @@ exactly like the right-padded prefill garbage (engine/inference.py).
 
 from __future__ import annotations
 
-import dataclasses
 import time
 from typing import Any, Dict, Optional, Tuple
 

@@ -51,3 +51,24 @@ class ByteTokenizer:
 
     def encode_history(self, history: Union[str, Sequence[Dict[str, Any]]]) -> List[int]:
         return self.encode(self.format_history(history))
+
+
+class StreamDecoder:
+    """Incremental token→text-delta decoder for streaming engines.
+
+    Multi-byte UTF-8 sequences are held back until complete; special ids
+    (EOS/PAD and the rest of the non-byte range) produce no text.  One
+    shared implementation so the sequential and batching engines' SSE
+    output can never diverge."""
+
+    def __init__(self):
+        import codecs
+        self._decoder = codecs.getincrementaldecoder("utf-8")("replace")
+
+    def feed(self, token: int) -> str:
+        if 0 <= token < 256:
+            return self._decoder.decode(bytes([token]))
+        return ""
+
+    def flush(self) -> str:
+        return self._decoder.decode(b"", final=True)

@@ -93,6 +93,28 @@ def paged_decode(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
     return decode_attention(q, k_seq, v_seq, pos)
 
 
+def paged_chunk(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                table: jax.Array, start: jax.Array, q_pos: jax.Array,
+                window: int, impl: str = "auto") -> jax.Array:
+    """Dispatching suffix-chunk attention over a paged KV pool
+    (engine/paged_kv.chunk_prefill_paged): q [1, S_c, Nq, D], pools
+    [Nkv, NB, bs, D], table [MB], start [1], q_pos [1, S_c] clamped
+    absolute positions, static ``window``.  The Pallas path reconstructs
+    positions from ``start`` (contiguous-chunk contract, like
+    flash_chunk_attention); the XLA path gathers the window and masks by
+    ``q_pos`` (portable / GSPMD-shardable fallback)."""
+    nkv, bs, d = k_pool.shape[0], k_pool.shape[2], k_pool.shape[3]
+    if resolve_impl(impl) == "pallas":
+        from .pallas_attention import paged_chunk_attention
+        return paged_chunk_attention(q, k_pool, v_pool, table, start, window)
+    wb = window // bs
+    k_seq = jnp.swapaxes(
+        k_pool[:, table[:wb]].reshape(nkv, window, d), 0, 1)[None]
+    v_seq = jnp.swapaxes(
+        v_pool[:, table[:wb]].reshape(nkv, window, d), 0, 1)[None]
+    return chunk_attention(q, k_seq, v_seq, q_pos)
+
+
 def _expand_kv(x: jax.Array, groups: int) -> jax.Array:
     """[B, S, N_kv, D] -> [B, S, N_kv*groups, D] by repeating each kv head."""
     if groups == 1:

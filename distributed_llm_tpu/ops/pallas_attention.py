@@ -236,6 +236,108 @@ def flash_chunk_attention(q: jax.Array, k_cache: jax.Array,
 
 
 # =============================================================================
+# Paged chunk prefill: suffix queries against table blocks of the KV pool
+# =============================================================================
+
+def _paged_chunk_kernel(tbl_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
+                        acc_ref, m_ref, l_ref, *, bq: int, bs: int,
+                        scale: float):
+    """Flash recurrence over one slot's block-table window with the
+    per-query frontier of _chunk_kernel (row r attends cache cols ≤
+    start + r): grid (Nq, S_c/bq, W/bs), innermost j streams pool blocks
+    through VMEM via the scalar-prefetched table."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32) * scale                 # [BQ, D]
+    k = k_ref[0, 0]                                          # [bs, D]
+    v = v_ref[0, 0]
+    row_pos = start_ref[0] + i * bq + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, 1), 0)
+
+    s = jnp.dot(q, k.T.astype(jnp.float32),
+                preferred_element_type=jnp.float32)          # [BQ, bs]
+    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bs
+    s = jnp.where(col <= row_pos, s, NEG_INF)
+
+    m_prev, l_prev = m_ref[...], l_ref[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    m_ref[...] = m_new
+    l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+
+    @pl.when(j == nb - 1)
+    def _done():
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_chunk_attention(q: jax.Array, k_pool: jax.Array,
+                          v_pool: jax.Array, table: jax.Array,
+                          start: jax.Array, window: int) -> jax.Array:
+    """Suffix-chunk attention straight out of a paged KV pool: q
+    [1, S_c, Nq, D] (the chunk's queries at absolute positions start+r),
+    pools [Nkv, NB, bs, D], table [MB] the slot's block row, start [1]
+    -> [1, S_c, Nq, D].  ``window`` (static, multiple of bs) bounds the
+    attended positions; the chunk's own K/V are already scattered into the
+    table's blocks (write-before-attend), and the per-query causal
+    frontier masks everything past each row.  Replaces the XLA path's
+    whole-window gather in engine/paged_kv.chunk_prefill_paged."""
+    _, s_c, nq, d = q.shape
+    nkv, bs = k_pool.shape[0], k_pool.shape[2]
+    groups = nq // nkv
+    bq = min(s_c, 128)
+    if s_c % bq or window % bs:
+        raise ValueError(
+            f"paged_chunk_attention: chunk {s_c} / window {window} not "
+            f"multiples of the ({bq}, {bs}) blocks")
+    wb = window // bs
+
+    qh = q[0].transpose(1, 0, 2)                             # [Nq, S_c, D]
+    tbl32 = table.astype(jnp.int32)
+    start32 = start.astype(jnp.int32).reshape(1)
+
+    kernel = functools.partial(_paged_chunk_kernel, bq=bq, bs=bs,
+                               scale=d ** -0.5)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nq, s_c // bq, wb),
+        in_specs=[
+            pl.BlockSpec((1, bq, d),
+                         lambda h, i, j, tbl, st: (h, i, 0)),
+            pl.BlockSpec((1, 1, bs, d),
+                         lambda h, i, j, tbl, st: (h // groups, tbl[j], 0, 0)),
+            pl.BlockSpec((1, 1, bs, d),
+                         lambda h, i, j, tbl, st: (h // groups, tbl[j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d),
+                               lambda h, i, j, tbl, st: (h, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        interpret=_interpret(),
+    )(tbl32, start32, qh, k_pool, v_pool)
+    return out.transpose(1, 0, 2)[None]                      # [1, S_c, Nq, D]
+
+
+# =============================================================================
 # Paged decode: block-table attention straight out of the KV pool
 # =============================================================================
 

@@ -14,7 +14,7 @@ The same rules serve inference (engine on a tier submesh) and training
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -148,6 +148,19 @@ def kv_cache_specs(tp_axis: str = "tp") -> Dict[str, P]:
     """KV cache [L, B, S, N_kv, D]: shard the kv-head axis over tp."""
     return {"k": P(None, None, None, tp_axis, None),
             "v": P(None, None, None, tp_axis, None)}
+
+
+def kv_pool_specs(tp_axis: str = "tp") -> Dict[str, P]:
+    """Paged KV pool [L, N_kv, NB, bs, D] (engine/paged_kv.py head-major
+    layout): shard the kv-head axis over tp, like the contiguous cache —
+    each shard owns its heads' blocks, and the decode step's scatter/gather
+    batch over the head axis without resharding."""
+    return {"k": P(None, tp_axis, None, None, None),
+            "v": P(None, tp_axis, None, None, None)}
+
+
+def kv_pool_shardings(mesh: Mesh, tp_axis: str = "tp") -> Dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, s) for k, s in kv_pool_specs(tp_axis).items()}
 
 
 def kv_cache_shardings(mesh: Mesh, tp_axis: str = "tp") -> Dict[str, NamedSharding]:

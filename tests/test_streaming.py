@@ -66,15 +66,20 @@ def test_sse_endpoint_streams_and_terminates():
     app.extensions["dllm_manager"].stop_server()
 
 
-def test_sse_endpoint_rejects_unbatched_tier():
-    cluster = ClusterConfig(
-        nano=_tier(decode_batch=1),
-        orin=_tier(name="orin", model_preset="orin_test", decode_batch=1))
-    app = create_tier_app("nano", cluster=cluster)
+def test_sse_endpoint_rejects_engine_without_stream_support():
+    """Engines lacking generate_stream (e.g. the speculative engine) get a
+    501, not a crash.  (Sequential AND batched engines both stream now.)"""
+    class _NoStreamEngine:
+        pass
+
+    class _Mgr:
+        def engine(self):
+            return _NoStreamEngine()
+
+    app = create_tier_app("nano", manager=_Mgr())
     resp = app.test_client().post("/query/stream",
                                   json={"query": "user: x"})
     assert resp.status_code == 501
-    app.extensions["dllm_manager"].stop_server()
 
 
 def test_stream_terminates_when_admission_fails():
@@ -95,3 +100,44 @@ def test_batched_engine_still_has_warmup():
         engine.warmup()                      # regression: method exists
     finally:
         engine.stop()
+
+
+def test_sequential_engine_stream_matches_generate():
+    """The sequential engine's segmented stream must be token-identical to
+    its one-call generate (same compiled decode program, sliced by the
+    runtime budget operand)."""
+    from distributed_llm_tpu.engine.inference import InferenceEngine
+
+    tier = _tier(decode_batch=1)
+    a = InferenceEngine(tier, seed=31)
+    b = InferenceEngine(tier, seed=31)
+    ref = a.generate("user: stream me sequentially", max_new_tokens=7)
+    handle = b.generate_stream("user: stream me sequentially",
+                               max_new_tokens=7, segment=3)
+    text = "".join(handle)
+    assert text == ref.text
+    assert handle.result.token_ids == ref.token_ids
+    assert handle.result.gen_tokens == ref.gen_tokens
+
+
+def test_sequential_stream_sse_endpoint():
+    """/query/stream serves decode_batch=1 tiers through the same SSE
+    contract as batched tiers."""
+    from distributed_llm_tpu.engine.manager import EngineManager
+
+    mgr = EngineManager(_tier(decode_batch=1), warmup_on_start=False)
+    app = create_tier_app("nano", manager=mgr)
+    try:
+        c = app.test_client()
+        resp = c.post("/query/stream",
+                      json={"query": "user: sse sequential", "num_predict": 5})
+        assert resp.status_code == 200
+        events = [json.loads(line[len("data: "):]) for line in
+                  resp.text.strip().split("\n\n")
+                  if line.startswith("data: ")]
+        assert events and events[-1].get("done") is True
+        deltas = "".join(e.get("delta", "") for e in events[:-1])
+        assert isinstance(deltas, str)
+        assert events[-1]["tokens"] >= 1
+    finally:
+        mgr.stop_server()
