@@ -207,6 +207,11 @@ class TierConfig:
     # int8 halves decode's HBM weight traffic.  Unsharded dense tiers only
     # (sharding rules and the trainer see full-precision leaf paths).
     quantize: str = "none"
+    # Cross-host tier: base URL of a tpu_api server on another host
+    # (serving/remote.py — the DCN twin of the reference's SSH-tunneled
+    # device endpoints, src/models/nano.py:4-8).  When set, no local
+    # engine/submesh is built for this tier; requests POST /query there.
+    endpoint: Optional[str] = None
 
     def model(self) -> ModelConfig:
         return MODEL_PRESETS[self.model_preset]

@@ -15,7 +15,7 @@ still runs, with tiers distinguished by model size alone.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 import jax
 import numpy as np
@@ -49,6 +49,8 @@ def carve_tier_meshes(
     meshes: Dict[str, jax.sharding.Mesh] = {}
     cursor = 0
     for tier in cluster.tiers():
+        if tier.endpoint:
+            continue        # cross-host tier: its chips live on that host
         remaining = len(devices) - cursor
         tp = _fit_tp(tier, max(remaining, 0))
         if tp == 0:

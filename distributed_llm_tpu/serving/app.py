@@ -174,7 +174,9 @@ def create_app(router: Optional[Router] = None,
         for name, tier in router_.tiers.items():
             mgr = tier.server_manager
             entry = dict(mgr.health())
-            engine = mgr._engine          # peek without lazy-starting it
+            # Peek without lazy-starting; remote tiers' managers
+            # (serving/remote.py) have no local engine at all.
+            engine = getattr(mgr, "_engine", None)
             if engine is not None and hasattr(engine, "phases"):
                 entry["phases"] = engine.phases.summary()
             if engine is not None and getattr(engine, "prefix_cache", None):

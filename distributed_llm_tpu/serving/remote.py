@@ -1,0 +1,161 @@
+"""Remote tier client — the cross-host (DCN) device-client layer.
+
+Reference parity: src/models/nano.py / src/models/orin.py POST the chat
+history as JSON to a per-device Flask server reached through an SSH tunnel
+(src/models/nano.py:23-28, src/models/server_manager.py:34-50).  In a
+multi-host TPU deployment the same ``/query`` + ``/health`` contract
+(serving/tpu_api.py) crosses hosts over plain HTTP on the data-center
+network — intra-slice traffic rides ICI inside each engine; only
+request/response JSON crosses DCN, exactly like the reference's
+router→device hop.
+
+Divergences from the reference client, documented:
+
+- Both tiers get (connect, read) timeouts.  The reference's Orin client has
+  NO timeout (src/models/orin.py:26, SURVEY.md §7 quirk list) — an
+  asymmetric bug we fix rather than reproduce.
+- ``RemoteServerManager.start_server`` cannot SSH-bootstrap the remote
+  process (the reference scripts a login + nohup, server_manager.py:77-105;
+  a TPU pod host runs its tier server under its own supervisor).  It keeps
+  the same *readiness* semantics instead: poll ``GET /health`` 15×1 s
+  (reference server_manager.py:122-134) and raise if the server never
+  comes up.  ``stop_server`` is a local no-op for the same reason.
+- ``process`` opts into the ``stats`` extension of ``/query`` so the
+  router's perf strategy and TTFT accounting keep working across hosts
+  (the reference measures latency host-side only).
+
+Error-dict shapes match src/models/nano.py:30-40 so Router failover and
+``_is_error`` treat remote tiers exactly like local ones.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Union
+
+from ..engine.inference import GenerationResult
+from ..utils.faults import FaultInjector
+
+logger = logging.getLogger(__name__)
+
+History = Union[str, List[Dict[str, Any]]]
+
+HEALTH_POLL_ATTEMPTS = 15          # reference: 15×1 s (server_manager.py:128)
+HEALTH_POLL_INTERVAL_S = 1.0
+CONNECT_TIMEOUT_S = 5.0            # reference nano.py:28 (5, 180)
+READ_TIMEOUT_S = 180.0
+
+
+def _http_json(url: str, payload: Optional[Dict[str, Any]] = None,
+               timeout: float = READ_TIMEOUT_S) -> Dict[str, Any]:
+    """POST (or GET when payload is None) expecting a JSON body.  Raises
+    ValueError on a non-JSON reply — the remote twin of the reference's
+    content-type guard (src/models/nano.py:30-33)."""
+    data = None if payload is None else json.dumps(payload).encode("utf-8")
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        ctype = resp.headers.get("Content-Type", "")
+        body = resp.read()
+    if "application/json" not in ctype:
+        raise ValueError(f"non-JSON response (Content-Type {ctype!r})")
+    return json.loads(body.decode("utf-8"))
+
+
+class RemoteServerManager:
+    """ServerManager surface over a tier server on another host.
+
+    Lifecycle of the remote process belongs to that host's supervisor; this
+    manager owns *readiness*: ``start_server`` blocks until ``/health``
+    answers (or raises), ``is_server_running`` probes it once."""
+
+    def __init__(self, base_url: str,
+                 connect_timeout: float = CONNECT_TIMEOUT_S):
+        self.base_url = base_url.rstrip("/")
+        self.connect_timeout = connect_timeout
+
+    def is_server_running(self) -> bool:
+        try:
+            return bool(self.health().get("ok"))
+        except Exception:
+            return False
+
+    def health(self) -> Dict[str, Any]:
+        return _http_json(f"{self.base_url}/health",
+                          timeout=self.connect_timeout)
+
+    def start_server(self) -> None:
+        """Wait for the remote tier to be ready (reference readiness
+        protocol: /health poll 15×1 s, server_manager.py:122-134)."""
+        for attempt in range(HEALTH_POLL_ATTEMPTS):
+            if self.is_server_running():
+                return
+            if attempt < HEALTH_POLL_ATTEMPTS - 1:
+                time.sleep(HEALTH_POLL_INTERVAL_S)
+        raise TimeoutError(
+            f"remote tier at {self.base_url} not healthy after "
+            f"{HEALTH_POLL_ATTEMPTS} attempts")
+
+    def stop_server(self) -> None:
+        """No-op: the remote host supervises its own process (see module
+        docstring)."""
+
+
+class RemoteTierClient:
+    """TierClient twin whose engine lives across DCN: same ``.process``,
+    ``.server_manager``, ``.last_result`` surface as serving/tiers.py."""
+
+    def __init__(self, name: str, base_url: str,
+                 fault_injector: Optional[FaultInjector] = None,
+                 read_timeout: float = READ_TIMEOUT_S):
+        self.name = name
+        self.tier = None                   # no local TierConfig — remote
+        self.base_url = base_url.rstrip("/")
+        self.read_timeout = read_timeout
+        self.server_manager = RemoteServerManager(self.base_url)
+        self.faults = fault_injector
+        self.last_result: Optional[GenerationResult] = None
+
+    def process(self, history: History) -> Dict[str, Any]:
+        if self.faults is not None:
+            fault = self.faults.intercept(self.name)
+            if fault is not None:
+                return fault
+        # No health round trip — but DO enforce the connect timeout
+        # separately (urllib has a single timeout knob, and inference can
+        # legitimately take the full read timeout): a cheap 5 s TCP probe
+        # makes a dead/blackholed host fail fast into the router's
+        # failover instead of stalling each request for read_timeout.
+        # The reference client's lazy SSH restart (src/models/nano.py:19-21)
+        # has no equivalent here — the remote host supervises its own
+        # process.
+        try:
+            parts = urllib.parse.urlsplit(self.base_url)
+            conn = socket.create_connection(
+                (parts.hostname, parts.port or 80),
+                timeout=self.server_manager.connect_timeout)
+            conn.close()
+            payload = _http_json(f"{self.base_url}/query",
+                                 {"query": history, "stats": True},
+                                 timeout=self.read_timeout)
+        except (urllib.error.URLError, socket.timeout, TimeoutError,
+                ValueError, OSError) as exc:
+            return {"error": f"Request failed: {exc}"}
+
+        stats = payload.pop("stats", None)
+        if isinstance(stats, dict):
+            self.last_result = GenerationResult(
+                text=payload.get("response", ""),
+                token_ids=[],
+                prompt_tokens=int(stats.get("prompt_tokens", 0)),
+                gen_tokens=int(stats.get("gen_tokens", 0)),
+                ttft_ms=float(stats.get("ttft_ms", 0.0)),
+                total_ms=float(stats.get("total_ms", 0.0)),
+            )
+        return payload

@@ -68,10 +68,17 @@ def build_tiers(
     fault_injector: Optional[FaultInjector] = None,
     warmup_on_start: bool = True,
 ) -> Dict[str, TierClient]:
-    """Carve submeshes and wire a client per tier (registry, not classes)."""
+    """Carve submeshes and wire a client per tier (registry, not classes).
+    Tiers with an ``endpoint`` dispatch across hosts (serving/remote.py)
+    instead of building a local engine."""
     meshes = carve_tier_meshes(cluster, devices=devices)
     tiers: Dict[str, TierClient] = {}
     for tier in cluster.tiers():
+        if tier.endpoint:
+            from .remote import RemoteTierClient
+            tiers[tier.name] = RemoteTierClient(
+                tier.name, tier.endpoint, fault_injector=fault_injector)
+            continue
         mesh = meshes[tier.name]
         # A 1-device mesh adds partitioning overhead for no benefit: pin to
         # the single device instead.

@@ -82,7 +82,19 @@ def create_tier_app(tier_name: str,
         try:
             result = manager.engine().generate(
                 query, max_new_tokens=max_new, temperature=temperature)
-            return jsonify({"response": result.text.strip()})
+            payload: Dict[str, Any] = {"response": result.text.strip()}
+            if data.get("stats"):
+                # Opt-in extension (the bare reply stays reference-faithful,
+                # src/devices/nano_api.py:83): generation metrics so a
+                # cross-host caller (serving/remote.py) can feed the perf
+                # strategy and TTFT accounting without a second request.
+                payload["stats"] = {
+                    "prompt_tokens": result.prompt_tokens,
+                    "gen_tokens": result.gen_tokens,
+                    "ttft_ms": round(result.ttft_ms, 3),
+                    "total_ms": round(result.total_ms, 3),
+                }
+            return jsonify(payload)
         except TimeoutError:
             return jsonify({"error": "Inference timed out"}), 504
         except Exception as exc:
@@ -101,8 +113,8 @@ def create_tier_app(tier_name: str,
             return jsonify({"error": "No/invalid query provided"}), 400
         engine = manager.engine()
         if not hasattr(engine, "generate_stream"):
-            return jsonify({"error": "streaming needs a batched tier "
-                                     "(decode_batch > 1)"}), 501
+            return jsonify({"error": "this tier's engine does not support "
+                                     "token streaming"}), 501
         try:
             num_predict = int(data.get("num_predict") or DEFAULT_NUM_PREDICT)
             temperature = float(data.get("temperature")
