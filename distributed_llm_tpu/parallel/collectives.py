@@ -17,7 +17,7 @@ signals are allgathered over ICI"):
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict
+
 
 import jax
 import jax.numpy as jnp

@@ -25,7 +25,7 @@ from __future__ import annotations
 import re
 import threading
 import zlib
-from typing import List, Sequence
+from typing import Sequence
 
 import numpy as np
 
