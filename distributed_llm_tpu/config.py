@@ -168,6 +168,13 @@ class TierConfig:
     name: str                       # "nano" | "orin" | ...
     model_preset: str               # key into MODEL_PRESETS
     tp: int = 1                     # tensor-parallel degree (submesh size)
+    # Sequence-parallel degree for PREFILL: sp>1 makes the tier submesh 2-D
+    # ('sp','tp') and the prefill runs ring attention over the sp axis
+    # (parallel/ring_attention.py) with activations sequence-sharded, so a
+    # long prompt's O(S²) attention spreads over sp chips.  Decode and the
+    # KV cache stay sharded on tp only (sequence replicated) — decode is
+    # bandwidth-bound on weights, not attention FLOPs.  Dense models only.
+    sp: int = 1
     max_new_tokens: int = 256       # decode cap (reference: num_predict, -1=unbounded)
     temperature: float = 0.0        # greedy by default (src/devices/nano_api.py:21)
     prefill_buckets: Tuple[int, ...] = (64, 128, 256, 512, 1024, 2048)

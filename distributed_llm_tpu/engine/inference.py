@@ -144,6 +144,25 @@ class InferenceEngine:
         # generate()'s suffix-bucket choice and warmup()'s precompiles.
         self._buckets = sorted(set(
             b for b in tier.prefill_buckets if b <= self._max_seq))
+        # Sequence-parallel tiers extend the ladder to max_seq: each chip
+        # holds only S/sp of the activations, so the whole model context
+        # prefills as ONE ring-attention call — the O(S²) long-prompt case
+        # sp exists for.  Without this, prompts past the largest bucket
+        # would fall to the chunk-stride path, which the sp hook does not
+        # cover (suffix chunks are O(delta) and stay GSPMD-sharded).
+        # Prefix-reuse SUFFIX bucketing keeps the unextended tier ladder:
+        # a long new turn should chunk-stride (O(delta), warmed programs),
+        # not pad out to a giant unsharded suffix prefill.
+        self._suffix_buckets = list(self._buckets)
+        if (mesh is not None and dict(mesh.shape).get("sp", 1) > 1
+                and self.cfg.num_experts == 1
+                and self._buckets and self._buckets[-1] < self._max_seq):
+            ladder = self._buckets[-1]
+            while ladder * 2 <= self._max_seq:
+                ladder *= 2
+                self._buckets.append(ladder)
+            if self._buckets[-1] < self._max_seq:
+                self._buckets.append(self._max_seq)
         # Bucketed KV-cache lengths: decode attention reads the WHOLE cache
         # every step, so sizing it to the conversation (next candidate ≥
         # prompt + decode cap) instead of max_seq_len cuts decode's HBM
@@ -189,6 +208,20 @@ class InferenceEngine:
         return next(c for c in self._cache_lens if c >= min(needed,
                                                             self._max_seq))
 
+    def _sp_attn(self, bucket: int):
+        """Ring-attention override for sequence-parallel prefill, when the
+        tier mesh has an 'sp' axis that divides this bucket (dense models
+        only — models.serving_prefill ignores the hook for MoE)."""
+        mesh = self.mesh
+        if (mesh is None or self.cfg.num_experts > 1
+                or "sp" not in mesh.shape or mesh.shape["sp"] <= 1
+                or bucket % mesh.shape["sp"]):
+            return None
+        from ..parallel.ring_attention import ring_attention
+        head_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
+        return lambda q, k, v: ring_attention(q, k, v, mesh, "sp",
+                                              head_axis=head_axis)
+
     def _prefill_fn(self, bucket: int, cache_len: int):
         """Jitted per (prompt bucket, cache length): embed+forward the
         padded prompt, seed a cache sized for this conversation, sample the
@@ -198,11 +231,13 @@ class InferenceEngine:
             return self._prefill_fns[key]
 
         cfg = self.cfg
+        sp_attn = self._sp_attn(bucket)
 
         def run(params, tokens, true_len, rng, temperature):
             b, s = tokens.shape
             positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
-            hidden, (k_all, v_all) = models.serving_prefill(cfg, params, tokens, positions)
+            hidden, (k_all, v_all) = models.serving_prefill(
+                cfg, params, tokens, positions, attn=sp_attn)
             # logits only at each sequence's last real position
             last = hidden[jnp.arange(b), true_len - 1]
             logits = transformer.logits_from_hidden(params, last)
@@ -249,7 +284,10 @@ class InferenceEngine:
         meaningful, and only it is used.
         """
         n = len(ids)
-        cb = self._buckets[-1]
+        # Stride with the SUFFIX ladder's largest bucket: on sp tiers the
+        # prompt ladder extends to max_seq (ring prefill), but chunk
+        # striding should keep the warmed tier-bucket-sized programs.
+        cb = self._suffix_buckets[-1]
         if cache is None:
             cache = self._init_cache_fn(cache_len)()
         first = None
@@ -378,7 +416,7 @@ class InferenceEngine:
         t0 = time.perf_counter()
         with self.phases.phase("tokenize"):
             ids, bucket = prepare_prompt(self.tokenizer, history,
-                                         self.tier.prefill_buckets,
+                                         self._buckets,
                                          self._max_seq,
                                          self.tier.max_new_tokens,
                                          allow_long=True)
@@ -410,7 +448,7 @@ class InferenceEngine:
         # instead of O(history) — the reference re-prefills everything
         # through Ollama every turn, SURVEY.md §3.1).
         from .prefix_cache import select_reuse
-        sel = select_reuse(self.prefix_cache, ids, self._buckets,
+        sel = select_reuse(self.prefix_cache, ids, self._suffix_buckets,
                            self._max_seq, allow_long_suffix=True)
         reused = (sel[0].cache, sel[1], sel[2], sel[3]) if sel else None
 
@@ -424,7 +462,8 @@ class InferenceEngine:
         if reused is not None:
             m, sb = reused[1], reused[3]
             if sb is None:     # bucket-exceeding suffix, chunked from m
-                needed = max(needed, m + -(-(n - m) // cb) * cb)
+                scb = self._suffix_buckets[-1]   # the chunk-stride size
+                needed = max(needed, m + -(-(n - m) // scb) * scb)
             else:
                 needed = max(needed, m + sb)
         cache_len = self._pick_cache_len(needed)

@@ -223,50 +223,93 @@ class SpeculativeEngine:
 
     def generate(self, history, max_new_tokens: Optional[int] = None,
                  temperature: Optional[float] = None) -> GenerationResult:
+        handle = self.generate_stream(history, max_new_tokens, temperature)
+        for _ in handle:          # drain; deltas are a byproduct here
+            pass
+        if handle.request.error is not None:
+            raise handle.request.error
+        return handle.request.result
+
+    def generate_stream(self, history, max_new_tokens: Optional[int] = None,
+                        temperature: Optional[float] = None):
+        """Token streaming off the speculative loop: each accepted round's
+        tokens yield as text deltas (same StreamHandle surface as the other
+        engines; generate() is implemented on top, so the two paths cannot
+        diverge)."""
         if temperature:
             raise NotImplementedError(
                 "speculative engine is greedy-only (reference default, "
                 "src/devices/nano_api.py:21)")
-        t0 = time.perf_counter()
-        ids, bucket = prepare_prompt(
-            self.tokenizer, history, self.target.prefill_buckets,
-            self._max_seq, self.target.max_new_tokens)
-        n = len(ids)
-        budget = self.target.max_new_tokens
-        if max_new_tokens and max_new_tokens > 0:
-            budget = min(budget, max_new_tokens)
+        from .batching import StreamHandle, _Request
+        from .tokenizer import StreamDecoder
 
-        tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
-        tokens[0, :n] = ids
-        first, cache_t, cache_d = self._prefill_fn(bucket)(
-            self.params_t, self.params_d, jnp.asarray(tokens),
-            jnp.asarray([n], np.int32))
-        first = int(jax.block_until_ready(first)[0])
-        ttft_ms = (time.perf_counter() - t0) * 1000.0
+        req = _Request(history=history, max_new_tokens=max_new_tokens,
+                       temperature=temperature)
 
-        out_tokens = [first]
-        cur = jnp.asarray([first], jnp.int32)
-        pos = jnp.asarray([n], jnp.int32)
-        step = self._spec_step()
-        while (len(out_tokens) < budget
-               and out_tokens[-1] != self.tokenizer.eos_id
-               and int(pos[0]) + self.gamma + 1 < self._max_seq):
-            out, n_acc, cur, pos, cache_t, cache_d = step(
-                self.params_t, self.params_d, cache_t, cache_d, cur, pos)
-            n_acc_i = int(n_acc[0])
-            self.accept_history.append(n_acc_i)
-            for tok in np.asarray(out)[0][:n_acc_i + 1].tolist():
-                out_tokens.append(int(tok))
-                if out_tokens[-1] == self.tokenizer.eos_id:
-                    break
+        def deltas():
+            decoder = StreamDecoder()
+            eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
+            try:
+                t0 = time.perf_counter()
+                ids, bucket = prepare_prompt(
+                    self.tokenizer, history, self.target.prefill_buckets,
+                    self._max_seq, self.target.max_new_tokens)
+                n = len(ids)
+                budget = self.target.max_new_tokens
+                if max_new_tokens and max_new_tokens > 0:
+                    budget = min(budget, max_new_tokens)
 
-        total_ms = (time.perf_counter() - t0) * 1000.0
-        gen_ids = trim_at_eos(out_tokens[:budget], self.tokenizer.eos_id,
-                              self.tokenizer.pad_id)
-        return GenerationResult(
-            text=self.tokenizer.decode(gen_ids), token_ids=gen_ids,
-            prompt_tokens=n, gen_tokens=len(gen_ids),
-            ttft_ms=ttft_ms, total_ms=total_ms)
+                tokens = np.full((1, bucket), pad, np.int32)
+                tokens[0, :n] = ids
+                first, cache_t, cache_d = self._prefill_fn(bucket)(
+                    self.params_t, self.params_d, jnp.asarray(tokens),
+                    jnp.asarray([n], np.int32))
+                first = int(jax.block_until_ready(first)[0])
+                ttft_ms = (time.perf_counter() - t0) * 1000.0
+
+                out_tokens = [first]
+                if first not in (eos, pad):
+                    text = decoder.feed(first)
+                    if text:
+                        yield text
+                cur = jnp.asarray([first], jnp.int32)
+                pos = jnp.asarray([n], jnp.int32)
+                step = self._spec_step()
+                while (len(out_tokens) < budget
+                       and out_tokens[-1] not in (eos, pad)
+                       and int(pos[0]) + self.gamma + 1 < self._max_seq):
+                    out, n_acc, cur, pos, cache_t, cache_d = step(
+                        self.params_t, self.params_d, cache_t, cache_d, cur,
+                        pos)
+                    n_acc_i = int(n_acc[0])
+                    self.accept_history.append(n_acc_i)
+                    for tok in np.asarray(out)[0][:n_acc_i + 1].tolist():
+                        tok = int(tok)
+                        out_tokens.append(tok)
+                        # PAD ends the stream like EOS (trim_at_eos trims
+                        # the result there, batching.py does the same).
+                        if tok in (eos, pad) or len(out_tokens) > budget:
+                            break
+                        text = decoder.feed(tok)
+                        if text:
+                            yield text
+                tail = decoder.flush()
+                if tail:
+                    yield tail
+
+                gen_ids = trim_at_eos(out_tokens[:budget], eos, pad)
+                req.result = GenerationResult(
+                    text=self.tokenizer.decode(gen_ids), token_ids=gen_ids,
+                    prompt_tokens=n, gen_tokens=len(gen_ids),
+                    ttft_ms=ttft_ms,
+                    total_ms=(time.perf_counter() - t0) * 1000.0)
+            except BaseException as exc:
+                req.error = exc
+                raise
+            finally:
+                req.done.set()
+
+        return StreamHandle(deltas(), req)
 
     @property
     def acceptance_rate(self) -> float:

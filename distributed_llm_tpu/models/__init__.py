@@ -18,9 +18,13 @@ def model_module(cfg: ModelConfig):
     return moe if cfg.num_experts > 1 else transformer
 
 
-def serving_prefill(cfg: ModelConfig, params, tokens, positions):
-    """(hidden, (k_all, v_all)) for either family (drops MoE aux loss)."""
-    out = model_module(cfg).prefill(cfg, params, tokens, positions)
+def serving_prefill(cfg: ModelConfig, params, tokens, positions, attn=None):
+    """(hidden, (k_all, v_all)) for either family (drops MoE aux loss).
+    ``attn`` (dense only): attention-op override — see transformer.prefill."""
+    if cfg.num_experts > 1:
+        out = moe.prefill(cfg, params, tokens, positions)
+    else:
+        out = transformer.prefill(cfg, params, tokens, positions, attn=attn)
     return out[0], out[1]
 
 

@@ -104,16 +104,23 @@ def _swiglu(x: jax.Array, gate, up, down) -> jax.Array:
 # =============================================================================
 
 def prefill(cfg: ModelConfig, params: Params, tokens: jax.Array,
-            positions: jax.Array) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+            positions: jax.Array, attn=None
+            ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """Process a full (right-padded) prompt.
 
     tokens/positions: [B, S].  Returns (hidden [B,S,H],
     (k_all, v_all) each [L,B,S,N_kv,D]) — the per-layer K/V to seed the cache.
+    ``attn`` optionally replaces the causal-attention op (q, k, v) ->
+    [B,S,Nq,D] — the hook sequence-parallel prefill uses to swap in ring
+    attention over the 'sp' mesh axis (parallel/ring_attention.py).
     """
     b, s = tokens.shape
     d = cfg.head_dim
     x = quant.embed_rows(params["embed"], tokens)                       # [B,S,H]
     sin, cos = rope_sincos(positions, d, cfg.rope_theta)
+    if attn is None:
+        attn = lambda q, k, v: attention.causal(q, k, v,
+                                                impl=cfg.attention_impl)
 
     def layer(x, lp):
         h_in = rms_norm(x, lp["ln1"], cfg.norm_eps)
@@ -122,9 +129,8 @@ def prefill(cfg: ModelConfig, params: Params, tokens: jax.Array,
         v = quant.matmul(h_in, lp["wv"]).reshape(b, s, cfg.num_kv_heads, d)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
-        attn = attention.causal(q, k, v, impl=cfg.attention_impl
-                                ).reshape(b, s, cfg.num_heads * d)
-        x = x + quant.matmul(attn, lp["wo"])
+        out = attn(q, k, v).reshape(b, s, cfg.num_heads * d)
+        x = x + quant.matmul(out, lp["wo"])
         x = x + _swiglu(rms_norm(x, lp["ln2"], cfg.norm_eps),
                         lp["w_gate"], lp["w_up"], lp["w_down"])
         return x, (k, v)

@@ -30,6 +30,14 @@ def tp_mesh(devices: Sequence[jax.Device], tp: int,
     return jax.sharding.Mesh(chosen, (axis_name,))
 
 
+def sp_tp_mesh(devices: Sequence[jax.Device], sp: int,
+               tp: int) -> jax.sharding.Mesh:
+    """A 2-D ('sp', 'tp') tier mesh over the first sp·tp devices —
+    sequence-parallel ring prefill × tensor-parallel weights."""
+    chosen = np.array(list(devices[:sp * tp])).reshape(sp, tp)
+    return jax.sharding.Mesh(chosen, ("sp", "tp"))
+
+
 def carve_tier_meshes(
     cluster: ClusterConfig,
     devices: Optional[Sequence[jax.Device]] = None,
@@ -55,13 +63,38 @@ def carve_tier_meshes(
         tp = _fit_tp(tier, max(remaining, 0))
         if tp == 0:
             # Nothing left — share chips from the front (single-chip box).
-            tp = _fit_tp(tier, len(devices))
-            tp = max(tp, 1)
-            meshes[tier.name] = tp_mesh(devices, tp)
+            tp = max(_fit_tp(tier, len(devices)), 1)
+            sp = _fit_sp(tier, len(devices), tp)
+            meshes[tier.name] = (sp_tp_mesh(devices, sp, tp) if sp > 1
+                                 else tp_mesh(devices, tp))
             continue
-        meshes[tier.name] = tp_mesh(devices[cursor:cursor + tp], tp)
-        cursor += tp
+        sp = _fit_sp(tier, remaining, tp)
+        meshes[tier.name] = (sp_tp_mesh(devices[cursor:], sp, tp) if sp > 1
+                             else tp_mesh(devices[cursor:], tp))
+        cursor += tp * sp
     return meshes
+
+
+def _fit_sp(tier: TierConfig, available: int, tp: int) -> int:
+    """Largest power-of-two sequence-parallel degree ≤ requested that fits
+    the remaining chips alongside tp (power of two so it divides the
+    power-of-two prefill buckets).  Returns 1 — reserving no extra chips —
+    for tiers whose engine cannot use the sp axis (only the dense
+    sequential InferenceEngine runs ring prefill)."""
+    if tier.sp > 1 and (tier.model().num_experts > 1
+                        or tier.decode_batch > 1 or tier.draft_preset):
+        import logging
+        logging.getLogger(__name__).warning(
+            "tier %s: sp=%d ignored — sequence-parallel prefill needs the "
+            "dense sequential engine (MoE=%s decode_batch=%d draft=%s); "
+            "not reserving extra chips",
+            tier.name, tier.sp, tier.model().num_experts > 1,
+            tier.decode_batch, tier.draft_preset)
+        return 1
+    sp = 1
+    while (sp * 2 <= tier.sp and sp * 2 * tp <= available):
+        sp *= 2
+    return sp
 
 
 def _fit_tp(tier: TierConfig, available: int) -> int:

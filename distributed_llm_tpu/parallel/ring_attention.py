@@ -33,15 +33,19 @@ def ring_attention(
     mesh: Mesh,
     axis_name: str = "sp",
     causal: bool = True,
+    head_axis: str | None = None,
 ) -> jax.Array:
     """q: [B, S, N_q, D]; k/v: [B, S, N_kv, D], S sharded over ``axis_name``.
 
-    Returns [B, S, N_q, D] with the same sharding.
+    Returns [B, S, N_q, D] with the same sharding.  ``head_axis`` names a
+    second mesh axis sharding the head dim (2-D sp×tp serving meshes) so
+    tensor-parallel shards keep only their own heads through the ring —
+    omitted, heads are treated as replicated over every other mesh axis.
     """
     n_shards = mesh.shape[axis_name]
     groups = q.shape[2] // k.shape[2]
 
-    spec = P(None, axis_name, None, None)
+    spec = P(None, axis_name, head_axis, None)
 
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec, check_vma=False)

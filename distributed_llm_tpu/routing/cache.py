@@ -392,18 +392,20 @@ class QueryCache:
                     "predicted_confidence": round(conf, 3),
                     "history_len": len(e.routing_history),
                 })
-        return {
-            "size": size,
-            "max_size": self.max_size,
-            "valid": valid,
-            "stale": size - valid,
-            "hits": self._hits,
-            "attempts": self._attempts,
-            "hit_rate": round(self._hits / max(self._attempts, 1), 4),
-            "evictions": self._evictions,
-            "hybrid_fallbacks": self._hybrid_fallbacks,
-            "top_queries": top,
-        }
+            # Counters read under the same lock (the reference reads size
+            # outside it, cache.py:481 — SURVEY.md §7 quirks).
+            return {
+                "size": size,
+                "max_size": self.max_size,
+                "valid": valid,
+                "stale": size - valid,
+                "hits": self._hits,
+                "attempts": self._attempts,
+                "hit_rate": round(self._hits / max(self._attempts, 1), 4),
+                "evictions": self._evictions,
+                "hybrid_fallbacks": self._hybrid_fallbacks,
+                "top_queries": top,
+            }
 
     def clear(self) -> None:
         with self._lock:

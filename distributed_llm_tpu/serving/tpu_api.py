@@ -123,8 +123,16 @@ def create_tier_app(tier_name: str,
             return jsonify({"error": "num_predict/temperature must be "
                                      "numeric"}), 400
         max_new = num_predict if num_predict > 0 else None
-        handle = engine.generate_stream(query, max_new_tokens=max_new,
-                                        temperature=temperature)
+        try:
+            handle = engine.generate_stream(query, max_new_tokens=max_new,
+                                            temperature=temperature)
+        except NotImplementedError as exc:
+            # e.g. the speculative engine is greedy-only: keep the JSON
+            # error contract instead of a framework 500 page.
+            return jsonify({"error": str(exc)}), 501
+        except Exception as exc:
+            logger.exception("stream setup failed")
+            return jsonify({"error": f"Inference failed: {exc}"}), 500
 
         def events():
             try:

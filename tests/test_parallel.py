@@ -174,3 +174,90 @@ def test_ring_attention_sequence_stays_sharded():
     q = jax.device_put(x, spec)
     out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(q, q, q)
     assert not out.sharding.is_fully_replicated
+
+
+def test_sp_prefill_engine_matches_single_device_tokens(monkeypatch):
+    """Sequence-parallel ring prefill (sp=4 tier mesh) must generate the
+    same greedy tokens as the unsharded engine — ring attention changes
+    where the O(S²) work runs, not its result.  Asserts the ring op
+    actually ran (a prompt that misses the bucketed path would compare
+    chunked-vs-chunked and pass vacuously)."""
+    from distributed_llm_tpu.config import TierConfig
+    from distributed_llm_tpu.parallel import ring_attention as ra
+    from distributed_llm_tpu.parallel.mesh import sp_tp_mesh
+
+    calls = []
+    real = ra.ring_attention
+    monkeypatch.setattr(ra, "ring_attention",
+                        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+
+    tier = TierConfig(name="nano", model_preset="nano_test",
+                      max_new_tokens=6, prefill_buckets=(16, 32, 64))
+    single = InferenceEngine(tier, seed=13)
+    sp = InferenceEngine(tier, seed=13,
+                         mesh=sp_tp_mesh(jax.devices(), sp=4, tp=1))
+    prompt = "user: short enough to fit one bucket"   # 41 ids -> bucket 64
+    a = single.generate(prompt)
+    assert not calls                                  # unsharded: no ring
+    b = sp.generate(prompt)
+    assert calls, "sp engine never invoked ring attention"
+    assert a.token_ids == b.token_ids
+
+
+def test_sp_engine_serves_long_prompt_via_ring_not_chunks(monkeypatch):
+    """Prompts beyond the tier's largest configured bucket — THE case sp
+    exists for — must take the extended-ladder ring prefill on an sp tier,
+    and still match the unsharded engine's chunk-stride output."""
+    from distributed_llm_tpu.config import TierConfig
+    from distributed_llm_tpu.parallel import ring_attention as ra
+    from distributed_llm_tpu.parallel.mesh import sp_tp_mesh
+
+    calls = []
+    real = ra.ring_attention
+    monkeypatch.setattr(ra, "ring_attention",
+                        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+
+    tier = TierConfig(name="nano", model_preset="nano_test",
+                      max_new_tokens=6, prefill_buckets=(16, 32, 64))
+    single = InferenceEngine(tier, seed=19)
+    sp = InferenceEngine(tier, seed=19,
+                         mesh=sp_tp_mesh(jax.devices(), sp=4, tp=1))
+    # 120 ids: past bucket 64, within max_seq 256 — sp ladder covers it.
+    prompt = "user: " + "tell me about sequence parallel rings " * 3
+    assert sp._buckets[-1] == 256                     # ladder reaches max_seq
+    a = single.generate(prompt)                       # chunk-stride path
+    b = sp.generate(prompt)                           # one ring prefill
+    assert calls, "long prompt did not use ring attention on the sp tier"
+    assert a.token_ids == b.token_ids
+
+
+def test_sp_tp_2d_mesh_prefill_matches_single_device_tokens():
+    """2-D sp×tp tier mesh: ring attention over 'sp' with heads sharded
+    over 'tp' (orin_test has 4 kv heads)."""
+    from distributed_llm_tpu.config import TierConfig
+    from distributed_llm_tpu.parallel.mesh import sp_tp_mesh
+
+    tier = TierConfig(name="orin", model_preset="orin_test",
+                      max_new_tokens=6, prefill_buckets=(16, 32, 64))
+    single = InferenceEngine(tier, seed=17)
+    both = InferenceEngine(tier, seed=17,
+                           mesh=sp_tp_mesh(jax.devices(), sp=2, tp=2))
+    prompt = "user: compare the two dimensional mesh against one chip"
+    a = single.generate(prompt)
+    b = both.generate(prompt)
+    assert a.token_ids == b.token_ids
+
+
+def test_carve_assigns_2d_mesh_for_sp_tier():
+    from distributed_llm_tpu.config import ClusterConfig, TierConfig
+    from distributed_llm_tpu.parallel.mesh import carve_tier_meshes
+
+    cluster = ClusterConfig(
+        nano=TierConfig(name="nano", model_preset="nano_test", tp=1),
+        orin=TierConfig(name="orin", model_preset="orin_test", tp=2, sp=2))
+    meshes = carve_tier_meshes(cluster)
+    assert dict(meshes["orin"].shape) == {"sp": 2, "tp": 2}
+    # Chips are disjoint: nano got 1, orin the next 4.
+    nano_ids = {d.id for d in meshes["nano"].devices.flat}
+    orin_ids = {d.id for d in meshes["orin"].devices.flat}
+    assert not nano_ids & orin_ids

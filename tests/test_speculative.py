@@ -137,3 +137,18 @@ def test_manager_builds_speculative_tier():
     r = engine.generate("user: spec tier", max_new_tokens=4)
     assert isinstance(r.text, str)
     mgr.stop_server()
+
+
+def test_speculative_stream_matches_generate():
+    """generate() is built on generate_stream(); deltas concatenate to the
+    result text and tokens match a fresh engine's generate()."""
+    eng_a = SpeculativeEngine(_tier("orin_test"), _tier("nano_test"),
+                              gamma=3, seed=41)
+    eng_b = SpeculativeEngine(_tier("orin_test"), _tier("nano_test"),
+                              gamma=3, seed=41)
+    ref = eng_a.generate("user: stream the speculation", max_new_tokens=10)
+    handle = eng_b.generate_stream("user: stream the speculation",
+                                   max_new_tokens=10)
+    text = "".join(handle)
+    assert text == ref.text
+    assert handle.result.token_ids == ref.token_ids

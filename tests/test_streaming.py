@@ -141,3 +141,21 @@ def test_sequential_stream_sse_endpoint():
         assert events[-1]["tokens"] >= 1
     finally:
         mgr.stop_server()
+
+
+def test_stream_endpoint_json_error_for_greedy_only_engine():
+    """A speculative (greedy-only) tier asked to stream with temperature
+    must get the JSON error contract, not a framework 500 page."""
+    class _GreedyOnlyEngine:
+        def generate_stream(self, *a, **kw):
+            raise NotImplementedError("greedy-only")
+
+    class _Mgr:
+        def engine(self):
+            return _GreedyOnlyEngine()
+
+    app = create_tier_app("nano", manager=_Mgr())
+    resp = app.test_client().post(
+        "/query/stream", json={"query": "user: x", "temperature": 0.9})
+    assert resp.status_code == 501
+    assert "error" in resp.get_json()
