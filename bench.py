@@ -150,6 +150,9 @@ def run() -> dict:
             entry["phases"] = eng.phases.summary()
         if getattr(eng, "prefix_cache", None) is not None:
             entry["prefix_cache"] = eng.prefix_cache.stats()
+        if hasattr(eng, "acceptance_rate"):
+            entry["speculative_acceptance_rate"] = round(
+                eng.acceptance_rate, 4)
         if entry:
             phases[name] = entry
 

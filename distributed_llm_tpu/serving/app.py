@@ -181,6 +181,9 @@ def create_app(router: Optional[Router] = None,
                 entry["phases"] = engine.phases.summary()
             if engine is not None and getattr(engine, "prefix_cache", None):
                 entry["prefix_cache"] = engine.prefix_cache.stats()
+            if engine is not None and hasattr(engine, "acceptance_rate"):
+                entry["speculative_acceptance_rate"] = round(
+                    engine.acceptance_rate, 4)
             tiers[name] = entry
         try:
             cache_stats = router_.query_router.get_cache_stats()

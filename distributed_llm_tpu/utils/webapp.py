@@ -38,6 +38,12 @@ class Request:
                 return None
             raise
 
+    @property
+    def json(self) -> Optional[Any]:
+        """flask.Request.json parity (the reference app reads it,
+        /root/reference/src/app.py)."""
+        return self.get_json()
+
 
 class _Args:
     def __init__(self, parsed: Dict[str, List[str]]):

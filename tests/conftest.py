@@ -27,4 +27,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache: the suite is compile-dominated on this
+# 1-core box, and most programs recur across runs (same tiny shapes).
+# Repeat full-suite runs reuse compiled artifacts across processes.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("DLLM_TEST_COMPILE_CACHE",
+                                 "/tmp/dllm_jax_test_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

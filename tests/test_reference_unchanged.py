@@ -1,0 +1,141 @@
+"""North-star acceptance (SURVEY.md preamble): the REFERENCE's own
+`src/app.py` and `src/tests/routing_chatbot_tester.py` must run UNCHANGED
+against this framework's backend.
+
+These tests import the actual reference files from /root/reference (never
+copied into this repo) on top of the compat/ module layer, with stdlib
+stand-ins for the reference's third-party imports that this image lacks
+(flask/flask_cors → utils/webapp shim; pexpect → an inert SSH stub, since
+there are no Jetsons to SSH into — the reference's own error handling
+treats unreachable devices as "power logging unavailable" and carries on).
+
+Each test runs in a subprocess: the sys.modules aliasing must not leak
+into the rest of the suite.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE_SRC = "/root/reference/src"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REFERENCE_SRC),
+    reason="reference checkout not mounted")
+
+# Shared bootstrap: compat modules + reference src on the path, stdlib
+# shims registered under the reference's import names.
+BOOTSTRAP = f"""
+import sys, types
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {REFERENCE_SRC!r})
+sys.path.insert(0, {REFERENCE_SRC + '/tests'!r})
+# compat/ goes FIRST so our router/query_router_engine/cache/token_counter/
+# query_sets shadow the reference's (that's the backend swap); app.py and
+# routing_chatbot_tester.py exist only in the reference tree.
+sys.path.insert(0, {REPO + '/compat'!r})
+
+# flask / flask_cors -> the framework's Flask-compatible shim.
+from distributed_llm_tpu.utils import webapp
+flask_mod = types.ModuleType("flask")
+flask_mod.Flask = webapp.Flask
+flask_mod.request = webapp.request
+flask_mod.jsonify = webapp.jsonify
+sys.modules["flask"] = flask_mod
+cors_mod = types.ModuleType("flask_cors")
+cors_mod.CORS = lambda app, **kw: None
+sys.modules["flask_cors"] = cors_mod
+
+# pexpect -> inert stub: every SSH interaction looks like a clean no-op
+# session (the reference catches TIMEOUT/EOF and continues without power
+# data when devices are unreachable).
+pexpect_mod = types.ModuleType("pexpect")
+class _Match:
+    def group(self, i=0):
+        return "0"
+class _Child:
+    before = ""
+    match = _Match()
+    def expect(self, *a, **kw):
+        return 0
+    def sendline(self, *a, **kw):
+        pass
+    def wait(self):
+        return 0
+    def close(self, *a, **kw):
+        pass
+pexpect_mod.spawn = lambda *a, **kw: _Child()
+pexpect_mod.TIMEOUT = type("TIMEOUT", (Exception,), {{}})
+pexpect_mod.EOF = type("EOF", (Exception,), {{}})
+sys.modules["pexpect"] = pexpect_mod
+"""
+
+
+def _run(body: str, cwd: str, timeout: int = 900) -> str:
+    proc = subprocess.run(
+        [sys.executable, "-c", BOOTSTRAP + body], cwd=cwd,
+        capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    return proc.stdout
+
+
+def test_reference_app_py_serves_unchanged(tmp_path):
+    """The reference Flask app (src/app.py, byte-identical) boots against
+    our Router and serves /chat, /history with its JSON contract."""
+    out = _run("""
+import app as reference_app                     # /root/reference/src/app.py
+c = reference_app.app.test_client()
+
+r = c.post("/chat", json={"message": "hello there",
+                          "strategy": "heuristic", "session_id": "s1"})
+assert r.status_code == 200, r.status_code
+body = r.get_json()
+for field in ("reply", "device", "reasoning", "method", "confidence",
+              "cache_hit", "tokens"):
+    assert field in body, field
+assert body["device"] in ("nano", "orin")
+
+h = c.get("/history?session_id=s1").get_json()
+assert isinstance(h, list) and len(h) == 2, h     # user + assistant turns
+assert h[0]["role"] == "user"
+print("REFERENCE_APP_OK", body["device"], body["method"])
+""", cwd=str(tmp_path))
+    assert "REFERENCE_APP_OK" in out
+
+
+def test_reference_tester_runs_unchanged(tmp_path):
+    """The reference benchmark harness (routing_chatbot_tester.py,
+    byte-identical) runs a token-strategy experiment against our backend
+    and writes both CSV schemas."""
+    out = _run("""
+import csv
+import routing_chatbot_tester as t              # the reference harness
+
+items = t.normalize_query_set(
+    __import__("query_sets").query_sets["general_knowledge"][:2])
+run_cfg = t.RunConfig(
+    query_set_name="general_knowledge",
+    thresholds=[100], strategies=["token"], cache_modes=["off"],
+    fixed_threshold_for_non_token=1000,
+    output_csv="summary.csv", output_per_query_csv="per_query.csv")
+ssh_cfg = t.SSHConfig(nano_ip="127.0.0.1", orin_ip="127.0.0.1",
+                      nano_ssh_user="x", orin_ssh_user="x",
+                      nano_ssh_port=22, orin_ssh_port=22)
+t.run_experiment(items, run_cfg, ssh_cfg)
+
+rows = list(csv.DictReader(open("summary.csv")))
+assert rows, "no summary rows"
+row = rows[0]
+assert row["strategy"] == "token"
+assert float(row["routing_accuracy"]) >= 0.0
+per_q = list(csv.DictReader(open("per_query.csv")))
+assert len(per_q) == 2
+assert all(r["device_used"] in ("nano", "orin") for r in per_q)
+print("REFERENCE_TESTER_OK", row["routing_accuracy"])
+""", cwd=str(tmp_path))
+    assert "REFERENCE_TESTER_OK" in out
