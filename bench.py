@@ -140,19 +140,10 @@ def run() -> dict:
 
     # Per-tier phase attribution (tokenize/prefill/decode/detok) and prefix
     # reuse counters — the where-did-the-time-go story behind the headline.
+    from distributed_llm_tpu.utils.telemetry import engine_stats
     phases = {}
     for name, tier in router.tiers.items():
-        eng = getattr(tier.server_manager, "_engine", None)
-        if eng is None:
-            continue
-        entry = {}
-        if getattr(eng, "phases", None) is not None:
-            entry["phases"] = eng.phases.summary()
-        if getattr(eng, "prefix_cache", None) is not None:
-            entry["prefix_cache"] = eng.prefix_cache.stats()
-        if hasattr(eng, "acceptance_rate"):
-            entry["speculative_acceptance_rate"] = round(
-                eng.acceptance_rate, 4)
+        entry = engine_stats(getattr(tier.server_manager, "_engine", None))
         if entry:
             phases[name] = entry
 

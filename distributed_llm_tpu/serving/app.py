@@ -176,14 +176,8 @@ def create_app(router: Optional[Router] = None,
             entry = dict(mgr.health())
             # Peek without lazy-starting; remote tiers' managers
             # (serving/remote.py) have no local engine at all.
-            engine = getattr(mgr, "_engine", None)
-            if engine is not None and hasattr(engine, "phases"):
-                entry["phases"] = engine.phases.summary()
-            if engine is not None and getattr(engine, "prefix_cache", None):
-                entry["prefix_cache"] = engine.prefix_cache.stats()
-            if engine is not None and hasattr(engine, "acceptance_rate"):
-                entry["speculative_acceptance_rate"] = round(
-                    engine.acceptance_rate, 4)
+            from ..utils.telemetry import engine_stats
+            entry.update(engine_stats(getattr(mgr, "_engine", None)))
             tiers[name] = entry
         try:
             cache_stats = router_.query_router.get_cache_stats()

@@ -82,6 +82,24 @@ class PhaseTimer:
                 for name in self.totals}
 
 
+def engine_stats(engine) -> Dict[str, Any]:
+    """Per-engine observability snapshot shared by GET /stats
+    (serving/app.py) and bench.py's tier section — one assembler so the
+    two surfaces cannot drift.  Tolerates any engine type (remote tiers
+    have none; batching/speculative engines expose different subsets)."""
+    entry: Dict[str, Any] = {}
+    if engine is None:
+        return entry
+    if getattr(engine, "phases", None) is not None:
+        entry["phases"] = engine.phases.summary()
+    if getattr(engine, "prefix_cache", None) is not None:
+        entry["prefix_cache"] = engine.prefix_cache.stats()
+    if hasattr(engine, "acceptance_rate"):
+        entry["speculative_acceptance_rate"] = round(
+            engine.acceptance_rate, 4)
+    return entry
+
+
 class TierTelemetry:
     """1 Hz sampler of per-tier device memory, window-integrable.
 

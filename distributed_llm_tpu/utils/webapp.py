@@ -21,6 +21,11 @@ from wsgiref.simple_server import WSGIServer, make_server
 _local = threading.local()
 
 
+class BadRequest(Exception):
+    """flask/werkzeug BadRequest parity: raised by ``Request.json`` on a
+    missing or unparseable body; the dispatcher maps it to a 400."""
+
+
 class Request:
     def __init__(self, method: str, path: str, query: str, body: bytes,
                  content_type: str = "application/json"):
@@ -41,8 +46,15 @@ class Request:
     @property
     def json(self) -> Optional[Any]:
         """flask.Request.json parity (the reference app reads it,
-        /root/reference/src/app.py)."""
-        return self.get_json()
+        /root/reference/src/app.py): a missing/unparseable body is a 400,
+        matching Flask's BadRequest, not a silent None."""
+        try:
+            body = self.get_json()
+        except (ValueError, UnicodeDecodeError) as exc:
+            raise BadRequest(f"invalid JSON body: {exc}") from exc
+        if body is None:
+            raise BadRequest("request body must be JSON")
+        return body
 
 
 class _Args:
@@ -159,6 +171,8 @@ class Flask:
         _local.request = req
         try:
             return _coerce(fn())
+        except BadRequest as exc:
+            return Response(json.dumps({"error": str(exc)}).encode(), 400)
         except Exception as exc:
             if self.testing:
                 raise

@@ -23,16 +23,22 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compile cache: the suite is compile-dominated on this
+# 1-core box, and most programs recur across runs (same tiny shapes).
+# Set via env (inherited by subprocess-based tests like
+# test_reference_unchanged.py, which recompile full engines) AND via
+# jax.config below (this process imported jax-adjacent state already).
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.environ.get("DLLM_TEST_COMPILE_CACHE",
+                                     "/tmp/dllm_jax_test_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-
-# Persistent XLA compile cache: the suite is compile-dominated on this
-# 1-core box, and most programs recur across runs (same tiny shapes).
-# Repeat full-suite runs reuse compiled artifacts across processes.
 jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("DLLM_TEST_COMPILE_CACHE",
-                                 "/tmp/dllm_jax_test_cache"))
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 

@@ -139,3 +139,45 @@ assert all(r["device_used"] in ("nano", "orin") for r in per_q)
 print("REFERENCE_TESTER_OK", row["routing_accuracy"])
 """, cwd=str(tmp_path))
     assert "REFERENCE_TESTER_OK" in out
+
+
+def test_reference_cli_chatbot_runs_unchanged(tmp_path):
+    """The reference CLI REPL (src/main.py, byte-identical) chats through
+    our Router and shuts both tiers down cleanly on 'exit' — the repo's
+    only clean-shutdown path (SURVEY.md §3.4)."""
+    out = _run("""
+import io, sys
+import main as reference_main                   # /root/reference/src/main.py
+
+bot = reference_main.Chatbot(strategy="heuristic",
+                             config={"cache_enabled": False,
+                                     "enable_response_cache": False,
+                                     "enable_failover": True})
+sys.stdin = io.StringIO("hello there\\nexit\\n")
+bot.chat()                                      # one turn, then clean exit
+assert len(bot.conversation_history) == 2
+assert bot.conversation_history[1]["role"] == "assistant"
+assert not bot.router.nano.server_manager.is_server_running()
+assert not bot.router.orin.server_manager.is_server_running()
+print("REFERENCE_CLI_OK")
+""", cwd=str(tmp_path))
+    assert "REFERENCE_CLI_OK" in out
+
+
+def test_reference_legacy_tester_runs_unchanged(tmp_path):
+    """The reference v1 harness (chatbot_tester.py, byte-identical) sweeps
+    a threshold against our backend and returns its query log."""
+    out = _run("""
+from chatbot_tester import ChatbotTester        # the legacy harness
+
+tester = ChatbotTester(["hello", "what is 2+2?"], [100],
+                       nano_ip="127.0.0.1", orin_ip="127.0.0.1")
+log = tester.run_test()
+assert len(log) == 2, log
+for threshold, device, start, end, tokens in log:
+    assert threshold == 100 and device in ("nano", "orin")
+    assert end >= start
+assert not tester.chatbot.router.nano.server_manager.is_server_running()
+print("REFERENCE_LEGACY_OK", [row[1] for row in log])
+""", cwd=str(tmp_path))
+    assert "REFERENCE_LEGACY_OK" in out
