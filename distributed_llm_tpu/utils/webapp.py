@@ -47,14 +47,14 @@ class Request:
     def json(self) -> Optional[Any]:
         """flask.Request.json parity (the reference app reads it,
         /root/reference/src/app.py): a missing/unparseable body is a 400,
-        matching Flask's BadRequest, not a silent None."""
+        matching Flask's BadRequest; a literal JSON ``null`` body parses
+        to None like Flask's does."""
+        if not self._body:
+            raise BadRequest("request body must be JSON")
         try:
-            body = self.get_json()
+            return self.get_json()
         except (ValueError, UnicodeDecodeError) as exc:
             raise BadRequest(f"invalid JSON body: {exc}") from exc
-        if body is None:
-            raise BadRequest("request body must be JSON")
-        return body
 
 
 class _Args:

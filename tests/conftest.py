@@ -28,9 +28,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 # Set via env (inherited by subprocess-based tests like
 # test_reference_unchanged.py, which recompile full engines) AND via
 # jax.config below (this process imported jax-adjacent state already).
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.environ.get("DLLM_TEST_COMPILE_CACHE",
-                                     "/tmp/dllm_jax_test_cache"))
+if "DLLM_TEST_COMPILE_CACHE" in os.environ:
+    # Explicit suite-local override always wins (even over a user-global
+    # JAX_COMPILATION_CACHE_DIR).
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = \
+        os.environ["DLLM_TEST_COMPILE_CACHE"]
+else:
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/dllm_jax_test_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
