@@ -1,0 +1,11 @@
+"""``python -m distributed_llm_tpu`` — serve the chat API.
+
+Convenience launcher for the Flask app (serving/app.py): the same
+``/chat`` + ``/history`` + ``/stats`` + ``/ui`` surface the reference
+exposes on :8000 (reference: ``python src/app.py``).
+"""
+
+from .serving.app import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""A/B the attention kernel implementations on the current backend.
+
+Usage::
+
+    python -m distributed_llm_tpu.bench.ab_kernels [--tier nano|orin]
+        [--prompt-tokens N] [--max-new N] [--repeat K]
+
+For each ``DLLM_ATTENTION`` setting (xla, pallas) this builds a fresh
+bench-tier engine, warms it, and measures steady-state TTFT (prefill) and
+decode tok/s over ``--repeat`` generations, printing one JSON line per
+impl plus a verdict.  This is the measurement behind bench.py's default
+attention pin — rerun it whenever the kernel set or jax version changes.
+
+The engines are built sequentially in ONE process (the chip allows a
+single claimant); DLLM_ATTENTION is read at trace time, so each engine is
+constructed after the env var is set and dropped before the next.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import time
+
+
+def measure(impl: str, tier_name: str, prompt_tokens: int, max_new: int,
+            repeat: int) -> dict:
+    os.environ["DLLM_ATTENTION"] = impl
+    import dataclasses
+
+    import jax
+
+    from ..config import bench_cluster, tiny_cluster
+    from ..engine.inference import InferenceEngine
+
+    cluster = (tiny_cluster() if jax.default_backend() == "cpu"
+               else bench_cluster())
+    # Prefix reuse OFF: this harness measures the cold prefill kernels
+    # (PrefixCache.take matches even a diverging entry's shared prefix, so
+    # any repeat would otherwise prefill a ~1-bucket suffix, not the
+    # prompt).  Belt and braces, the prompt HEAD varies per iteration too.
+    tier = dataclasses.replace(getattr(cluster, tier_name),
+                               enable_prefix_cache=False)
+    engine = InferenceEngine(tier, seed=0)
+    engine.warmup()
+
+    prompt = "user: " + ("benchmark the attention kernels now. " * 400)
+    prompt = prompt[:prompt_tokens]
+    ttfts, tokps = [], []
+    for i in range(repeat):
+        res = engine.generate(f"variant {i} " + prompt,
+                              max_new_tokens=max_new)
+        ttfts.append(res.ttft_ms)
+        if res.tokens_per_s:
+            tokps.append(res.tokens_per_s)
+    del engine
+    return {
+        "impl": impl,
+        "backend": jax.default_backend(),
+        "tier": tier.name,
+        "model": tier.model_preset,
+        "prompt_tokens": prompt_tokens,
+        "p50_ttft_ms": round(statistics.median(ttfts), 2),
+        "p50_decode_tok_per_s": round(statistics.median(tokps), 1)
+        if tokps else None,
+        "repeat": repeat,
+    }
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--tier", default="nano", choices=("nano", "orin"))
+    ap.add_argument("--prompt-tokens", type=int, default=512)
+    ap.add_argument("--max-new", type=int, default=64)
+    ap.add_argument("--repeat", type=int, default=5)
+    ap.add_argument("--platform", default=None,
+                    help="pin jax_platforms (e.g. cpu) — the env var alone "
+                         "is snapshotted too early under this image's "
+                         "sitecustomize")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    results = {}
+    prior = os.environ.get("DLLM_ATTENTION")
+    try:
+        for impl in ("xla", "pallas"):
+            t0 = time.perf_counter()
+            results[impl] = measure(impl, args.tier, args.prompt_tokens,
+                                    args.max_new, args.repeat)
+            results[impl]["wall_s"] = round(time.perf_counter() - t0, 1)
+            print(json.dumps(results[impl]), flush=True)
+    finally:
+        # Don't leak the kill switch into the calling process (in-process
+        # callers like the test suite share os.environ).
+        if prior is None:
+            os.environ.pop("DLLM_ATTENTION", None)
+        else:
+            os.environ["DLLM_ATTENTION"] = prior
+
+    x, p = results["xla"], results["pallas"]
+    verdict = {
+        "ttft_ratio_pallas_over_xla": round(
+            p["p50_ttft_ms"] / max(x["p50_ttft_ms"], 1e-9), 3),
+        "decode_ratio_pallas_over_xla": round(
+            (p["p50_decode_tok_per_s"] or 0)
+            / max(x["p50_decode_tok_per_s"] or 1e-9, 1e-9), 3),
+    }
+    print(json.dumps({"verdict": verdict}))
+
+
+if __name__ == "__main__":
+    main()

@@ -133,3 +133,15 @@ def test_stats_endpoint_exposes_phases_and_cache():
     phases = used[0]["phases"]
     assert {"tokenize", "prefill", "decode"} <= set(phases)
     assert len(d["devices"]) == 8
+
+
+def test_ab_kernels_smoke(capsys):
+    """The kernel A/B harness produces both impl rows and a verdict."""
+    from distributed_llm_tpu.bench import ab_kernels
+    ab_kernels.main(["--tier", "nano", "--prompt-tokens", "32",
+                     "--max-new", "4", "--repeat", "1"])
+    out = capsys.readouterr().out.strip().splitlines()
+    import json
+    rows = [json.loads(l) for l in out]
+    assert {r.get("impl") for r in rows[:2]} == {"xla", "pallas"}
+    assert "verdict" in rows[-1]
