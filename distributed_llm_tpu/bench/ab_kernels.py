@@ -46,12 +46,14 @@ def measure(impl: str, tier_name: str, prompt_tokens: int, max_new: int,
     engine = InferenceEngine(tier, seed=0)
     engine.warmup()
 
-    prompt = "user: " + ("benchmark the attention kernels now. " * 400)
-    prompt = prompt[:prompt_tokens]
+    filler = "user: " + ("benchmark the attention kernels now. " * 400)
     ttfts, tokps = [], []
     for i in range(repeat):
-        res = engine.generate(f"variant {i} " + prompt,
-                              max_new_tokens=max_new)
+        # Head-varied per iteration, sliced AFTER prepending so the total
+        # stays at the requested token count (byte-level tokenizer:
+        # chars ≈ tokens) and lands in the intended prefill bucket.
+        prompt = (f"variant {i} " + filler)[:prompt_tokens]
+        res = engine.generate(prompt, max_new_tokens=max_new)
         ttfts.append(res.ttft_ms)
         if res.tokens_per_s:
             tokps.append(res.tokens_per_s)

@@ -211,8 +211,9 @@ class TierConfig:
     enable_prefix_cache: bool = True
     prefix_cache_entries: int = 2
     # Weight-only quantization for serving ("none" | "int8", ops/quant.py):
-    # int8 halves decode's HBM weight traffic.  Unsharded dense tiers only
-    # (sharding rules and the trainer see full-precision leaf paths).
+    # int8 halves decode's HBM weight traffic.  Dense and MoE families;
+    # unsharded tiers only (sharding rules and the trainer see
+    # full-precision leaf paths).
     quantize: str = "none"
     # Cross-host tier: base URL of a tpu_api server on another host
     # (serving/remote.py — the DCN twin of the reference's SSH-tunneled

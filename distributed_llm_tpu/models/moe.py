@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..config import ModelConfig
 from ..ops import attention
+from ..ops import quant
 from . import transformer
 
 Params = Dict[str, Any]
@@ -90,7 +91,7 @@ def moe_ffn_train(cfg: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array
     e = cfg.num_experts
     xt = x.reshape(t, h)
 
-    gates, probs = _top2_gates(xt @ lp["w_router"])          # [T, E]
+    gates, probs = _top2_gates(quant.matmul(xt, lp["w_router"]))          # [T, E]
 
     capacity = max(1, int(cfg.moe_capacity_factor * 2 * t / e))
     # Position of each token within its expert's buffer, per expert.
@@ -106,10 +107,10 @@ def moe_ffn_train(cfg: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array
     combine = dispatch * gates.astype(x.dtype)[..., None]    # weights in
 
     expert_in = jnp.einsum("tec,th->ech", dispatch, xt)      # [E, C, H]
-    gate_h = jnp.einsum("ech,ehf->ecf", expert_in, lp["w_gate"])
-    up_h = jnp.einsum("ech,ehf->ecf", expert_in, lp["w_up"])
+    gate_h = quant.expert_einsum("ech,ehf->ecf", expert_in, lp["w_gate"])
+    up_h = quant.expert_einsum("ech,ehf->ecf", expert_in, lp["w_up"])
     act = jax.nn.silu(gate_h) * up_h
-    expert_out = jnp.einsum("ecf,efh->ech", act, lp["w_down"])
+    expert_out = quant.expert_einsum("ecf,efh->ech", act, lp["w_down"])
     out = jnp.einsum("tec,ech->th", combine, expert_out)
 
     # Switch load-balance loss: E · Σ_e fraction_of_tokens_e · mean_prob_e.
@@ -123,11 +124,11 @@ def moe_ffn_decode(cfg: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array
                    ) -> jax.Array:
     """Decode-step MoE FFN: x [B, H].  Computes all experts for the few
     decode tokens and combines by (top-2) gate weight — no dispatch."""
-    gates, _ = _top2_gates(x @ lp["w_router"])               # [B, E]
-    gate_h = jnp.einsum("bh,ehf->bef", x, lp["w_gate"])
-    up_h = jnp.einsum("bh,ehf->bef", x, lp["w_up"])
+    gates, _ = _top2_gates(quant.matmul(x, lp["w_router"]))               # [B, E]
+    gate_h = quant.expert_einsum("bh,ehf->bef", x, lp["w_gate"])
+    up_h = quant.expert_einsum("bh,ehf->bef", x, lp["w_up"])
     act = jax.nn.silu(gate_h) * up_h
-    outs = jnp.einsum("bef,efh->beh", act, lp["w_down"])     # [B, E, H]
+    outs = quant.expert_einsum("bef,efh->beh", act, lp["w_down"])     # [B, E, H]
     return jnp.einsum("be,beh->bh", gates.astype(x.dtype), outs)
 
 
@@ -142,20 +143,20 @@ def prefill(cfg: ModelConfig, params: Params, tokens: jax.Array,
     the summed load-balance loss across layers."""
     b, s = tokens.shape
     d = cfg.head_dim
-    x = params["embed"][tokens]
+    x = quant.embed_rows(params["embed"], tokens)
     sin, cos = transformer.rope_sincos(positions, d, cfg.rope_theta)
 
     def layer(carry, lp):
         x, aux = carry
         h_in = transformer.rms_norm(x, lp["ln1"], cfg.norm_eps)
-        q = (h_in @ lp["wq"]).reshape(b, s, cfg.num_heads, d)
-        k = (h_in @ lp["wk"]).reshape(b, s, cfg.num_kv_heads, d)
-        v = (h_in @ lp["wv"]).reshape(b, s, cfg.num_kv_heads, d)
+        q = quant.matmul(h_in, lp["wq"]).reshape(b, s, cfg.num_heads, d)
+        k = quant.matmul(h_in, lp["wk"]).reshape(b, s, cfg.num_kv_heads, d)
+        v = quant.matmul(h_in, lp["wv"]).reshape(b, s, cfg.num_kv_heads, d)
         q = transformer.apply_rope(q, sin, cos)
         k = transformer.apply_rope(k, sin, cos)
         attn = attention.causal(q, k, v, impl=cfg.attention_impl
                                 ).reshape(b, s, cfg.num_heads * d)
-        x = x + attn @ lp["wo"]
+        x = x + quant.matmul(attn, lp["wo"])
         ffn_out, layer_aux = moe_ffn_train(
             cfg, lp, transformer.rms_norm(x, lp["ln2"], cfg.norm_eps))
         return (x + ffn_out, aux + layer_aux), (k, v)
@@ -183,7 +184,7 @@ def chunk_prefill(cfg: ModelConfig, params: Params, tokens: jax.Array,
     """
     b, s_c = tokens.shape
     d = cfg.head_dim
-    x = params["embed"][tokens]
+    x = quant.embed_rows(params["embed"], tokens)
     positions = start[:, None] + jnp.arange(s_c)[None, :]
     q_pos = jnp.minimum(positions, jnp.maximum(true_len, 1)[:, None] - 1)
     sin, cos = transformer.rope_sincos(positions, d, cfg.rope_theta)
@@ -191,9 +192,9 @@ def chunk_prefill(cfg: ModelConfig, params: Params, tokens: jax.Array,
     def layer(x, scanned):
         lp, k_cache, v_cache = scanned
         h_in = transformer.rms_norm(x, lp["ln1"], cfg.norm_eps)
-        q = (h_in @ lp["wq"]).reshape(b, s_c, cfg.num_heads, d)
-        k = (h_in @ lp["wk"]).reshape(b, s_c, cfg.num_kv_heads, d)
-        v = (h_in @ lp["wv"]).reshape(b, s_c, cfg.num_kv_heads, d)
+        q = quant.matmul(h_in, lp["wq"]).reshape(b, s_c, cfg.num_heads, d)
+        k = quant.matmul(h_in, lp["wk"]).reshape(b, s_c, cfg.num_kv_heads, d)
+        v = quant.matmul(h_in, lp["wv"]).reshape(b, s_c, cfg.num_kv_heads, d)
         q = transformer.apply_rope(q, sin, cos)
         k = transformer.apply_rope(k, sin, cos)
 
@@ -208,7 +209,7 @@ def chunk_prefill(cfg: ModelConfig, params: Params, tokens: jax.Array,
         v_att = v_cache[:, :window] if window else v_cache
         attn = attention.chunk(q, k_att, v_att, q_pos,
                                impl=cfg.attention_impl)
-        x = x + attn.reshape(b, s_c, cfg.num_heads * d) @ lp["wo"]
+        x = x + quant.matmul(attn.reshape(b, s_c, cfg.num_heads * d), lp["wo"])
         ffn_out, _ = moe_ffn_train(
             cfg, lp, transformer.rms_norm(x, lp["ln2"], cfg.norm_eps))
         return x + ffn_out, (k_cache, v_cache)
@@ -225,15 +226,15 @@ def decode_step(cfg: ModelConfig, params: Params, token: jax.Array,
     """One autoregressive step; same contract as transformer.decode_step."""
     b = token.shape[0]
     d = cfg.head_dim
-    x = params["embed"][token]
+    x = quant.embed_rows(params["embed"], token)
     sin, cos = transformer.rope_sincos(pos, d, cfg.rope_theta)
 
     def layer(x, scanned):
         lp, k_cache, v_cache = scanned
         h_in = transformer.rms_norm(x, lp["ln1"], cfg.norm_eps)
-        q = (h_in @ lp["wq"]).reshape(b, cfg.num_heads, d)
-        k = (h_in @ lp["wk"]).reshape(b, cfg.num_kv_heads, d)
-        v = (h_in @ lp["wv"]).reshape(b, cfg.num_kv_heads, d)
+        q = quant.matmul(h_in, lp["wq"]).reshape(b, cfg.num_heads, d)
+        k = quant.matmul(h_in, lp["wk"]).reshape(b, cfg.num_kv_heads, d)
+        v = quant.matmul(h_in, lp["wv"]).reshape(b, cfg.num_kv_heads, d)
         q = transformer.apply_rope(q, sin, cos)
         k = transformer.apply_rope(k, sin, cos)
 
@@ -246,7 +247,7 @@ def decode_step(cfg: ModelConfig, params: Params, token: jax.Array,
 
         attn = attention.decode(q, k_cache, v_cache, pos,
                                 impl=cfg.attention_impl)
-        x = x + attn.reshape(b, cfg.num_heads * d) @ lp["wo"]
+        x = x + quant.matmul(attn.reshape(b, cfg.num_heads * d), lp["wo"])
         x = x + moe_ffn_decode(
             cfg, lp, transformer.rms_norm(x, lp["ln2"], cfg.norm_eps))
         return x, (k_cache, v_cache)

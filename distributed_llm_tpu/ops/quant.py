@@ -70,6 +70,23 @@ def matmul(x: jax.Array, w: Any) -> jax.Array:
     return y * jnp.squeeze(w["s"], axis=-2)
 
 
+def expert_einsum(subscripts: str, x: jax.Array, w: Any) -> jax.Array:
+    """Einsum against stacked MoE expert weights [E, in, out], plain or
+    int8 (models/moe.py).  The per-(expert, output-channel) scale
+    s [E, 1, out] folds into the (small) output: directly when the output
+    is expert-major ("...->ecf"/"...->ech", capacity dispatch) and with
+    the kept contract dim squeezed when the batch leads ("...->bef"/
+    "...->beh", decode's all-expert pass) — trailing-dim broadcasting
+    covers both."""
+    if not is_quantized(w):
+        return jnp.einsum(subscripts, x, w)
+    y = jnp.einsum(subscripts, x, w["q"].astype(x.dtype))
+    s = w["s"]
+    if subscripts.split("->")[1][0] == "e":
+        return y * s                          # [E, C, out] × [E, 1, out]
+    return y * jnp.squeeze(s, axis=-2)        # [B, E, out] × [E, out]
+
+
 def embed_rows(embed: Any, tokens: jax.Array) -> jax.Array:
     """Embedding-table row lookup for a plain or quantized table [V, H].
 
@@ -103,9 +120,10 @@ def maybe_quantize(params: Dict[str, Any], tier, cfg,
     """Apply a tier's quantize mode with central validation — the one
     entry point every engine uses, so modes and support guards can't drift.
 
-    Unknown modes raise; supported-but-inapplicable combinations (sharded
-    mesh, MoE) WARN and serve full precision, so an operator who asked for
-    int8 can see in the logs that it did not take effect.
+    Unknown modes raise; the one supported-but-inapplicable combination
+    (a sharded mesh) WARNS and serves full precision, so an operator who
+    asked for int8 can see in the logs that it did not take effect.
+    Dense and MoE families both quantize.
     """
     import logging
 
@@ -122,20 +140,16 @@ def maybe_quantize(params: Dict[str, Any], tier, cfg,
             "precision (sharding rules map full-precision leaf paths)",
             getattr(tier, "name", "?"))
         return params
-    if cfg.num_experts > 1:
-        log.warning(
-            "tier %s: quantize='int8' ignored — MoE models serve full "
-            "precision (expert FFN quantization not implemented)",
-            getattr(tier, "name", "?"))
-        return params
     return jax.jit(quantize_params)(params)
 
 
 def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
-    """Quantize a dense-transformer params tree for serving.
+    """Quantize a transformer params tree (dense OR MoE) for serving.
 
-    Matmul weights and the (tied) embedding table go int8; norm gains pass
-    through.  Idempotent on already-quantized trees.
+    Matmul weights, stacked expert weights ([L, E, in, out] — per-(expert,
+    channel) scales), and the (tied) embedding table go int8; norm gains
+    and the tiny MoE router pass through.  Idempotent on already-quantized
+    trees.
     """
     out = dict(params)
     if not is_quantized(params["embed"]):
