@@ -1,0 +1,126 @@
+"""Concurrency soak: mixed traffic against the full serving stack.
+
+The reference's known concurrency hazard is unsynchronized Flask globals
+(SURVEY.md §5.2); our app serializes session state behind a lock and the
+batching engine runs a shared scheduler.  This soak drives them all at
+once from many threads — chat across sessions, strategy hot-swaps,
+streaming, /stats reads, history clears — and then asserts the system is
+still coherent.  Bounded small so the suite stays fast."""
+
+import dataclasses
+import json
+import threading
+
+from distributed_llm_tpu.config import ClusterConfig, tiny_cluster
+from distributed_llm_tpu.serving.app import create_app
+from distributed_llm_tpu.serving.tpu_api import create_tier_app
+
+# Derived from the canonical CPU test tiers (one source of truth for the
+# presets/buckets); decode_batch turns on the shared batched scheduler,
+# the component under contention here.
+_TINY = tiny_cluster()
+_CLUSTER = ClusterConfig(
+    nano=dataclasses.replace(_TINY.nano, decode_batch=3, max_new_tokens=6),
+    orin=dataclasses.replace(_TINY.orin, tp=1, max_new_tokens=6))
+
+
+def _run_all(threads, errors):
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    # A deadlocked worker is the failure this soak exists to catch — a
+    # timed-out join alone would silently pass.
+    stuck = [t.name for t in threads if t.is_alive()]
+    assert not stuck, f"deadlocked threads: {stuck} (errors so far: {errors})"
+    assert not errors, errors
+
+
+def test_soak_mixed_concurrent_traffic():
+    app = create_app(cluster=_CLUSTER)
+    c = app.test_client()
+    errors = []
+    strategies = ("token", "semantic", "heuristic", "hybrid", "perf")
+
+    def chatter(session: int):
+        try:
+            for turn in range(3):
+                r = c.post("/chat", json={
+                    "message": f"session {session} turn {turn}: tell me "
+                               f"something about rivers and topic {session}",
+                    "strategy": strategies[(session + turn) % len(strategies)],
+                    "session_id": f"s{session}"})
+                assert r.status_code == 200, r.status_code
+                body = r.get_json()
+                assert body["device"] in ("nano", "orin")
+        except BaseException as exc:      # noqa: BLE001 — collect, don't die
+            errors.append(("chatter", session, repr(exc)))
+
+    def stats_reader():
+        try:
+            for _ in range(6):
+                r = c.get("/stats")
+                assert r.status_code == 200
+                json.dumps(r.get_json())      # fully serializable
+        except BaseException as exc:
+            errors.append(("stats", 0, repr(exc)))
+
+    def history_cycler():
+        try:
+            for _ in range(3):
+                c.get("/history?session_id=s0")
+                c.delete("/history?session_id=s1")
+        except BaseException as exc:
+            errors.append(("history", 0, repr(exc)))
+
+    try:
+        threads = ([threading.Thread(target=chatter, args=(i,),
+                                     name=f"chatter-{i}") for i in range(4)]
+                   + [threading.Thread(target=stats_reader, name="stats"),
+                      threading.Thread(target=history_cycler, name="history")])
+        _run_all(threads, errors)
+
+        # System still coherent: a final request works on every strategy.
+        for s in strategies:
+            r = c.post("/chat", json={"message": "final check", "strategy": s,
+                                      "session_id": "final"})
+            assert r.status_code == 200
+    finally:
+        state = app.extensions["dllm_state"]
+        for tier in state["router"].tiers.values():
+            tier.server_manager.stop_server()
+
+
+def test_soak_streaming_alongside_sync_requests():
+    """SSE streams and synchronous queries interleave on one batched tier
+    without deadlock or cross-talk."""
+    app = create_tier_app("nano", cluster=_CLUSTER)
+    c = app.test_client()
+    errors = []
+
+    def streamer(i: int):
+        try:
+            r = c.post("/query/stream",
+                       json={"query": f"user: stream {i}", "num_predict": 5})
+            assert r.status_code == 200
+            events = [json.loads(l[6:]) for l in r.text.strip().split("\n\n")
+                      if l.startswith("data: ")]
+            assert events and events[-1].get("done") is True
+        except BaseException as exc:
+            errors.append(("stream", i, repr(exc)))
+
+    def syncer(i: int):
+        try:
+            r = c.post("/query", json={"query": f"user: sync {i}"})
+            assert r.status_code == 200 and "response" in r.get_json()
+        except BaseException as exc:
+            errors.append(("sync", i, repr(exc)))
+
+    try:
+        threads = ([threading.Thread(target=streamer, args=(i,),
+                                     name=f"stream-{i}") for i in range(3)]
+                   + [threading.Thread(target=syncer, args=(i,),
+                                       name=f"sync-{i}") for i in range(3)])
+        _run_all(threads, errors)
+    finally:
+        app.extensions["dllm_manager"].stop_server()
