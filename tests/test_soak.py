@@ -25,10 +25,15 @@ _CLUSTER = ClusterConfig(
 
 
 def _run_all(threads, errors):
+    import time
+
     for t in threads:
         t.start()
+    # One shared deadline: a deadlock should fail in ~600 s total, not
+    # 600 s per stuck thread.
+    deadline = time.monotonic() + 600
     for t in threads:
-        t.join(timeout=600)
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
     # A deadlocked worker is the failure this soak exists to catch — a
     # timed-out join alone would silently pass.
     stuck = [t.name for t in threads if t.is_alive()]
