@@ -101,3 +101,42 @@ def test_prefix_cache_reclaims_only_true_prefixes(data):
         assert suffix == prompt[m:]
         if sb is not None:
             assert sb >= len(suffix)
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.text(max_size=200))
+def test_tokenizer_roundtrips_arbitrary_unicode(s):
+    """decode(encode(s)) == s for any unicode (byte-level scheme)."""
+    from distributed_llm_tpu.engine.tokenizer import ByteTokenizer
+    tok = ByteTokenizer()
+    assert tok.decode(tok.encode(s, add_bos=False)) == s
+
+
+@settings(max_examples=100, deadline=None)
+@given(st.text(max_size=64))
+def test_stream_decoder_matches_batch_decode(s):
+    """Feeding bytes one token at a time through StreamDecoder yields the
+    same text as decoding the whole id list at once."""
+    from distributed_llm_tpu.engine.tokenizer import ByteTokenizer, StreamDecoder
+    tok = ByteTokenizer()
+    ids = tok.encode(s, add_bos=False)
+    dec = StreamDecoder()
+    out = "".join(dec.feed(t) for t in ids) + dec.flush()
+    assert out == tok.decode(ids)
+
+
+@settings(max_examples=50, deadline=None)
+@given(st.integers(1, 12), st.integers(0, 2**31 - 1))
+def test_top_k_sampling_only_picks_top_k(k, seed):
+    """With top_k set, sampled ids must come from the k highest logits."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_llm_tpu.ops.sampling import sample_token
+
+    logits = jax.random.normal(jax.random.PRNGKey(seed), (3, 32))
+    tok = sample_token(logits, jax.random.PRNGKey(seed + 1),
+                       temperature=1.0, top_k=k)
+    top = np.argsort(np.asarray(logits), axis=-1)[:, -k:]
+    for b in range(3):
+        assert int(tok[b]) in top[b]
