@@ -61,34 +61,13 @@ def create_app(router: Optional[Router] = None,
 
     @app.route("/chat", methods=["POST"])
     def chat():
-        data = request.get_json(silent=True) or {}
-        user_input = data.get("message", "")
-        requested = data.get("strategy", "hybrid")
-        session_id = data.get("session_id", "default")
-
-        if requested == "token-counting":   # UI dropdown name
-            requested = "token"
-
-        if not user_input.strip():
-            return jsonify({"error": "No message provided"}), 400
-
-        with state_lock:
-            if requested != state["strategy"]:
-                logger.info("Switching strategy: %s -> %s",
-                            state["strategy"], requested)
-                try:
-                    state["router"].query_router.change_strategy(requested)
-                    state["strategy"] = requested
-                except Exception as exc:
-                    return jsonify(
-                        {"error": f"Failed to switch strategy: {exc}"}), 500
-
-            history: List[Dict[str, str]] = state["histories"].setdefault(
-                session_id, [])
-            history.append({"role": "user", "content": user_input})
+        err, turn, requested, session_id, history, snapshot = \
+            _begin_chat_turn()
+        if err is not None:
+            return err
 
         try:
-            response_data, tokens, device = state["router"].route_query(history)
+            response_data, tokens, device = state["router"].route_query(snapshot)
 
             if isinstance(response_data, dict):
                 reply = response_data.get("response", "")
@@ -102,9 +81,7 @@ def create_app(router: Optional[Router] = None,
                 reasoning, method = "Direct response", requested
                 confidence, cache_hit = 0.0, False
 
-            with state_lock:
-                history.append({"role": "assistant", "content": reply})
-                state["histories"][session_id] = history[-HISTORY_LIMIT:]
+            _commit_assistant_turn(history, session_id, reply)
 
             return jsonify({
                 "reply": reply,
@@ -118,9 +95,7 @@ def create_app(router: Optional[Router] = None,
 
         except Exception as exc:
             logger.exception("Error during routing")
-            with state_lock:
-                if history and history[-1]["role"] == "user":
-                    history.pop()
+            _rollback_user_turn(history, turn)
             return jsonify({
                 "reply": "System Error: The router encountered an issue.",
                 "device": "error",
@@ -130,6 +105,106 @@ def create_app(router: Optional[Router] = None,
                 "cache_hit": False,
                 "tokens": 0,
             }), 500
+
+    def _begin_chat_turn():
+        """Shared /chat + /chat/stream front half: parse the request,
+        hot-swap the strategy, append the user turn.  Returns
+        (error_response | None, user_input, requested, session_id,
+        history, snapshot)."""
+        data = request.get_json(silent=True) or {}
+        user_input = data.get("message", "")
+        requested = data.get("strategy", "hybrid")
+        session_id = data.get("session_id", "default")
+        if requested == "token-counting":   # UI dropdown name
+            requested = "token"
+        if not user_input.strip():
+            return ((jsonify({"error": "No message provided"}), 400),
+                    None, None, None, None, None)
+        with state_lock:
+            if requested != state["strategy"]:
+                logger.info("Switching strategy: %s -> %s",
+                            state["strategy"], requested)
+                try:
+                    state["router"].query_router.change_strategy(requested)
+                    state["strategy"] = requested
+                except Exception as exc:
+                    return ((jsonify({"error":
+                                      f"Failed to switch strategy: {exc}"}),
+                             500), None, None, None, None, None)
+            history = state["histories"].setdefault(session_id, [])
+            turn = {"role": "user", "content": user_input}
+            history.append(turn)
+            snapshot = list(history)
+        return None, turn, requested, session_id, history, snapshot
+
+    def _rollback_user_turn(history, turn):
+        """Remove THIS request's user turn by identity — popping the tail
+        would delete a different request's turn when two land on the same
+        session concurrently (streams hold the window open for seconds)."""
+        with state_lock:
+            for i in range(len(history) - 1, -1, -1):
+                if history[i] is turn:
+                    del history[i]
+                    break
+
+    def _commit_assistant_turn(history, session_id, reply):
+        """Append the assistant turn and trim IN PLACE: replacing the list
+        object would orphan the reference every other in-flight request on
+        this session holds."""
+        with state_lock:
+            history.append({"role": "assistant", "content": reply})
+            if len(history) > HISTORY_LIMIT:
+                del history[:len(history) - HISTORY_LIMIT]
+            state["histories"][session_id] = history
+
+    @app.route("/chat/stream", methods=["POST"])
+    def chat_stream():
+        """SSE chat: one ``meta`` event with the routing decision, then
+        ``delta`` events as tokens decode, then ``done``.  The reference
+        API is non-streaming (stream:false, src/devices/nano_api.py:67);
+        this is the TTFT-native extension of /chat, built on
+        Router.route_query_stream — the SAME decision stage, setup-time
+        failover, fault model, and perf feedback as the sync path.  The
+        response cache does not participate (a stream is consumed as it
+        is produced)."""
+        from ..utils.http_compat import (sse_done_event, sse_event,
+                                         streaming_response)
+
+        err, turn, requested, session_id, history, snapshot = \
+            _begin_chat_turn()
+        if err is not None:
+            return err
+
+        try:
+            routed = state["router"].route_query_stream(snapshot)
+        except Exception as exc:
+            logger.exception("stream routing failed")
+            _rollback_user_turn(history, turn)
+            return jsonify({"error": f"Routing failed: {exc}"}), 500
+
+        def events():
+            pieces: List[str] = []
+            committed = False
+            try:
+                yield sse_event({"meta": True, **routed.meta})
+                for delta in routed:
+                    pieces.append(delta)
+                    yield sse_event({"delta": delta})
+                _commit_assistant_turn(history, session_id, "".join(pieces))
+                committed = True
+                yield sse_done_event(routed.result)
+            except Exception as exc:
+                logger.exception("stream failed mid-flight")
+                yield sse_event({"error": str(exc)})
+            finally:
+                # Covers errors AND client disconnects (GeneratorExit
+                # skips except-Exception): an uncommitted turn must not
+                # leave the session history with this request's dangling
+                # user message.
+                if not committed:
+                    _rollback_user_turn(history, turn)
+
+        return streaming_response(events())
 
     # -- frontend (reference: fyp-chat-frontend, served here dependency-
     # free — same /chat contract, so the original React app also works

@@ -159,3 +159,11 @@ class RemoteTierClient:
                 total_ms=float(stats.get("total_ms", 0.0)),
             )
         return payload
+
+    def process_stream(self, history: History) -> Dict[str, Any]:
+        """Cross-host token streaming is not consumed client-side yet (the
+        remote tier's /query/stream exists, but this client is
+        synchronous): return the error-dict shape so the router's stream
+        failover picks a local tier instead."""
+        return {"error": "Request failed: remote tier streaming not "
+                         "supported by this client"}

@@ -176,6 +176,38 @@ class Router:
 
     # -- main pipeline -----------------------------------------------------
 
+    def _decide(self, query: str, context: str, ctx_hash: str,
+                history: List[Dict[str, Any]]):
+        """The routing-decision stage shared by the sync and streaming
+        pipelines: QueryRouter decision with the reference's ctx-size
+        fallback on engine failure (src/router.py:258-270).  Returns
+        (device, method, confidence, reasoning, cache_hit, overhead_ms)."""
+        t0 = time.perf_counter()
+        device = "nano"
+        method, confidence, reasoning = "unknown", 0.0, ""
+        cache_hit = False
+        try:
+            decision = self.query_router.route_query(
+                query=query, context=context, context_key=ctx_hash)
+            device = decision.device
+            method = decision.method
+            confidence = float(decision.confidence)
+            reasoning = decision.reasoning
+            cache_hit = bool(decision.cache_hit)
+            logger.info("[%s] routing: %s | method=%s conf=%.3f",
+                        "BENCH" if self.benchmark_mode else "PROD",
+                        device.upper(), method, confidence)
+        except Exception as exc:
+            ctx_size = self.token_counter.get_context_size(history)
+            device = "orin" if ctx_size > self.threshold_fallback else "nano"
+            method = "fallback_ctx_size"
+            confidence = 0.2
+            reasoning = (f"router failed: {exc}; ctx_size={ctx_size}, "
+                         f"threshold_fallback={self.threshold_fallback}")
+            logger.warning("routing failed (%s); ctx fallback -> %s", exc, device)
+        overhead_ms = (time.perf_counter() - t0) * 1000.0
+        return device, method, confidence, reasoning, cache_hit, overhead_ms
+
     def route_query(self, history: List[Dict[str, Any]]
                     ) -> Tuple[Dict[str, Any], int, str]:
         query, context, ctx_hash = self._history_to_query_and_context(history)
@@ -201,28 +233,9 @@ class Router:
                 }, tokens, which
 
         # 1) routing decision
-        t0 = time.perf_counter()
-        device = "nano"
-        method, confidence, reasoning = "unknown", 0.0, ""
-        try:
-            decision = self.query_router.route_query(
-                query=query, context=context, context_key=ctx_hash)
-            device = decision.device
-            method = decision.method
-            confidence = float(decision.confidence)
-            reasoning = decision.reasoning
-            logger.info("[%s] routing: %s | method=%s conf=%.3f",
-                        "BENCH" if self.benchmark_mode else "PROD",
-                        device.upper(), method, confidence)
-        except Exception as exc:
-            ctx_size = self.token_counter.get_context_size(history)
-            device = "orin" if ctx_size > self.threshold_fallback else "nano"
-            method = "fallback_ctx_size"
-            confidence = 0.2
-            reasoning = (f"router failed: {exc}; ctx_size={ctx_size}, "
-                         f"threshold_fallback={self.threshold_fallback}")
-            logger.warning("routing failed (%s); ctx fallback -> %s", exc, device)
-        overhead_ms = (time.perf_counter() - t0) * 1000.0
+        (device, method, confidence, reasoning,
+         cache_hit, overhead_ms) = self._decide(query, context, ctx_hash,
+                                                history)
 
         # 2) inference + failover
         raw, which, lat_ms = self._run_device(device, history)
@@ -265,3 +278,86 @@ class Router:
             "routing_reasoning": reasoning,
             "ok": ok,
         }, tokens, which
+
+    def route_query_stream(self, history: List[Dict[str, Any]]
+                           ) -> "RoutedStream":
+        """Streaming twin of ``route_query``: same decision stage
+        (``_decide`` incl. the ctx-size fallback), same one-shot tier
+        failover — applied at stream SETUP, where a clean switch is still
+        possible — and the same perf feedback, fired when the stream
+        completes.  The response cache does not participate: a streamed
+        reply is consumed as it is produced.  Raises RuntimeError if no
+        tier can start a stream."""
+        query, context, ctx_hash = self._history_to_query_and_context(history)
+        (device, method, confidence, reasoning,
+         cache_hit, overhead_ms) = self._decide(query, context, ctx_hash,
+                                                history)
+
+        t0 = time.perf_counter()
+        handle = self.tiers[device].process_stream(history)
+        which = device
+        if self._is_error(handle) and self.enable_failover:
+            other = "orin" if device == "nano" else "nano"
+            logger.warning("%s stream setup failed — failing over to %s",
+                           device, other)
+            alt = self.tiers[other].process_stream(history)
+            if not self._is_error(alt):
+                handle, which = alt, other
+        if self._is_error(handle):
+            raise RuntimeError(handle.get("error", "stream setup failed"))
+
+        def on_done(result, ok: bool) -> None:
+            # Engine-true generation time, NOT wall time to exhaustion: a
+            # slow SSE consumer would otherwise poison the perf strategy's
+            # latency window for a healthy tier.
+            if result is not None and result.total_ms > 0:
+                lat_ms = result.total_ms
+            else:
+                lat_ms = (time.perf_counter() - t0) * 1000.0
+            tokens = result.gen_tokens if result else 0
+            try:
+                self.query_router.update_perf(which, lat_ms, tokens, ok=ok)
+            except Exception:
+                pass
+
+        meta = {
+            "device": which,
+            "method": method,
+            "confidence": round(confidence, 4),
+            "reasoning": reasoning,
+            "cache_hit": cache_hit,
+            "routing_overhead_ms": round(overhead_ms, 2),
+        }
+        return RoutedStream(handle, which, meta, on_done)
+
+
+class RoutedStream:
+    """A routed token stream: iterate for text deltas; ``.result`` holds
+    the GenerationResult once exhausted.  Fires the router's perf-feedback
+    callback exactly once, whether the stream completes, errors, or is
+    abandoned mid-iteration (client disconnect)."""
+
+    def __init__(self, handle, device: str, meta: Dict[str, Any], on_done):
+        self._handle = handle
+        self.device = device
+        self.meta = meta
+        self._on_done = on_done
+        self._fired = False
+
+    def _fire(self, ok: bool) -> None:
+        if not self._fired:
+            self._fired = True
+            self._on_done(self._handle.result, ok)
+
+    def __iter__(self):
+        try:
+            for delta in self._handle:
+                yield delta
+        except BaseException:       # incl. GeneratorExit on disconnect
+            self._fire(False)
+            raise
+        self._fire(True)
+
+    @property
+    def result(self):
+        return self._handle.result

@@ -61,6 +61,55 @@ class TierClient:
         self.last_result = result
         return {"response": result.text}
 
+    def process_stream(self, history: History):
+        """Streaming twin of ``process``: returns a primed stream handle,
+        or the reference error-dict shape on any setup failure.  Fault
+        injection applies exactly like the sync path, and the stream is
+        PRIMED (first token pulled, i.e. prefill has run) before this
+        returns — engine errors are lazy, surfacing at first iteration,
+        so priming is what makes setup-time failover able to catch real
+        engine failures, not just injected ones."""
+        if self.faults is not None:
+            fault = self.faults.intercept(self.name)
+            if fault is not None:
+                return fault
+        try:
+            if not self.server_manager.is_server_running():
+                logger.info("No running %s engine found, starting...", self.name)
+                self.server_manager.start_server()
+            engine = self.server_manager.engine()
+            if not hasattr(engine, "generate_stream"):
+                return {"error": "Request failed: engine does not support "
+                                 "token streaming"}
+            return _PrimedStream(engine.generate_stream(history))
+        except Exception as exc:
+            return {"error": f"Request failed: {exc}"}
+
+
+class _PrimedStream:
+    """A stream handle whose first delta has already been pulled (raising
+    setup/prefill errors eagerly); iteration replays it then continues."""
+
+    def __init__(self, handle):
+        self._handle = handle
+        self._it = iter(handle)
+        self._first: Optional[str] = None
+        self._exhausted = False
+        try:
+            self._first = next(self._it)
+        except StopIteration:
+            self._exhausted = True
+
+    def __iter__(self):
+        if self._first is not None:
+            yield self._first
+        if not self._exhausted:
+            yield from self._it
+
+    @property
+    def result(self):
+        return self._handle.result
+
 
 def build_tiers(
     cluster: ClusterConfig,

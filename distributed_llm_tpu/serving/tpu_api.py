@@ -23,7 +23,6 @@ import argparse
 import logging
 from typing import Any, Dict, Optional
 
-import json as _json
 
 from ..config import ClusterConfig
 from ..utils.http_compat import Flask, jsonify, request, streaming_response
@@ -134,18 +133,15 @@ def create_tier_app(tier_name: str,
             logger.exception("stream setup failed")
             return jsonify({"error": f"Inference failed: {exc}"}), 500
 
+        from ..utils.http_compat import sse_done_event, sse_event
+
         def events():
             try:
                 for delta in handle:
-                    yield f"data: {_json.dumps({'delta': delta})}\n\n"
-                result = handle.result
-                yield "data: " + _json.dumps({
-                    "done": True,
-                    "tokens": result.gen_tokens if result else 0,
-                    "ttft_ms": round(result.ttft_ms, 2) if result else None,
-                }) + "\n\n"
+                    yield sse_event({"delta": delta})
+                yield sse_done_event(handle.result)
             except Exception as exc:
-                yield f"data: {_json.dumps({'error': str(exc)})}\n\n"
+                yield sse_event({"error": str(exc)})
 
         return streaming_response(events())
 

@@ -15,6 +15,24 @@ except ImportError:
     HAVE_FLASK = False
 
 
+def sse_event(obj) -> str:
+    """One server-sent event frame; the single source of the SSE framing
+    used by every streaming endpoint (tier /query/stream, app
+    /chat/stream)."""
+    import json
+    return f"data: {json.dumps(obj)}\n\n"
+
+
+def sse_done_event(result) -> str:
+    """The shared terminal event: token count + engine-true TTFT from a
+    GenerationResult (or None)."""
+    return sse_event({
+        "done": True,
+        "tokens": result.gen_tokens if result else 0,
+        "ttft_ms": round(result.ttft_ms, 2) if result else None,
+    })
+
+
 def streaming_response(chunks, content_type: str = "text/event-stream"):
     """A chunked/SSE response on either backend."""
     if HAVE_FLASK:

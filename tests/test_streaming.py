@@ -159,3 +159,86 @@ def test_stream_endpoint_json_error_for_greedy_only_engine():
         "/query/stream", json={"query": "user: x", "temperature": 0.9})
     assert resp.status_code == 501
     assert "error" in resp.get_json()
+
+
+def test_app_chat_stream_endpoint():
+    """App-level /chat/stream: meta event (routing decision) -> deltas ->
+    done; history gains the assistant turn assembled from the deltas."""
+    from distributed_llm_tpu.config import ClusterConfig
+    from distributed_llm_tpu.serving.app import create_app
+
+    cluster = ClusterConfig(
+        nano=_tier(), orin=_tier(name="orin", model_preset="orin_test",
+                                 decode_batch=1))
+    app = create_app(cluster=cluster)
+    try:
+        c = app.test_client()
+        r = c.post("/chat/stream", json={"message": "hello stream",
+                                         "strategy": "heuristic",
+                                         "session_id": "st1"})
+        assert r.status_code == 200
+        events = [json.loads(l[len("data: "):])
+                  for l in r.text.strip().split("\n\n")
+                  if l.startswith("data: ")]
+        assert events[0].get("meta") is True
+        assert events[0]["device"] in ("nano", "orin")
+        assert events[0]["method"]
+        assert events[-1].get("done") is True
+        deltas = "".join(e.get("delta", "") for e in events[1:-1])
+        h = c.get("/history?session_id=st1").get_json()   # bare list (ref shape)
+        assert h[-1]["role"] == "assistant"
+        assert h[-1]["content"] == deltas
+        # A sync /chat on the same session continues the conversation.
+        r2 = c.post("/chat", json={"message": "and more?",
+                                   "strategy": "heuristic",
+                                   "session_id": "st1"})
+        assert r2.status_code == 200
+    finally:
+        state = app.extensions["dllm_state"]
+        for tier in state["router"].tiers.values():
+            tier.server_manager.stop_server()
+
+
+def test_app_chat_stream_rejects_empty_message():
+    from distributed_llm_tpu.config import ClusterConfig
+    from distributed_llm_tpu.serving.app import create_app
+
+    cluster = ClusterConfig(
+        nano=_tier(), orin=_tier(name="orin", model_preset="orin_test"))
+    app = create_app(cluster=cluster)
+    r = app.test_client().post("/chat/stream", json={"message": "  "})
+    assert r.status_code == 400
+
+
+def test_routed_stream_fails_over_and_feeds_perf():
+    """Router.route_query_stream applies the fault model, setup-time
+    failover, and perf feedback — the same pipeline as the sync path."""
+    from distributed_llm_tpu.config import ClusterConfig
+    from distributed_llm_tpu.serving.router import Router
+    from distributed_llm_tpu.utils.faults import FaultInjector
+
+    faults = FaultInjector()
+    cluster = ClusterConfig(
+        nano=_tier(), orin=_tier(name="orin", model_preset="orin_test",
+                                 decode_batch=1))
+    router = Router(strategy="heuristic", benchmark_mode=True,
+                    cluster=cluster, fault_injector=faults)
+    try:
+        # "hi" routes nano; the injected fault forces the stream onto orin.
+        faults.fail_next("nano")
+        routed = router.route_query_stream([{"role": "user", "content": "hi"}])
+        text = "".join(routed)
+        assert routed.device == "orin"
+        assert routed.meta["device"] == "orin"
+        assert text == (routed.result.text if routed.result else text)
+
+        # Perf strategy sees the streamed turn's latency/tokens.
+        router.query_router.change_strategy("perf")
+        routed2 = router.route_query_stream(
+            [{"role": "user", "content": "hello again"}])
+        list(routed2)
+        perf = router.query_router.router        # active strategy object
+        assert sum(len(s) for s in perf.samples.values()) >= 1
+    finally:
+        for tier in router.tiers.values():
+            tier.server_manager.stop_server()
