@@ -27,7 +27,8 @@ from typing import Any, Dict, List, Optional
 
 from ..config import ClusterConfig
 from ..utils.http_compat import (Flask, enable_cors, jsonify, request,
-                                 static_response)
+                                 sse_done_event, sse_event, static_response,
+                                 streaming_response)
 from .router import Router
 
 logger = logging.getLogger(__name__)
@@ -150,12 +151,12 @@ def create_app(router: Optional[Router] = None,
     def _commit_assistant_turn(history, session_id, reply):
         """Append the assistant turn and trim IN PLACE: replacing the list
         object would orphan the reference every other in-flight request on
-        this session holds."""
+        this session holds — and NO re-bind, which would resurrect a
+        session cleared (or replaced) while this request was in flight."""
         with state_lock:
             history.append({"role": "assistant", "content": reply})
             if len(history) > HISTORY_LIMIT:
                 del history[:len(history) - HISTORY_LIMIT]
-            state["histories"][session_id] = history
 
     @app.route("/chat/stream", methods=["POST"])
     def chat_stream():
@@ -167,9 +168,6 @@ def create_app(router: Optional[Router] = None,
         failover, fault model, and perf feedback as the sync path.  The
         response cache does not participate (a stream is consumed as it
         is produced)."""
-        from ..utils.http_compat import (sse_done_event, sse_event,
-                                         streaming_response)
-
         err, turn, requested, session_id, history, snapshot = \
             _begin_chat_turn()
         if err is not None:

@@ -325,7 +325,12 @@ class Router:
             "method": method,
             "confidence": round(confidence, 4),
             "reasoning": reasoning,
-            "cache_hit": cache_hit,
+            # Same meaning as /chat's cache_hit (response cache) — streams
+            # never serve from it, so always False; the routing-decision
+            # cache hit is its own field (it also shows as "*_cached" in
+            # method, matching the sync path's convention).
+            "cache_hit": False,
+            "routing_cache_hit": cache_hit,
             "routing_overhead_ms": round(overhead_ms, 2),
         }
         return RoutedStream(handle, which, meta, on_done)
@@ -353,7 +358,14 @@ class RoutedStream:
         try:
             for delta in self._handle:
                 yield delta
-        except BaseException:       # incl. GeneratorExit on disconnect
+        except GeneratorExit:
+            # Consumer abandoned the stream (client disconnect) — the TIER
+            # was healthy as far as it was consumed; an ok=False sample
+            # here would let disconnecting clients poison the perf
+            # strategy against a healthy tier.
+            self._fire(True)
+            raise
+        except BaseException:        # real engine/stream failure
             self._fire(False)
             raise
         self._fire(True)

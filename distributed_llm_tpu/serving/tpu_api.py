@@ -25,7 +25,8 @@ from typing import Any, Dict, Optional
 
 
 from ..config import ClusterConfig
-from ..utils.http_compat import Flask, jsonify, request, streaming_response
+from ..utils.http_compat import (Flask, jsonify, request, sse_done_event,
+                                 sse_event, streaming_response)
 from ..engine.manager import EngineManager
 from .router import default_cluster
 from .tiers import build_tiers
@@ -132,8 +133,6 @@ def create_tier_app(tier_name: str,
         except Exception as exc:
             logger.exception("stream setup failed")
             return jsonify({"error": f"Inference failed: {exc}"}), 500
-
-        from ..utils.http_compat import sse_done_event, sse_event
 
         def events():
             try:

@@ -15,12 +15,14 @@ except ImportError:
     HAVE_FLASK = False
 
 
+import json as _json
+
+
 def sse_event(obj) -> str:
     """One server-sent event frame; the single source of the SSE framing
     used by every streaming endpoint (tier /query/stream, app
     /chat/stream)."""
-    import json
-    return f"data: {json.dumps(obj)}\n\n"
+    return f"data: {_json.dumps(obj)}\n\n"
 
 
 def sse_done_event(result) -> str:

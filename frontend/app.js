@@ -127,6 +127,119 @@ function addTyping() {
 // --- send flow (reference: App.tsx:100-110) ---------------------------------
 let busy = false;
 
+function chatBody(text) {
+  return JSON.stringify({
+    message: text,
+    strategy: strategyEl.value,
+    session_id: sessionId(),
+  });
+}
+
+async function sendSync(text, typing) {
+  const res = await fetch(API_BASE + "/chat", {
+    method: "POST",
+    headers: { "Content-Type": "application/json" },
+    body: chatBody(text),
+  });
+  const data = await res.json();
+  typing.remove();
+  if (!res.ok) {
+    addErrorMessage(data.reply || data.error || ("HTTP " + res.status));
+  } else {
+    addBotMessage(data);
+  }
+}
+
+// Token streaming over /chat/stream (SSE): deltas render as they decode;
+// the meta + done events fill the routing panel.  Any setup failure falls
+// back to the synchronous /chat path.
+async function sendStreaming(text, typing) {
+  const res = await fetch(API_BASE + "/chat/stream", {
+    method: "POST",
+    headers: { "Content-Type": "application/json" },
+    body: chatBody(text),
+  });
+  if (!res.ok || !res.body) {
+    throw new Error("stream unavailable (HTTP " + res.status + ")");
+  }
+  const reader = res.body.getReader();
+  const decoder = new TextDecoder();
+  let buf = "", reply = "", meta = null, finished = false, started = false;
+  let row = null, replyEl = null;
+
+  function ensureBubble() {
+    if (row) return;
+    typing.remove();
+    row = el("div", "msg bot");
+    const bubble = el("div", "bubble");
+    replyEl = el("div", "reply", "");
+    bubble.appendChild(replyEl);
+    row.appendChild(bubble);
+    messagesEl.appendChild(row);
+  }
+
+  function handle(ev) {
+    if (ev.meta) { meta = ev; return; }
+    if (ev.delta !== undefined) {
+      ensureBubble();
+      reply += ev.delta;
+      // Plain text while streaming (O(1) per token); one markdown render
+      // at the done event — re-rendering the whole reply per delta is
+      // O(n²) regex + DOM teardown and destroys any text selection.
+      replyEl.textContent = reply;
+      scrollDown();
+      return;
+    }
+    if (ev.done) {
+      finished = true;
+      ensureBubble();
+      replyEl.innerHTML = renderMarkdown(reply);
+      row.querySelector(".bubble").appendChild(metaPanel({
+        reply: reply,
+        device: meta && meta.device,
+        method: meta && meta.method,
+        confidence: meta && meta.confidence,
+        cache_hit: meta && meta.cache_hit,
+        reasoning: meta && meta.reasoning,
+        tokens: ev.tokens,
+      }));
+      scrollDown();
+      return;
+    }
+    if (ev.error) {
+      finished = true;
+      typing.remove();
+      addErrorMessage(ev.error);
+    }
+  }
+
+  try {
+    for (;;) {
+      const chunk = await reader.read();
+      if (chunk.done) break;
+      buf += decoder.decode(chunk.value, { stream: true });
+      let idx;
+      while ((idx = buf.indexOf("\n\n")) >= 0) {
+        const frame = buf.slice(0, idx);
+        buf = buf.slice(idx + 2);
+        if (frame.startsWith("data: ")) {
+          started = true;
+          handle(JSON.parse(frame.slice(6)));
+        }
+      }
+    }
+  } catch (err) {
+    // Mid-stream failure must NOT fall back to /chat: the turn was
+    // already (partially) served — resending would double-submit it.
+    err.noFallback = started;
+    throw err;
+  }
+  if (!finished) {
+    typing.remove();
+    addErrorMessage("Stream ended unexpectedly");
+  }
+}
+
 async function send(text) {
   if (busy || !text.trim()) return;
   busy = true;
@@ -134,21 +247,12 @@ async function send(text) {
   addUserMessage(text);
   const typing = addTyping();
   try {
-    const res = await fetch(API_BASE + "/chat", {
-      method: "POST",
-      headers: { "Content-Type": "application/json" },
-      body: JSON.stringify({
-        message: text,
-        strategy: strategyEl.value,
-        session_id: sessionId(),
-      }),
-    });
-    const data = await res.json();
-    typing.remove();
-    if (!res.ok) {
-      addErrorMessage(data.reply || data.error || ("HTTP " + res.status));
-    } else {
-      addBotMessage(data);
+    try {
+      await sendStreaming(text, typing);
+    } catch (streamErr) {
+      if (streamErr && streamErr.noFallback) throw streamErr;
+      // Stream endpoint unavailable (older backend / proxy): sync path.
+      await sendSync(text, typing);
     }
   } catch (err) {
     typing.remove();
