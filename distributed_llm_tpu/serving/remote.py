@@ -122,25 +122,31 @@ class RemoteTierClient:
         self.faults = fault_injector
         self.last_result: Optional[GenerationResult] = None
 
-    def process(self, history: History) -> Dict[str, Any]:
+    def _intercept(self) -> Optional[Dict[str, Any]]:
         if self.faults is not None:
-            fault = self.faults.intercept(self.name)
-            if fault is not None:
-                return fault
-        # No health round trip — but DO enforce the connect timeout
-        # separately (urllib has a single timeout knob, and inference can
-        # legitimately take the full read timeout): a cheap 5 s TCP probe
-        # makes a dead/blackholed host fail fast into the router's
-        # failover instead of stalling each request for read_timeout.
-        # The reference client's lazy SSH restart (src/models/nano.py:19-21)
-        # has no equivalent here — the remote host supervises its own
-        # process.
+            return self.faults.intercept(self.name)
+        return None
+
+    def _probe(self) -> None:
+        """Enforce the connect timeout separately (urllib has a single
+        timeout knob, and inference can legitimately take the full read
+        timeout): a cheap 5 s TCP probe makes a dead/blackholed host fail
+        fast into the router's failover instead of stalling each request
+        for read_timeout.  The reference client's lazy SSH restart
+        (src/models/nano.py:19-21) has no equivalent here — the remote
+        host supervises its own process."""
+        parts = urllib.parse.urlsplit(self.base_url)
+        conn = socket.create_connection(
+            (parts.hostname, parts.port or 80),
+            timeout=self.server_manager.connect_timeout)
+        conn.close()
+
+    def process(self, history: History) -> Dict[str, Any]:
+        fault = self._intercept()
+        if fault is not None:
+            return fault
         try:
-            parts = urllib.parse.urlsplit(self.base_url)
-            conn = socket.create_connection(
-                (parts.hostname, parts.port or 80),
-                timeout=self.server_manager.connect_timeout)
-            conn.close()
+            self._probe()
             payload = _http_json(f"{self.base_url}/query",
                                  {"query": history, "stats": True},
                                  timeout=self.read_timeout)
@@ -160,10 +166,111 @@ class RemoteTierClient:
             )
         return payload
 
-    def process_stream(self, history: History) -> Dict[str, Any]:
-        """Cross-host token streaming is not consumed client-side yet (the
-        remote tier's /query/stream exists, but this client is
-        synchronous): return the error-dict shape so the router's stream
-        failover picks a local tier instead."""
-        return {"error": "Request failed: remote tier streaming not "
-                         "supported by this client"}
+    def process_stream(self, history: History):
+        """Cross-host token streaming: consume the remote tier's
+        /query/stream SSE over DCN and expose the same handle surface as
+        a local engine stream (iterable of deltas, ``.result`` once the
+        terminal event arrives).  Setup failures — unreachable host,
+        non-SSE reply, an error event before any delta — return the
+        reference error-dict shape so the router's stream failover picks
+        another tier."""
+        fault = self._intercept()
+        if fault is not None:
+            return fault
+        resp = None
+        try:
+            self._probe()
+            data = json.dumps({"query": history}).encode("utf-8")
+            req = urllib.request.Request(
+                f"{self.base_url}/query/stream", data=data,
+                headers={"Content-Type": "application/json"})
+            resp = urllib.request.urlopen(req, timeout=self.read_timeout)
+            ctype = resp.headers.get("Content-Type", "")
+            if "text/event-stream" not in ctype:
+                body = resp.read(2048).decode("utf-8", "replace")
+                return {"error": f"Request failed: non-SSE reply "
+                                 f"({ctype!r}): {body[:200]}"}
+            handle = _RemoteStream(resp)
+            # Surface pre-first-token failures (incl. an SSE error event,
+            # which prime raises as RuntimeError) as the error-dict shape —
+            # this is the router's stream-failover window.
+            handle.prime()
+            resp = None                  # handle owns the connection now
+            return handle
+        except (urllib.error.URLError, socket.timeout, TimeoutError,
+                ValueError, OSError, RuntimeError) as exc:
+            return {"error": f"Request failed: {exc}"}
+        finally:
+            if resp is not None:
+                resp.close()
+
+
+class _RemoteStream:
+    """Client side of the /query/stream SSE contract: iterates text
+    deltas; ``.result`` is assembled from the terminal ``done`` event
+    (engine-true tokens/TTFT from across the wire)."""
+
+    def __init__(self, resp):
+        self._resp = resp
+        self._buf = b""
+        self._queued: List[str] = []
+        self._done = False
+        self.result: Optional[GenerationResult] = None
+        self._text_parts: List[str] = []
+
+    def _read_frames(self):
+        """Read until at least one complete SSE frame is handled or the
+        connection ends.  Returns True if anything was handled."""
+        while not self._done:
+            sep = self._buf.find(b"\n\n")
+            if sep >= 0:
+                frame = self._buf[:sep].decode("utf-8", "replace")
+                self._buf = self._buf[sep + 2:]
+                if not frame.startswith("data: "):
+                    continue
+                ev = json.loads(frame[len("data: "):])
+                if "delta" in ev:
+                    self._queued.append(ev["delta"])
+                    self._text_parts.append(ev["delta"])
+                    return True
+                if ev.get("done"):
+                    self._done = True
+                    self.result = GenerationResult(
+                        text="".join(self._text_parts), token_ids=[],
+                        prompt_tokens=0,
+                        gen_tokens=int(ev.get("tokens", 0)),
+                        ttft_ms=float(ev.get("ttft_ms") or 0.0),
+                        # Engine-true generation time from across the
+                        # wire: keeps the router's perf feedback immune
+                        # to consumer pacing (see sse_done_event).
+                        total_ms=float(ev.get("total_ms") or 0.0))
+                    self._resp.close()
+                    return True
+                if "error" in ev:
+                    self._done = True
+                    self._resp.close()
+                    raise RuntimeError(ev["error"])
+                continue
+            chunk = self._resp.read1(65536) if hasattr(self._resp, "read1") \
+                else self._resp.read(65536)
+            if not chunk:
+                self._done = True
+                self._resp.close()
+                return False
+            self._buf += chunk
+        return False
+
+    def prime(self) -> None:
+        """Pull the first event so setup-time errors raise here (the
+        router failover window), mirroring tiers._PrimedStream."""
+        if not self._queued and not self._done:
+            self._read_frames()
+
+    def __iter__(self):
+        while True:
+            while self._queued:
+                yield self._queued.pop(0)
+            if self._done:
+                return
+            if not self._read_frames() and self._done:
+                return

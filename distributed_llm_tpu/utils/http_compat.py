@@ -26,12 +26,15 @@ def sse_event(obj) -> str:
 
 
 def sse_done_event(result) -> str:
-    """The shared terminal event: token count + engine-true TTFT from a
-    GenerationResult (or None)."""
+    """The shared terminal event: token count + engine-true TTFT and total
+    generation time from a GenerationResult (or None).  total_ms lets a
+    cross-host stream consumer (serving/remote.py) feed the perf strategy
+    engine-true latency instead of wall time shaped by consumer pacing."""
     return sse_event({
         "done": True,
         "tokens": result.gen_tokens if result else 0,
         "ttft_ms": round(result.ttft_ms, 2) if result else None,
+        "total_ms": round(result.total_ms, 2) if result else None,
     })
 
 

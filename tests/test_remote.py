@@ -99,3 +99,43 @@ def test_router_serves_through_live_remote_tier(remote_server):
         [{"role": "user", "content": "hi"}])
     assert device == "nano"
     assert "response" in response
+
+
+def test_remote_stream_consumes_sse(remote_server):
+    """RemoteTierClient streams deltas over the wire and assembles the
+    result from the done event."""
+    client = RemoteTierClient("nano", remote_server)
+    handle = client.process_stream(
+        [{"role": "user", "content": "stream across hosts"}])
+    assert not isinstance(handle, dict), handle
+    deltas = list(handle)
+    assert handle.result is not None
+    assert handle.result.gen_tokens >= 1
+    assert "".join(deltas) == handle.result.text
+
+
+def test_remote_stream_dead_host_error_shape():
+    client = RemoteTierClient("nano", "http://127.0.0.1:1")
+    out = client.process_stream("user: anyone?")
+    assert isinstance(out, dict) and out["error"].startswith("Request failed:")
+
+
+def test_router_streams_through_live_remote_tier(remote_server):
+    """Full app streaming pipeline with the nano tier living across DCN."""
+    from distributed_llm_tpu.config import ClusterConfig
+    from distributed_llm_tpu.serving.router import Router
+
+    cluster = ClusterConfig(
+        nano=_tier(name="nano", endpoint=remote_server),
+        orin=_tier(name="orin", model_preset="orin_test"))
+    router = Router(strategy="heuristic", benchmark_mode=True,
+                    cluster=cluster)
+    try:
+        routed = router.route_query_stream([{"role": "user", "content": "hi"}])
+        text = "".join(routed)
+        assert routed.device == "nano"
+        assert routed.result is not None and routed.result.gen_tokens >= 1
+        assert text == routed.result.text
+    finally:
+        for tier in router.tiers.values():
+            tier.server_manager.stop_server()
