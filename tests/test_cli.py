@@ -1,0 +1,35 @@
+"""The framework's own CLI REPL (serving/cli.py) — reference parity with
+src/main.py's Chatbot: interactive turns, clean shutdown on exit."""
+
+import builtins
+
+from distributed_llm_tpu.config import ClusterConfig, tiny_cluster
+from distributed_llm_tpu.serving.cli import Chatbot
+from distributed_llm_tpu.serving.router import Router
+
+
+def _router():
+    tiny = tiny_cluster()
+    return Router(strategy="heuristic", benchmark_mode=True,
+                  cluster=ClusterConfig(nano=tiny.nano, orin=tiny.orin))
+
+
+def test_cli_ask_and_shutdown():
+    bot = Chatbot(router=_router())
+    out = bot.ask("hello there")
+    assert out.startswith("[nano]") or out.startswith("[orin]")
+    assert [m["role"] for m in bot.history] == ["user", "assistant"]
+    bot.shutdown()
+    assert not bot.router.nano.server_manager.is_server_running()
+    assert not bot.router.orin.server_manager.is_server_running()
+
+
+def test_cli_repl_loop_exits_cleanly(monkeypatch, capsys):
+    bot = Chatbot(router=_router())
+    lines = iter(["hi", "", "exit"])
+    monkeypatch.setattr(builtins, "input", lambda prompt="": next(lines))
+    bot.chat()
+    out = capsys.readouterr().out
+    assert "Tier engines stopped" in out
+    assert len(bot.history) == 2           # empty input routed nothing
+    assert not bot.router.nano.server_manager.is_server_running()
