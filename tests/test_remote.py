@@ -139,3 +139,32 @@ def test_router_streams_through_live_remote_tier(remote_server):
     finally:
         for tier in router.tiers.values():
             tier.server_manager.stop_server()
+
+
+def test_health_monitor_survives_dead_remote_tier():
+    """HealthMonitor probes a dead remote tier without crashing its
+    thread; the snapshot reports the tier unhealthy while local tiers
+    stay healthy."""
+    from distributed_llm_tpu.config import ClusterConfig
+    from distributed_llm_tpu.serving.health import HealthMonitor
+    from distributed_llm_tpu.serving.router import Router
+
+    cluster = ClusterConfig(
+        nano=_tier(),
+        orin=_tier(name="orin", endpoint="http://127.0.0.1:1"))
+    router = Router(strategy="heuristic", benchmark_mode=True,
+                    cluster=cluster)
+    mon = HealthMonitor(router, interval_s=0.2, auto_restart=True,
+                        max_consecutive_failures=1)
+    try:
+        router.route_query([{"role": "user", "content": "hi"}])  # warm nano
+        mon.start()
+        import time
+        time.sleep(1.5)                      # several probe cycles
+        snap = mon.snapshot()
+        assert "orin" in snap and "nano" in snap
+        assert not snap["orin"].get("ok", True)
+    finally:
+        mon.stop()
+        for tier in router.tiers.values():
+            tier.server_manager.stop_server()
