@@ -302,3 +302,30 @@ def test_manager_builds_batched_engine_for_sharded_tier():
         assert res.gen_tokens >= 1
     finally:
         mgr.stop_server()
+
+
+def test_batched_tp_mesh_prefix_reuse_multiturn():
+    """Session KV prefix reuse works under the tensor-parallel batching
+    engine: the follow-up turn reclaims parked pool blocks and still
+    matches the unsharded engine's greedy tokens."""
+    from distributed_llm_tpu.parallel.mesh import tp_mesh
+
+    tier = _tier(name="orin", model_preset="orin_test", decode_batch=2,
+                 max_new_tokens=6)
+    plain = ContinuousBatchingEngine(tier, seed=51)
+    tp = ContinuousBatchingEngine(tier, seed=51,
+                                  mesh=tp_mesh(jax.devices(), 4))
+    try:
+        outs = []
+        for eng in (plain, tp):
+            h = [{"role": "user", "content": "tell me about rivers"}]
+            r1 = eng.generate(h)
+            h += [{"role": "assistant", "content": r1.text},
+                  {"role": "user", "content": "and lakes?"}]
+            r2 = eng.generate(h)
+            outs.append((r1.token_ids, r2.token_ids))
+            assert eng.prefix_cache.stats()["hits"] >= 1
+        assert outs[0] == outs[1]
+    finally:
+        plain.stop()
+        tp.stop()
