@@ -136,8 +136,9 @@ class RemoteTierClient:
         (src/models/nano.py:19-21) has no equivalent here — the remote
         host supervises its own process."""
         parts = urllib.parse.urlsplit(self.base_url)
+        port = parts.port or (443 if parts.scheme == "https" else 80)
         conn = socket.create_connection(
-            (parts.hostname, parts.port or 80),
+            (parts.hostname, port),
             timeout=self.server_manager.connect_timeout)
         conn.close()
 

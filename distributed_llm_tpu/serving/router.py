@@ -294,12 +294,13 @@ class Router:
                                                 history)
 
         t0 = time.perf_counter()
-        handle = self.tiers[device].process_stream(history)
-        which = device
+        tier = self.tiers.get(device, self.nano)
+        handle = tier.process_stream(history)
+        which = tier.name
         if self._is_error(handle) and self.enable_failover:
-            other = "orin" if device == "nano" else "nano"
+            other = "orin" if which == "nano" else "nano"
             logger.warning("%s stream setup failed — failing over to %s",
-                           device, other)
+                           which, other)
             alt = self.tiers[other].process_stream(history)
             if not self._is_error(alt):
                 handle, which = alt, other

@@ -79,7 +79,9 @@ def _run(body: str, cwd: str, timeout: int = 900) -> str:
     proc = subprocess.run(
         [sys.executable, "-c", BOOTSTRAP + body], cwd=cwd,
         capture_output=True, text=True, timeout=timeout,
-        env={**os.environ, "PYTHONPATH": REPO})
+        env={**os.environ,
+             "PYTHONPATH": os.pathsep.join(
+                 p for p in (REPO, os.environ.get("PYTHONPATH", "")) if p)})
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
     return proc.stdout
 
