@@ -270,6 +270,34 @@ def tiny_cluster() -> ClusterConfig:
     )
 
 
+def default_checkpoint(preset: str) -> Optional[str]:
+    """Repo-local pretrained weights for a preset, if published: the
+    ``checkpoints/<preset>`` directory written by training/pretrain.py
+    (detected by its ``latest`` version link).  None = no artifact, tiers
+    fall back to deterministic random init."""
+    import os
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "checkpoints", preset)
+    return root if os.path.islink(os.path.join(root, "latest")) else None
+
+
+def with_default_checkpoints(cluster: "ClusterConfig") -> "ClusterConfig":
+    """Fill each tier's ``checkpoint_path`` with the preset's published
+    pretrained artifact (when one exists and the tier doesn't already pin
+    a path).  Serving entry points use this so /chat runs on learned
+    weights (reference tiers serve pretrained models,
+    src/devices/nano_api.py:15-16); unit tests build clusters directly
+    and keep fast deterministic random init."""
+    def fill(tier: TierConfig) -> TierConfig:
+        if tier.checkpoint_path or tier.endpoint:
+            return tier
+        path = default_checkpoint(tier.model_preset)
+        return (dataclasses.replace(tier, checkpoint_path=path)
+                if path else tier)
+    return dataclasses.replace(cluster, nano=fill(cluster.nano),
+                               orin=fill(cluster.orin))
+
+
 def resolve_config(config: Optional[Dict[str, Any]], benchmark_mode: bool) -> Dict[str, Any]:
     """Explicit config wins; otherwise pick the canonical dict by mode
     (reference: src/router.py:37-40)."""

@@ -173,6 +173,15 @@ class ContinuousBatchingEngine:
         self._thread: Optional[threading.Thread] = None
         self._lifecycle = threading.Lock()   # guards start()/stop()
 
+        # Per-phase wall-time + roofline work (GET /stats, bench MFU/HBM
+        # accounting — utils/telemetry.py, utils/roofline.py).  Only the
+        # scheduler thread writes; snapshots from other threads read
+        # whole-dict summaries, safe under the GIL.
+        from ..utils.telemetry import PhaseTimer
+        from ..utils import roofline
+        self.phases = PhaseTimer()
+        self._wbytes = roofline.weight_bytes(self.cfg, tier.quantize)
+
     # -- compiled stages ---------------------------------------------------
 
     def _prefill_fn(self, bucket: int):
@@ -317,6 +326,7 @@ class ContinuousBatchingEngine:
         temp = (self.tier.temperature if req.temperature is None
                 else req.temperature)
 
+        from ..utils import roofline
         if reused is not None:
             entry, m, suffix, sb = reused
             owned = list(entry.cache["blocks"])
@@ -336,11 +346,14 @@ class ContinuousBatchingEngine:
                 tokens = np.full((1, sb), self.tokenizer.pad_id, np.int32)
                 tokens[0, :len(suffix)] = suffix
                 window = self._suffix_window(m + sb)
-                first, self.pool = self._chunk_prefill_fn(sb, window)(
-                    self.params, self.pool, jnp.asarray(tokens),
-                    jnp.asarray([m], np.int32), jnp.asarray([n], np.int32),
-                    jnp.asarray(row), rng, jnp.float32(temp))
-                first = int(jax.block_until_ready(first))
+                with self.phases.phase("prefill"):
+                    first, self.pool = self._chunk_prefill_fn(sb, window)(
+                        self.params, self.pool, jnp.asarray(tokens),
+                        jnp.asarray([m], np.int32), jnp.asarray([n], np.int32),
+                        jnp.asarray(row), rng, jnp.float32(temp))
+                    first = int(jax.block_until_ready(first))
+                self.phases.add_work("prefill", **roofline.prefill_work(
+                    self.cfg, window, window - sb, wbytes=self._wbytes))
             except BaseException:
                 self.allocator.free(owned)   # don't leak pool blocks
                 raise
@@ -355,15 +368,18 @@ class ContinuousBatchingEngine:
                 tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
                 tokens[0, :n] = ids
 
-                first, k_all, v_all = self._prefill_fn(bucket)(
-                    self.params, jnp.asarray(tokens),
-                    jnp.asarray([n], np.int32), rng, jnp.float32(temp))
-                # Page the prefilled bucket into this slot's leading blocks.
-                nb_prefill = bucket // bs
-                self.pool = self._writer_fn(nb_prefill)(
-                    self.pool, jnp.asarray(blocks[:nb_prefill], np.int32),
-                    k_all, v_all)
-                first = int(jax.block_until_ready(first))
+                with self.phases.phase("prefill"):
+                    first, k_all, v_all = self._prefill_fn(bucket)(
+                        self.params, jnp.asarray(tokens),
+                        jnp.asarray([n], np.int32), rng, jnp.float32(temp))
+                    # Page the prefilled bucket into this slot's blocks.
+                    nb_prefill = bucket // bs
+                    self.pool = self._writer_fn(nb_prefill)(
+                        self.pool, jnp.asarray(blocks[:nb_prefill], np.int32),
+                        k_all, v_all)
+                    first = int(jax.block_until_ready(first))
+                self.phases.add_work("prefill", **roofline.prefill_work(
+                    self.cfg, bucket, 0, wbytes=self._wbytes))
             except BaseException:
                 self.allocator.free(blocks)  # don't leak pool blocks
                 raise
@@ -466,12 +482,18 @@ class ContinuousBatchingEngine:
                 w_need = int(max(self._pos[ix] for ix in active)) \
                     + self.steps_per_tick
                 wb = self._suffix_window(w_need) // self.paged.block_size
-                toks, self.pool = self._decode_step()(
-                    self.params, self.pool,
-                    jnp.asarray(self._tables[:, :wb]),
-                    jnp.asarray(self._pos), jnp.asarray(self._cur),
-                    jnp.asarray(self._temps), rng)
-                toks = np.asarray(jax.block_until_ready(toks))   # [T, B]
+                with self.phases.phase("decode"):
+                    toks, self.pool = self._decode_step()(
+                        self.params, self.pool,
+                        jnp.asarray(self._tables[:, :wb]),
+                        jnp.asarray(self._pos), jnp.asarray(self._cur),
+                        jnp.asarray(self._temps), rng)
+                    toks = np.asarray(jax.block_until_ready(toks))  # [T, B]
+                from ..utils import roofline
+                self.phases.add_work("decode", **roofline.decode_work(
+                    self.cfg, self.steps_per_tick,
+                    wb * self.paged.block_size, batch=len(active),
+                    wbytes=self._wbytes))
             except BaseException as exc:
                 # A dead tick must not become a dead scheduler: fail the
                 # in-flight requests and keep serving new ones.

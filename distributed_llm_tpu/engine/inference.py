@@ -174,6 +174,10 @@ class InferenceEngine:
         # the jax.profiler-adjacent view surfaced at GET /stats (§5.1/§5.5).
         from ..utils.telemetry import PhaseTimer
         self.phases = PhaseTimer()
+        # Roofline work accounting (utils/roofline.py): weight bytes one
+        # decode step streams, for MFU / HBM-utilization in the bench.
+        from ..utils import roofline
+        self._wbytes = roofline.weight_bytes(self.cfg, tier.quantize)
 
         # Session KV prefix reuse (engine/prefix_cache.py), both model
         # families (transformer/moe each export chunk_prefill).  Each
@@ -468,6 +472,8 @@ class InferenceEngine:
                 needed = max(needed, m + sb)
         cache_len = self._pick_cache_len(needed)
 
+        from ..utils import roofline
+        cb_s = self._suffix_buckets[-1] if self._suffix_buckets else bucket
         with self.phases.phase("prefill"):
             if reused is not None:
                 cache0, m, suffix, sb = reused
@@ -479,6 +485,10 @@ class InferenceEngine:
                 if sb is None:   # long new turn: chunk-stride from m
                     first, cache = self._long_prefill(
                         ids, cache_len, rng1, temp, cache=cache0, start0=m)
+                    chunks = -(-(n - m) // cb_s)
+                    pwork = roofline.prefill_work(
+                        self.cfg, m + chunks * cb_s, m,
+                        wbytes=chunks * self._wbytes)
                 else:
                     tokens = np.full((1, sb), self.tokenizer.pad_id, np.int32)
                     tokens[0, :len(suffix)] = suffix
@@ -487,15 +497,25 @@ class InferenceEngine:
                         self.params, cache0, jnp.asarray(tokens),
                         jnp.asarray([m], np.int32), jnp.asarray(true_len),
                         rng1, temp)
+                    # sb computed queries over the bucketed `window` span.
+                    pwork = roofline.prefill_work(self.cfg, window,
+                                                  window - sb,
+                                                  wbytes=self._wbytes)
             elif is_long:        # beyond the largest bucket: chunked stride
                 first, cache = self._long_prefill(ids, cache_len, rng1, temp)
+                chunks = -(-n // cb_s)
+                pwork = roofline.prefill_work(self.cfg, chunks * cb_s, 0,
+                                              wbytes=chunks * self._wbytes)
             else:
                 tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
                 tokens[0, :n] = ids
                 first, cache = self._prefill_fn(bucket, cache_len)(
                     self.params, jnp.asarray(tokens), jnp.asarray(true_len),
                     rng1, temp)
+                pwork = roofline.prefill_work(self.cfg, bucket, 0,
+                                              wbytes=self._wbytes)
             first = jax.block_until_ready(first)
+        self.phases.add_work("prefill", **pwork)
         ttft_ms = (time.perf_counter() - t0) * 1000.0
 
         # The decode cap must fit the sized cache (it always does when the
@@ -527,6 +547,10 @@ class InferenceEngine:
                 self.params, cache, first, jnp.asarray([n], np.int32), rng2,
                 temp, jnp.int32(budget))
             out = np.asarray(jax.block_until_ready(out))[0]
+        from ..utils import roofline
+        self.phases.add_work("decode", **roofline.decode_work(
+            self.cfg, max(0, int(steps) - 1), cache_len,
+            wbytes=self._wbytes))
         total_ms = (time.perf_counter() - t0) * 1000.0
 
         if self.prefix_cache is not None:
@@ -603,6 +627,10 @@ class InferenceEngine:
                             jnp.asarray([n + len(gen) - 1], np.int32),
                             sub, temp, jnp.int32(seg + 1))
                         out = np.asarray(jax.block_until_ready(out))[0]
+                    from ..utils import roofline
+                    self.phases.add_work("decode", **roofline.decode_work(
+                        self.cfg, max(0, int(steps) - 1), cache_len,
+                        wbytes=self._wbytes))
                     for tok in out[1:int(steps)].tolist():
                         gen.append(tok)
                         if tok in (eos, pad):

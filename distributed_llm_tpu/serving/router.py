@@ -39,8 +39,12 @@ logger = logging.getLogger(__name__)
 
 
 def default_cluster() -> ClusterConfig:
-    """Bench-sized tiers on an accelerator; tiny tiers on host CPU."""
-    return tiny_cluster() if jax.default_backend() == "cpu" else bench_cluster()
+    """Bench-sized tiers on an accelerator; tiny tiers on host CPU.
+    Either way the tiers serve published pretrained weights when
+    ``checkpoints/<preset>`` exists (training/pretrain.py)."""
+    from ..config import with_default_checkpoints
+    return with_default_checkpoints(
+        tiny_cluster() if jax.default_backend() == "cpu" else bench_cluster())
 
 
 class Router:

@@ -59,11 +59,16 @@ def profiler_trace(log_dir: str = "/tmp/dllm_tpu_trace"):
 
 
 class PhaseTimer:
-    """Accumulates wall-time per named phase across queries."""
+    """Accumulates wall-time per named phase across queries, plus the
+    roofline work (FLOPs / HBM bytes / tokens, utils/roofline.py) the
+    engines report for each device phase — so utilization = work / time
+    falls out of one snapshot."""
 
     def __init__(self):
         self.totals: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
+        self.work: Dict[str, Dict[str, float]] = defaultdict(
+            lambda: defaultdict(float))
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -74,12 +79,24 @@ class PhaseTimer:
             self.totals[name] += time.perf_counter() - t0
             self.counts[name] += 1
 
+    def add_work(self, name: str, **amounts: float) -> None:
+        """Accumulate work counters (flops, hbm_bytes, tokens) for a phase."""
+        acc = self.work[name]
+        for key, val in amounts.items():
+            acc[key] += float(val)
+
     def summary(self) -> Dict[str, Dict[str, float]]:
         return {name: {"total_s": round(self.totals[name], 4),
                        "count": self.counts[name],
                        "mean_ms": round(1000 * self.totals[name]
                                         / max(1, self.counts[name]), 3)}
                 for name in self.totals}
+
+    def work_summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-phase accumulated work joined with its measured seconds."""
+        return {name: {**{k: round(v, 2) for k, v in acc.items()},
+                       "seconds": round(self.totals.get(name, 0.0), 4)}
+                for name, acc in self.work.items() if acc}
 
 
 def engine_stats(engine) -> Dict[str, Any]:
@@ -92,6 +109,9 @@ def engine_stats(engine) -> Dict[str, Any]:
         return entry
     if getattr(engine, "phases", None) is not None:
         entry["phases"] = engine.phases.summary()
+        work = engine.phases.work_summary()
+        if work:
+            entry["work"] = work
     if getattr(engine, "prefix_cache", None) is not None:
         entry["prefix_cache"] = engine.prefix_cache.stats()
     if hasattr(engine, "acceptance_rate"):

@@ -1,0 +1,118 @@
+"""Roofline work accounting (utils/roofline.py) + its engine wiring.
+
+The reference never measures hardware utilization (Ollama hides the
+arithmetic, src/devices/nano_api.py:76); VERDICT r1 #2 made MFU/HBM-util
+a bench requirement.  These tests pin the formulas to hand-computed
+values on tiny configs and check both engines actually accumulate work.
+"""
+
+import jax
+import pytest
+
+from distributed_llm_tpu.config import MODEL_PRESETS, TierConfig, tiny_cluster
+from distributed_llm_tpu.utils import roofline
+
+
+CFG = MODEL_PRESETS["nano_test"]       # h=64, L=2, heads=4, kv=2, ffn=128
+
+
+def test_active_matmul_params_dense_hand_count():
+    h, f, l, v = 64, 128, 2, CFG.vocab_size
+    kv = 2 * (64 // 4)                  # kv_heads * head_dim = 32
+    attn = h * h + 2 * h * kv + h * h   # q + kv + o
+    expected = l * (attn + 3 * h * f) + v * h
+    assert roofline.active_matmul_params(CFG) == expected
+
+
+def test_moe_top2_flops_vs_full_weight_bytes():
+    moe = MODEL_PRESETS["moe_test"]     # 4 experts, same dims as nano_test
+    # FLOPs: top-2 experts active -> FFN term doubles vs dense.
+    dense_ffn = 2 * 3 * 64 * 128        # layers * 3hf
+    assert (roofline.active_matmul_params(moe)
+            - roofline.active_matmul_params(CFG)) == dense_ffn
+    # Bytes: dense-dispatch einsum streams ALL 4 experts.
+    delta = roofline.weight_bytes(moe) - roofline.weight_bytes(CFG)
+    assert delta == 2 * 3 * 64 * 128 * (4 - 1) * 2   # l*3hf*(E-1)*2B
+
+
+def test_weight_bytes_int8_halves_body_only():
+    bf16 = roofline.weight_bytes(CFG, "none")
+    i8 = roofline.weight_bytes(CFG, "int8")
+    emb = (CFG.vocab_size * 64 + (2 * 2 + 1) * 64) * 2   # stays bf16
+    assert i8 == (bf16 - emb) // 2 + emb
+
+
+def test_prefill_work_causal_quadratic():
+    w = roofline.prefill_work(CFG, 32, 0, wbytes=1000)
+    pm = roofline.active_matmul_params(CFG)
+    assert w["tokens"] == 32
+    assert w["flops"] == pytest.approx(2.0 * pm * 32
+                                       + 2.0 * 64 * 2 * 32 * 32)
+    assert w["hbm_bytes"] == 1000 + roofline.kv_bytes_per_pos(CFG) * 32
+    # A chunk starting at 16 does the quadratic difference, not the square.
+    w2 = roofline.prefill_work(CFG, 32, 16, wbytes=0)
+    assert w2["flops"] == pytest.approx(2.0 * pm * 16
+                                        + 2.0 * 64 * 2 * (32**2 - 16**2))
+
+
+def test_decode_work_scales_with_batch_and_ctx():
+    one = roofline.decode_work(CFG, steps=4, ctx=64, batch=1, wbytes=500)
+    two = roofline.decode_work(CFG, steps=4, ctx=64, batch=2, wbytes=500)
+    assert two["flops"] == pytest.approx(2 * one["flops"])
+    # Weights stream once per step regardless of batch — only KV doubles.
+    assert (two["hbm_bytes"] - one["hbm_bytes"]
+            == 4 * roofline.kv_bytes_per_pos(CFG) * 64)
+    assert one["tokens"] == 4 and two["tokens"] == 8
+
+
+def test_chip_peaks_cpu_none_tpu_v5e():
+    assert roofline.chip_peaks("cpu") is None
+    peaks = roofline.chip_peaks("tpu")
+    assert peaks["peak_flops"] == pytest.approx(197e12)
+    assert peaks["peak_hbm_bytes_per_s"] == pytest.approx(819e9)
+
+
+def test_utilization_math():
+    peaks = {"peak_flops": 100e12, "peak_hbm_bytes_per_s": 50e9, "chip": "x"}
+    u = roofline.utilization({"flops": 200e12, "hbm_bytes": 25e9}, 2.0, peaks)
+    assert u["mfu"] == pytest.approx(1.0)
+    assert u["hbm_util"] == pytest.approx(0.25)
+    # No peaks (CPU): achieved rates only, no utilization keys.
+    u2 = roofline.utilization({"flops": 200e12, "hbm_bytes": 25e9}, 2.0, None)
+    assert "mfu" not in u2 and u2["tflops_per_s"] > 0
+
+
+def test_inference_engine_accumulates_work():
+    from distributed_llm_tpu.engine.inference import InferenceEngine
+    eng = InferenceEngine(tiny_cluster().nano, seed=0)
+    eng.generate("hello roofline", max_new_tokens=4)
+    work = eng.phases.work_summary()
+    assert work["prefill"]["flops"] > 0
+    assert work["prefill"]["seconds"] > 0
+    assert work["decode"]["hbm_bytes"] > 0
+    assert work["decode"]["tokens"] >= 1
+
+
+def test_batching_engine_accumulates_work():
+    import dataclasses
+    from distributed_llm_tpu.engine.batching import ContinuousBatchingEngine
+    tier = dataclasses.replace(tiny_cluster().nano, decode_batch=2)
+    eng = ContinuousBatchingEngine(tier, seed=0)
+    try:
+        eng.generate("hello batched roofline", max_new_tokens=4)
+        work = eng.phases.work_summary()
+        assert work["prefill"]["flops"] > 0
+        assert work["decode"]["flops"] > 0
+    finally:
+        eng.stop()
+
+
+def test_engine_stats_exposes_work_and_zero_free():
+    from distributed_llm_tpu.engine.inference import InferenceEngine
+    from distributed_llm_tpu.utils.telemetry import engine_stats
+    eng = InferenceEngine(tiny_cluster().nano, seed=0)
+    eng.generate("stats", max_new_tokens=2)
+    entry = engine_stats(eng)
+    assert "work" in entry and "prefill" in entry["work"]
+    # tokenize/detokenize report no device work.
+    assert set(entry["work"]) <= {"prefill", "decode"}
