@@ -88,15 +88,17 @@ def concurrent_phase(cluster, n_requests: int = 12, n_sequential: int = 4,
 
 
 def run() -> dict:
-    import os
-
     # Attention path for the headline run.  All Pallas kernels (flash
     # prefill/chunk, paged + contiguous decode) compile and match XLA
     # numerically on this chip (v5e, 2026-07-30); A/B timing under load was
     # within noise — prefill slightly favors Pallas, small-batch decode
-    # slightly favors XLA.  Keep the GSPMD-safe XLA default for the
-    # recorded run; export DLLM_ATTENTION=pallas to A/B explicitly.
-    os.environ.setdefault("DLLM_ATTENTION", "xla")
+    # slightly favored XLA until the decode kernel grew its KV-length
+    # tiling.  The round-1 blanket DLLM_ATTENTION=xla pin is GONE:
+    # unsharded TPU engines opt into the Pallas family
+    # (engine/inference.py upgrade_attention_impl) and ops/attention.py
+    # demotes any (kind, length) the measured dispatch table
+    # (bench/ab_dispatch.json, from `ab_kernels micro --write-dispatch`)
+    # shows losing.  DLLM_ATTENTION remains the explicit A/B override.
 
     import jax
     from distributed_llm_tpu.bench.query_sets import query_sets
@@ -310,6 +312,17 @@ def _accelerator_healthy(timeout_s: int = 180) -> bool:
 
 if __name__ == "__main__":
     import sys
+    if not _accelerator_configured():
+        # JAX_PLATFORMS=cpu in the environment is NOT enough under this
+        # image's sitecustomize (the axon PJRT plugin registers at
+        # interpreter start and the env snapshot loses) — a bench meant
+        # for CPU would otherwise initialize the axon backend and block
+        # in the chip-claim retry loop.  Pin it in-process.
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
     if _accelerator_configured():
         # A wedged chip claim is often transient (a killed client's grant
         # expiring server-side): retry the probe a few times before

@@ -1,15 +1,22 @@
 """A/B the attention kernel implementations on the current backend.
 
-Usage::
+Two modes::
 
+    # End-to-end: full engine, TTFT + decode tok/s per DLLM_ATTENTION
     python -m distributed_llm_tpu.bench.ab_kernels [--tier nano|orin]
         [--prompt-tokens N] [--max-new N] [--repeat K]
 
-For each ``DLLM_ATTENTION`` setting (xla, pallas) this builds a fresh
-bench-tier engine, warms it, and measures steady-state TTFT (prefill) and
-decode tok/s over ``--repeat`` generations, printing one JSON line per
-impl plus a verdict.  This is the measurement behind bench.py's default
-attention pin — rerun it whenever the kernel set or jax version changes.
+    # Per-kernel micro A/B at serving shapes; optionally write the
+    # measured dispatch table ops/attention.py consults (VERDICT r1 #3 —
+    # per-shape dispatch instead of a blanket env pin)
+    python -m distributed_llm_tpu.bench.ab_kernels micro
+        [--tier nano|orin] [--repeat K] [--write-dispatch]
+
+``micro`` times each kernel kind (prefill / decode / chunk / paged_decode)
+directly — xla vs pallas, jitted, median of K — across the cache-length
+ladder and serving batch sizes, at worst-case positions (full-length
+frontier) so a pallas win is robust.  ``--write-dispatch`` publishes
+``bench/ab_dispatch.json``: per kind, per length, the faster impl.
 
 The engines are built sequentially in ONE process (the chip allows a
 single claimant); DLLM_ATTENTION is read at trace time, so each engine is
@@ -23,6 +30,124 @@ import json
 import os
 import statistics
 import time
+
+DISPATCH_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "ab_dispatch.json")
+
+
+def _time_fn(fn, args, repeat: int) -> float:
+    """Median wall ms of a jitted call (2 warmup calls compile + settle)."""
+    import jax
+    for _ in range(2):
+        jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append((time.perf_counter() - t0) * 1000.0)
+    return statistics.median(times)
+
+
+def micro_ab(tier_name: str = "orin", repeat: int = 20,
+             write_dispatch: bool = False) -> dict:
+    """Direct kernel A/B at serving shapes; returns (and optionally
+    publishes) the per-(kind, length) winner table."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..config import bench_cluster, tiny_cluster
+    from ..ops import attention as A
+    from ..ops import pallas_attention as PA
+
+    cluster = (tiny_cluster() if jax.default_backend() == "cpu"
+               else bench_cluster())
+    cfg = getattr(cluster, tier_name).model()
+    nq, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    lengths = sorted({c for c in (256, 1024) if c < cfg.max_seq_len}
+                     | {cfg.max_seq_len})
+    batches = (1, 4, 8)
+    key = jax.random.PRNGKey(0)
+    bf16 = jnp.bfloat16
+    results: dict = {"backend": jax.default_backend(), "model": cfg.name,
+                     "repeat": repeat, "cases": []}
+    wins: dict = {}
+
+    def record(kind, length, ms_xla, ms_pallas, detail):
+        case = {"kind": kind, "length": length, "xla_ms": round(ms_xla, 3),
+                "pallas_ms": round(ms_pallas, 3), **detail}
+        results["cases"].append(case)
+        print(json.dumps(case), flush=True)
+        slot = wins.setdefault(kind, {}).setdefault(str(length), [])
+        slot.append(ms_pallas <= ms_xla)
+
+    # prefill (one sequence per call, bucket-sized)
+    for s in lengths:
+        if s % 128:
+            continue
+        q = jax.random.normal(key, (1, s, nq, d), bf16)
+        k = jax.random.normal(key, (1, s, nkv, d), bf16)
+        v = jax.random.normal(key, (1, s, nkv, d), bf16)
+        record("prefill", s,
+               _time_fn(jax.jit(A.causal_attention), (q, k, v), repeat),
+               _time_fn(jax.jit(PA.flash_causal_attention), (q, k, v),
+                        repeat), {})
+
+    # decode + chunk + paged_decode across batch × cache length
+    for s in lengths:
+        for b in batches:
+            q = jax.random.normal(key, (b, nq, d), bf16)
+            kc = jax.random.normal(key, (b, s, nkv, d), bf16)
+            vc = jax.random.normal(key, (b, s, nkv, d), bf16)
+            pos = jnp.full((b,), s - 1, jnp.int32)     # worst-case frontier
+            record("decode", s,
+                   _time_fn(jax.jit(A.decode_attention), (q, kc, vc, pos),
+                            repeat),
+                   _time_fn(jax.jit(PA.flash_decode_attention),
+                            (q, kc, vc, pos), repeat), {"batch": b})
+
+        # chunk prefill: one 128-token suffix against the window
+        sc = min(128, s)
+        q = jax.random.normal(key, (1, sc, nq, d), bf16)
+        kc = jax.random.normal(key, (1, s, nkv, d), bf16)
+        vc = jax.random.normal(key, (1, s, nkv, d), bf16)
+        qpos = (jnp.arange(sc, dtype=jnp.int32) + (s - sc))[None]
+        record("chunk", s,
+               _time_fn(jax.jit(A.chunk_attention), (q, kc, vc, qpos),
+                        repeat),
+               _time_fn(jax.jit(PA.flash_chunk_attention), (q, kc, vc, qpos),
+                        repeat), {"chunk": sc})
+
+        # paged decode: pool sized for 8 slots of this length
+        bs = 64
+        for b in batches[1:]:
+            nb = b * (s // bs) + 1
+            kp = jax.random.normal(key, (nkv, nb, bs, d), bf16)
+            vp = jax.random.normal(key, (nkv, nb, bs, d), bf16)
+            tables = jnp.asarray(
+                np.arange(b * (s // bs), dtype=np.int32).reshape(b, s // bs))
+            pos = jnp.full((b,), s - 1, jnp.int32)
+            q = jax.random.normal(key, (b, nq, d), bf16)
+            record("paged_decode", s,
+                   _time_fn(jax.jit(A.paged_decode),
+                            (q, kp, vp, tables, pos), repeat),
+                   _time_fn(jax.jit(PA.paged_decode_attention),
+                            (q, kp, vp, tables, pos), repeat), {"batch": b})
+
+    # Dispatch decision: pallas must win (or tie) at EVERY tested batch of
+    # a (kind, length) to own it — robust beats optimal.
+    dispatch = {kind: {length: ("pallas" if all(v) else "xla")
+                       for length, v in per.items()}
+                for kind, per in wins.items()}
+    results["dispatch"] = dispatch
+    print(json.dumps({"dispatch": dispatch}), flush=True)
+    if write_dispatch:
+        with open(DISPATCH_PATH, "w") as f:
+            json.dump({"backend": results["backend"],
+                       "model": results["model"],
+                       "dispatch": dispatch}, f, indent=1)
+        print(f"# wrote {DISPATCH_PATH}", flush=True)
+    return results
 
 
 def measure(impl: str, tier_name: str, prompt_tokens: int, max_new: int,
@@ -73,10 +198,14 @@ def measure(impl: str, tier_name: str, prompt_tokens: int, max_new: int,
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("mode", nargs="?", default="engine",
+                    choices=("engine", "micro"))
     ap.add_argument("--tier", default="nano", choices=("nano", "orin"))
     ap.add_argument("--prompt-tokens", type=int, default=512)
     ap.add_argument("--max-new", type=int, default=64)
     ap.add_argument("--repeat", type=int, default=5)
+    ap.add_argument("--write-dispatch", action="store_true",
+                    help="micro mode: publish bench/ab_dispatch.json")
     ap.add_argument("--platform", default=None,
                     help="pin jax_platforms (e.g. cpu) — the env var alone "
                          "is snapshotted too early under this image's "
@@ -86,6 +215,11 @@ def main(argv=None) -> None:
     if args.platform:
         import jax
         jax.config.update("jax_platforms", args.platform)
+
+    if args.mode == "micro":
+        micro_ab(args.tier, repeat=max(args.repeat, 10),
+                 write_dispatch=args.write_dispatch)
+        return
 
     results = {}
     prior = os.environ.get("DLLM_ATTENTION")

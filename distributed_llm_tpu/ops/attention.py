@@ -11,12 +11,50 @@ head_dim); queries are [B, S, N_q, D] with N_q a multiple of N_kv (GQA).
 
 from __future__ import annotations
 
+import json
 import os
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+# Measured per-kernel dispatch table, written by
+# ``python -m distributed_llm_tpu.bench.ab_kernels micro --write-dispatch``
+# on real hardware: {"decode": {"default": "pallas", "2048": "xla"}, ...}.
+# Consulted only when an engine opted into the Pallas family ('pallas'
+# resolved, no DLLM_ATTENTION override): a kernel kind/length the A/B
+# showed losing is demoted back to XLA per shape, instead of the round-1
+# blanket env pin.
+_DISPATCH_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "..", "bench", "ab_dispatch.json")
+_DISPATCH_TABLE: Optional[dict] = None
+
+
+def _measured_impl(kind: str, length: Optional[int]) -> Optional[str]:
+    global _DISPATCH_TABLE
+    if _DISPATCH_TABLE is None:
+        try:
+            with open(_DISPATCH_PATH) as f:
+                _DISPATCH_TABLE = json.load(f).get("dispatch", {})
+        except (OSError, ValueError):
+            _DISPATCH_TABLE = {}
+    entry = _DISPATCH_TABLE.get(kind)
+    if isinstance(entry, str):
+        return entry
+    if isinstance(entry, dict):
+        return entry.get(str(length), entry.get("default"))
+    return None
+
+
+def _choose(impl: str, kind: str, length: Optional[int]) -> str:
+    resolved = resolve_impl(impl)
+    if resolved == "pallas" and os.environ.get("DLLM_ATTENTION") is None:
+        measured = _measured_impl(kind, length)
+        if measured in ("xla", "pallas"):
+            return measured
+    return resolved
 
 
 def resolve_impl(impl: str = "auto") -> str:
@@ -47,7 +85,7 @@ def resolve_impl(impl: str = "auto") -> str:
 def causal(q: jax.Array, k: jax.Array, v: jax.Array,
            impl: str = "auto") -> jax.Array:
     """Dispatching causal attention (prefill)."""
-    if resolve_impl(impl) == "pallas":
+    if _choose(impl, "prefill", q.shape[1]) == "pallas":
         from .pallas_attention import flash_causal_attention
         return flash_causal_attention(q, k, v)
     return causal_attention(q, k, v)
@@ -56,7 +94,7 @@ def causal(q: jax.Array, k: jax.Array, v: jax.Array,
 def decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
            pos: jax.Array, impl: str = "auto") -> jax.Array:
     """Dispatching single-step decode attention."""
-    if resolve_impl(impl) == "pallas":
+    if _choose(impl, "decode", k_cache.shape[1]) == "pallas":
         from .pallas_attention import flash_decode_attention
         return flash_decode_attention(q, k_cache, v_cache, pos)
     return decode_attention(q, k_cache, v_cache, pos)
@@ -68,7 +106,7 @@ def chunk(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     window).  The Pallas path keeps cold prefill and prefix-reuse hits on
     the same kernel family on TPU (flash recurrence, per-query frontier);
     the XLA path is the portable/shardable fallback."""
-    if resolve_impl(impl) == "pallas":
+    if _choose(impl, "chunk", k_cache.shape[1]) == "pallas":
         from .pallas_attention import flash_chunk_attention
         return flash_chunk_attention(q, k_cache, v_cache, q_positions)
     return chunk_attention(q, k_cache, v_cache, q_positions)
@@ -82,7 +120,8 @@ def paged_decode(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
     [B, MB], pos [B] -> [B, Nq, D].  The Pallas path walks the block table
     in-kernel; the XLA path gathers the table into a contiguous view and
     reuses ``decode_attention`` (portable / GSPMD-shardable fallback)."""
-    if resolve_impl(impl) == "pallas":
+    if _choose(impl, "paged_decode",
+               tables.shape[1] * k_pool.shape[2]) == "pallas":
         from .pallas_attention import paged_decode_attention
         return paged_decode_attention(q, k_pool, v_pool, tables, pos)
     b, mb = tables.shape
@@ -104,7 +143,7 @@ def paged_chunk(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
     flash_chunk_attention); the XLA path gathers the window and masks by
     ``q_pos`` (portable / GSPMD-shardable fallback)."""
     nkv, bs, d = k_pool.shape[0], k_pool.shape[2], k_pool.shape[3]
-    if resolve_impl(impl) == "pallas":
+    if _choose(impl, "paged_chunk", window) == "pallas":
         from .pallas_attention import paged_chunk_attention
         return paged_chunk_attention(q, k_pool, v_pool, table, start, window)
     wb = window // bs

@@ -448,55 +448,97 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
 # Decode: masked ("ragged") single-token attention over the KV cache
 # =============================================================================
 
-def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float):
-    # pos_ref holds the WHOLE [B, 1] array in SMEM (a (1,1) block would
-    # violate Mosaic's block-shape rule for B>1); scalar-load our row.
-    p = pos_ref[pl.program_id(0), 0]
-    q = q_ref[0, 0].astype(jnp.float32) * scale               # [G, D]
-    k = k_ref[0, 0]                                           # [S, D]
-    v = v_ref[0, 0]
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                   l_ref, *, bk: int, scale: float):
+    """Tiled flash recurrence over the KV length (grid B × Nkv × S/bk, the
+    KV-block index j innermost).  Each slot's iterations past its own
+    length frontier are index-map-clamped onto the frontier block (the
+    repeated index elides the DMA) and compute-skipped — so a sequence at
+    position p streams ceil((p+1)/bk) blocks, not S_max.  This is the
+    round-1 fix for the untiled kernel that loaded the whole [S_max, D]
+    slice per program and lost to XLA at B=8/S=2048 (BENCHMARKS.md r1)."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
 
-    s = jnp.dot(q, k.T.astype(jnp.float32),
-                preferred_element_type=jnp.float32)           # [G, S]
-    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    s = jnp.where(col <= p, s, NEG_INF)                       # ragged mask
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    m = jnp.max(s, axis=-1, keepdims=True)
-    e = jnp.exp(s - m)
-    probs = e / jnp.sum(e, axis=-1, keepdims=True)
-    o_ref[0, 0] = jnp.dot(probs.astype(v.dtype), v,
-                          preferred_element_type=jnp.float32).astype(o_ref.dtype)
+    @pl.when(j * bk <= pos_ref[b])
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32) * scale           # [G, D]
+        k = k_ref[0, 0]                                       # [bk, D]
+        v = v_ref[0, 0]
+
+        s = jnp.dot(q, k.T.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)       # [G, bk]
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bk
+        s = jnp.where(col <= pos_ref[b], s, NEG_INF)          # ragged mask
+
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+
+    @pl.when(j == nb - 1)
+    def _done():
+        o_ref[0, 0] = (acc_ref[...] /
+                       jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
 
 
 def flash_decode_attention(q: jax.Array, k_cache: jax.Array,
                            v_cache: jax.Array, pos: jax.Array) -> jax.Array:
     """Drop-in for ops.attention.decode_attention (q [B,Nq,D],
-    caches [B,S_max,Nkv,D], pos [B] -> [B,Nq,D])."""
+    caches [B,S_max,Nkv,D], pos [B] -> [B,Nq,D]) with a KV-length-tiled
+    flash recurrence: HBM traffic scales with each sequence's OWN length
+    (frontier-clamped block streaming), unlike the XLA path, which reads
+    the whole allocated cache every step."""
     b, nq, d = q.shape
     s_max, nkv = k_cache.shape[1], k_cache.shape[2]
     groups = nq // nkv
+    # 256-wide KV tiles amortize grid/DMA overhead while staying tiny in
+    # VMEM (256·D·2B ≈ 64 KiB at D=128); cache-length ladder rungs
+    # (256/1024/max_seq, engine/inference.py) are all multiples.
+    bk = next((t for t in (256, 128) if s_max % t == 0), s_max)
 
     qh = q.reshape(b, nkv, groups, d)                        # group-major
     kh = k_cache.transpose(0, 2, 1, 3)                       # [B, Nkv, S, D]
     vh = v_cache.transpose(0, 2, 1, 3)
-    pos32 = pos.astype(jnp.int32).reshape(b, 1)              # 2D for SMEM
+    pos32 = pos.astype(jnp.int32)
 
-    kernel = functools.partial(_decode_kernel, scale=d ** -0.5)
+    kernel = functools.partial(_decode_kernel, bk=bk, scale=d ** -0.5)
+
+    def kv_index(b_, h, j, p):
+        # Clamp past-frontier iterations onto the frontier block: the
+        # repeated index skips the DMA, pl.when skips the compute.
+        return (b_, h, jnp.minimum(j, p[b_] // bk), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, nkv, s_max // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, groups, d), lambda b_, h, j, p: (b_, h, 0, 0)),
+            pl.BlockSpec((1, 1, bk, d), kv_index),
+            pl.BlockSpec((1, 1, bk, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, groups, d),
+                               lambda b_, h, j, p: (b_, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((groups, d), jnp.float32),
+            pltpu.VMEM((groups, 1), jnp.float32),
+            pltpu.VMEM((groups, 1), jnp.float32),
+        ],
+    )
     out = pl.pallas_call(
         kernel,
-        grid=(b, nkv),
-        in_specs=[
-            pl.BlockSpec((b, 1), lambda b_, h: (0, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, groups, d), lambda b_, h: (b_, h, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, s_max, d), lambda b_, h: (b_, h, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, s_max, d), lambda b_, h: (b_, h, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, 1, groups, d), lambda b_, h: (b_, h, 0, 0),
-                               memory_space=pltpu.VMEM),
+        grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
         interpret=_interpret(),
     )(pos32, qh, kh, vh)

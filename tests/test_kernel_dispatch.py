@@ -1,0 +1,65 @@
+"""Measured per-(kind, length) kernel dispatch (VERDICT r1 #3).
+
+ops/attention.py's dispatching wrappers consult bench/ab_dispatch.json —
+written by `ab_kernels micro --write-dispatch` on real hardware — instead
+of the round-1 blanket DLLM_ATTENTION=xla pin.  These tests pin the
+override precedence and exercise the micro harness end-to-end on CPU.
+"""
+
+import json
+
+import pytest
+
+from distributed_llm_tpu.ops import attention as A
+
+
+@pytest.fixture
+def table(monkeypatch):
+    def set_table(t):
+        monkeypatch.setattr(A, "_DISPATCH_TABLE", t)
+    monkeypatch.delenv("DLLM_ATTENTION", raising=False)
+    return set_table
+
+
+def test_measured_table_demotes_per_length(table):
+    table({"decode": {"default": "xla", "256": "pallas"}})
+    assert A._choose("pallas", "decode", 512) == "xla"
+    assert A._choose("pallas", "decode", 256) == "pallas"
+    # Unknown kind: engine's choice stands.
+    assert A._choose("pallas", "paged_decode", 512) == "pallas"
+
+
+def test_env_override_beats_measured_table(table, monkeypatch):
+    table({"decode": {"default": "xla"}})
+    monkeypatch.setenv("DLLM_ATTENTION", "pallas")
+    assert A._choose("pallas", "decode", 512) == "pallas"
+    monkeypatch.setenv("DLLM_ATTENTION", "xla")
+    assert A._choose("pallas", "prefill", 512) == "xla"
+
+
+def test_auto_stays_xla_table_not_consulted(table):
+    # 'auto' (sharded/portable engines) never takes the Pallas family even
+    # if the table would prefer it — a pallas_call has no GSPMD rule.
+    table({"decode": {"default": "pallas"}})
+    assert A._choose("auto", "decode", 512) == "xla"
+
+
+def test_string_entry_and_missing_file(table):
+    table({"prefill": "xla"})
+    assert A._choose("pallas", "prefill", 1024) == "xla"
+    table({})                        # no table: engine's choice stands
+    assert A._choose("pallas", "prefill", 1024) == "pallas"
+
+
+def test_micro_ab_writes_dispatch(tmp_path, monkeypatch):
+    from distributed_llm_tpu.bench import ab_kernels
+    out = tmp_path / "ab_dispatch.json"
+    monkeypatch.setattr(ab_kernels, "DISPATCH_PATH", str(out))
+    res = ab_kernels.micro_ab("nano", repeat=1, write_dispatch=True)
+    assert res["cases"], "no kernel cases measured"
+    kinds = {c["kind"] for c in res["cases"]}
+    assert {"prefill", "decode", "chunk", "paged_decode"} <= kinds
+    data = json.loads(out.read_text())
+    assert set(data["dispatch"]) == kinds
+    for per_len in data["dispatch"].values():
+        assert all(v in ("xla", "pallas") for v in per_len.values())
