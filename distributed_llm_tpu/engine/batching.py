@@ -114,6 +114,12 @@ class ContinuousBatchingEngine:
                                  max_slots=tier.decode_batch,
                                  max_seq_len=self.cfg.max_seq_len)
         self.steps_per_tick = max(1, tier.decode_steps_per_tick)
+        if params is None and tier.checkpoint_path:
+            # Published tier weights win over random init (mirrors
+            # InferenceEngine; EngineManager also pre-loads for its tiers).
+            from ..utils.checkpoint import load_params_for_tier
+            params = load_params_for_tier(tier.checkpoint_path, self.cfg,
+                                          mesh=mesh, devices=self.devices)
         if params is None:
             if mesh is not None:
                 from ..parallel.sharding import param_shardings

@@ -132,7 +132,18 @@ class InferenceEngine:
         self.devices = devices
 
         if params is None:
-            params = self._init_params(seed)
+            if tier.checkpoint_path:
+                # Serve the tier's published weights (the reference serves
+                # pretrained models, src/devices/nano_api.py:15-16); only
+                # checkpoint-less tiers fall back to deterministic random
+                # init.  EngineManager pre-loads and passes params in; this
+                # covers direct engine construction.
+                from ..utils.checkpoint import load_params_for_tier
+                params = load_params_for_tier(
+                    tier.checkpoint_path, self.cfg, mesh=mesh,
+                    devices=self.devices)
+            else:
+                params = self._init_params(seed)
         from ..ops.quant import maybe_quantize
         self.params = maybe_quantize(params, tier, self.cfg, mesh=mesh)
 

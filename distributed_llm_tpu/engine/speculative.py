@@ -118,12 +118,19 @@ class SpeculativeEngine:
         self.tokenizer = ByteTokenizer()
         self._max_seq = min(self.cfg_t.max_seq_len, self.cfg_d.max_seq_len)
 
-        def init(cfg, params, salt):
+        def init(cfg, tier, params, salt):
             if params is not None:
                 return params
+            if tier.checkpoint_path:
+                # Published tier weights win over random init (same rule
+                # as InferenceEngine/ContinuousBatchingEngine) — drafting
+                # against a trained target with a random draft would pin
+                # acceptance near zero.
+                from ..utils.checkpoint import load_params_for_tier
+                return load_params_for_tier(tier.checkpoint_path, cfg)
             return jax.jit(lambda: transformer.init_params(cfg, seed + salt))()
-        self.params_t = init(self.cfg_t, target_params, 0)
-        self.params_d = init(self.cfg_d, draft_params, 1)
+        self.params_t = init(self.cfg_t, target, target_params, 0)
+        self.params_d = init(self.cfg_d, draft, draft_params, 1)
         # The target tier's quantize mode applies to both models (the draft
         # gains the most: it runs gamma small decode steps per target step).
         self.params_t = quant.maybe_quantize(self.params_t, target, self.cfg_t)
