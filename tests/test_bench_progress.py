@@ -1,0 +1,69 @@
+"""Wedge-resilient bench progress/partials (VERDICT r1 #1 hardening).
+
+The tunneled chip can wedge mid-run; bench.py checkpoints every finished
+section to BENCH_partial.json and a watchdog emits the partial as the
+headline JSON line when device progress stalls.  These tests pin that
+machinery without any device work.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402  (repo-root module)
+
+
+def test_sections_checkpoint_atomically(tmp_path):
+    path = tmp_path / "partial.json"
+    p = bench.Progress(str(path))
+    p.section("backend", "tpu")
+    p.section("per_strategy", {"token": {"req_per_s": 1.0}})
+    data = json.loads(path.read_text())
+    assert data == {"backend": "tpu",
+                    "per_strategy": {"token": {"req_per_s": 1.0}}}
+    # Overwrites keep the latest value.
+    p.section("backend", "cpu")
+    assert json.loads(path.read_text())["backend"] == "cpu"
+
+
+def test_beat_resets_idle_clock(tmp_path):
+    p = bench.Progress(str(tmp_path / "x.json"))
+    time.sleep(0.05)
+    assert p.idle_s() >= 0.05
+    p.beat()
+    assert p.idle_s() < 0.05
+
+
+def test_watchdog_leaves_live_run_alone(tmp_path):
+    p = bench.Progress(str(tmp_path / "x.json"))
+    t = bench.start_watchdog(p, timeout_s=3600.0)
+    assert t.daemon                      # must not block interpreter exit
+    time.sleep(0.2)
+    p.done.set()
+    # Run completed; if the watchdog had fired it would have os._exit'd.
+    assert True
+
+
+def test_watchdog_emits_partial_on_stall(tmp_path):
+    """The stall path os._exit(3)s after printing the partial headline —
+    exercised in a subprocess."""
+    import subprocess
+    code = f"""
+import sys, time
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+import bench
+p = bench.Progress({str(tmp_path / 'p.json')!r})
+p.section("backend", "tpu")
+p.section("value", 9.9)
+p._beat -= 100                       # simulate 100s without device progress
+bench.start_watchdog(p, timeout_s=1.0)
+time.sleep(30)                       # watchdog must fire long before this
+"""
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=25)
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-500:])
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["backend"] == "tpu" and line["value"] == 9.9
+    assert "aborted" in line and "wedged" in line["aborted"]
