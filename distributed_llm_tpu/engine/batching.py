@@ -192,16 +192,20 @@ class ContinuousBatchingEngine:
 
     def _prefill_fn(self, bucket: int):
         """Per bucket: forward the padded prompt, return the first sampled
-        token and the per-layer K/V to page into the pool."""
+        token and the per-layer K/V to page into the pool.  TP meshes take
+        the shard-mapped flash prefill where Pallas is preferred
+        (parallel/tp_attention.py), same policy as the sequential engine."""
         if bucket in self._prefill_fns:
             return self._prefill_fns[bucket]
         cfg = self.cfg
+        from ..parallel.tp_attention import tp_prefill_attn
+        attn = tp_prefill_attn(self.mesh, cfg, bucket)
 
         def run(params, tokens, true_len, rng, temp):
             b, s = tokens.shape
             positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
             hidden, (k_all, v_all) = models.serving_prefill(
-                cfg, params, tokens, positions)
+                cfg, params, tokens, positions, attn=attn)
             last = hidden[jnp.arange(b), true_len - 1]
             logits = transformer.logits_from_hidden(params, last)
             first = _sample_batched(logits, rng, temp[None])[0]

@@ -224,18 +224,23 @@ class InferenceEngine:
                                                             self._max_seq))
 
     def _sp_attn(self, bucket: int):
-        """Ring-attention override for sequence-parallel prefill, when the
-        tier mesh has an 'sp' axis that divides this bucket (dense models
-        only — models.serving_prefill ignores the hook for MoE)."""
+        """Prefill attention override for mesh tiers: ring attention when
+        the mesh has an 'sp' axis dividing this bucket (dense only —
+        models.serving_prefill ignores the hook for MoE); otherwise the
+        shard-mapped flash kernel on tp-only meshes where Pallas is the
+        preferred prefill impl (parallel/tp_attention.py — round 1 left
+        sharded tiers entirely on XLA)."""
         mesh = self.mesh
-        if (mesh is None or self.cfg.num_experts > 1
-                or "sp" not in mesh.shape or mesh.shape["sp"] <= 1
-                or bucket % mesh.shape["sp"]):
+        if mesh is None or self.cfg.num_experts > 1:
             return None
-        from ..parallel.ring_attention import ring_attention
-        head_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
-        return lambda q, k, v: ring_attention(q, k, v, mesh, "sp",
-                                              head_axis=head_axis)
+        if ("sp" in mesh.shape and mesh.shape["sp"] > 1
+                and bucket % mesh.shape["sp"] == 0):
+            from ..parallel.ring_attention import ring_attention
+            head_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
+            return lambda q, k, v: ring_attention(q, k, v, mesh, "sp",
+                                                  head_axis=head_axis)
+        from ..parallel.tp_attention import tp_prefill_attn
+        return tp_prefill_attn(mesh, self.cfg, bucket)
 
     def _prefill_fn(self, bucket: int, cache_len: int):
         """Jitted per (prompt bucket, cache length): embed+forward the
