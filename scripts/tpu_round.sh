@@ -1,0 +1,38 @@
+#!/bin/bash
+# Full TPU measurement sequence for a freshly healthy chip (round 2).
+# Run exactly ONE instance; every step is a separate sequential claimant.
+# Never kill these processes mid-run — a killed claimant wedges the chip.
+cd /root/repo
+log=/tmp/tpu_round.log
+{
+  echo "=== tpu_round start $(date -u) ==="
+
+  # 1. Bench-tier pretrained checkpoints (VERDICT r1 #4 at bench scale).
+  #    Minutes on a v5e; --save-every leaves a resumable 'latest' if the
+  #    chip dies mid-run.  Local-only artifacts (gitignored by size).
+  if [ ! -L checkpoints/nano_bench/latest ]; then
+    python -m distributed_llm_tpu.training.pretrain --preset nano_bench \
+      --out checkpoints/nano_bench --batch-size 16 --seq-len 256 \
+      --max-steps 800 --save-every 100 \
+      || echo "nano_bench pretrain FAILED — bench will serve random init"
+  fi
+  if [ ! -L checkpoints/orin_bench/latest ]; then
+    python -m distributed_llm_tpu.training.pretrain --preset orin_bench \
+      --out checkpoints/orin_bench --batch-size 4 --seq-len 256 \
+      --max-steps 500 --save-every 100 \
+      || echo "orin_bench pretrain FAILED (HBM?) — continuing without it"
+  fi
+
+  # 2. Per-kernel micro A/B on quiet hardware; publish the dispatch table
+  #    (VERDICT r1 #3).
+  python -m distributed_llm_tpu.bench.ab_kernels micro --tier orin \
+    --repeat 20 --write-dispatch > /tmp/ab_micro_tpu.json 2>&1 \
+    || echo "micro A/B failed"
+
+  # 3. Headline TPU bench (VERDICT r1 #1): partials checkpoint to
+  #    BENCH_partial.json; watchdog aborts with partials on a wedge.
+  python bench.py > /tmp/BENCH_tpu.json 2> /tmp/bench_tpu.log \
+    || echo "bench exited nonzero ($?)"
+
+  echo "=== tpu_round done $(date -u) ==="
+} >> "$log" 2>&1
