@@ -144,6 +144,35 @@ def concurrent_phase(cluster, n_requests: int = 12, n_sequential: int = 4,
     finally:
         engine.stop()
 
+    # int8 KV pool A/B on the same load (engine/paged_kv.py): halves the
+    # decode loop's KV read traffic; the measured ratio decides whether
+    # the default flips.
+    try:
+        q8 = ContinuousBatchingEngine(
+            dataclasses.replace(tier, kv_quantize="int8"), seed=1)
+        try:
+            q8.warmup()
+            # Match the bf16 engine's state: its sequential pass already
+            # compiled the real query bucket before its timed region.
+            for q in queries[:2]:
+                q8.generate(q)
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=q8.generate, args=(q,))
+                       for q in queries]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            kv_int8_rate = n_requests / (time.perf_counter() - t0)
+        finally:
+            q8.stop()
+        kv_quant = {
+            "concurrent_req_per_s": round(kv_int8_rate, 3),
+            "speedup_vs_bf16_kv": round(kv_int8_rate / concurrent_rate, 2),
+        }
+    except Exception as exc:
+        kv_quant = {"error": str(exc)[:200]}
+
     return {
         "concurrent_req_per_s": round(concurrent_rate, 3),
         "sequential_req_per_s": round(sequential_rate, 3),
@@ -151,6 +180,7 @@ def concurrent_phase(cluster, n_requests: int = 12, n_sequential: int = 4,
         "slots": slots,
         "requests": n_requests,
         "utilization": utilization,
+        "kv_int8": kv_quant,
     }
 
 

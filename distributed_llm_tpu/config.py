@@ -215,6 +215,11 @@ class TierConfig:
     # unsharded tiers only (sharding rules and the trainer see
     # full-precision leaf paths).
     quantize: str = "none"
+    # KV-cache quantization for the batched engine's paged pool ("none" |
+    # "int8", engine/paged_kv.py): halves decode's KV read traffic — the
+    # term that overtakes weights at long context × batch.  Symmetric
+    # per-row scales; writes quantize, the attention gather dequantizes.
+    kv_quantize: str = "none"
     # Cross-host tier: base URL of a tpu_api server on another host
     # (serving/remote.py — the DCN twin of the reference's SSH-tunneled
     # device endpoints, src/models/nano.py:4-8).  When set, no local

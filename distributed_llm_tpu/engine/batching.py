@@ -132,7 +132,7 @@ class ContinuousBatchingEngine:
             params = init(seed=seed)
         from ..ops.quant import maybe_quantize
         self.params = maybe_quantize(params, tier, self.cfg, mesh=mesh)
-        self.pool = init_pool(self.cfg, self.paged)
+        self.pool = init_pool(self.cfg, self.paged, tier.kv_quantize)
         self._pool_shardings = None
         self._replicated = None
         if mesh is not None:
@@ -144,7 +144,8 @@ class ContinuousBatchingEngine:
             # left unconstrained, XLA may replicate the output pool, which
             # silently multiplies KV memory by the mesh size.
             from ..parallel.sharding import kv_pool_shardings, replicated
-            self._pool_shardings = kv_pool_shardings(mesh)
+            self._pool_shardings = kv_pool_shardings(
+                mesh, quantized=(tier.kv_quantize == "int8"))
             self._replicated = replicated(mesh)
             self.pool = jax.device_put(self.pool, self._pool_shardings)
         self.allocator = BlockAllocator(self.paged.num_blocks)
@@ -503,7 +504,8 @@ class ContinuousBatchingEngine:
                 self.phases.add_work("decode", **roofline.decode_work(
                     self.cfg, self.steps_per_tick,
                     wb * self.paged.block_size, batch=len(active),
-                    wbytes=self._wbytes))
+                    wbytes=self._wbytes,
+                    kv_quantize=self.tier.kv_quantize))
             except BaseException as exc:
                 # A dead tick must not become a dead scheduler: fail the
                 # in-flight requests and keep serving new ones.

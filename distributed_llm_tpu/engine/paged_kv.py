@@ -41,8 +41,23 @@ from ..models import transformer
 from ..ops import attention, quant
 
 KVPool = Dict[str, jax.Array]    # {"k","v": [L, N_kv, NB, bs, D]}
+# int8 pools add {"ks","vs": [L, N_kv, NB, bs]} per-row dequant scales.
 
 TRASH_BLOCK = 0
+
+
+def quantize_kv_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-row int8: scale over the trailing D axis.  Returns
+    (int8 values, float32 scales with the D axis dropped)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.round(x.astype(jnp.float32) / scale[..., None])
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+def dequantize_kv_rows(q: jax.Array, scale: jax.Array,
+                       dtype) -> jax.Array:
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,9 +76,23 @@ class PagedConfig:
         return self.max_slots * self.blocks_per_slot + 1
 
 
-def init_pool(cfg: ModelConfig, pcfg: PagedConfig) -> KVPool:
+def init_pool(cfg: ModelConfig, pcfg: PagedConfig,
+              kv_quantize: str = "none") -> KVPool:
+    """``kv_quantize="int8"`` stores cached K/V as symmetric per-row int8
+    with float32 scales — decode's KV read traffic halves (decode is
+    bandwidth-bound; the KV term dominates the weight term at long
+    context × batch).  Writes quantize, reads dequantize at the attention
+    op (ops/attention.py paged paths)."""
     shape = (cfg.num_layers, cfg.num_kv_heads, pcfg.num_blocks,
              pcfg.block_size, cfg.head_dim)
+    if kv_quantize == "int8":
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "ks": jnp.ones(shape[:-1], jnp.float32),
+                "vs": jnp.ones(shape[:-1], jnp.float32)}
+    if kv_quantize != "none":
+        raise ValueError(f"kv_quantize={kv_quantize!r}: expected 'none' "
+                         "or 'int8'")
     dtype = jnp.dtype(cfg.dtype)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
@@ -105,6 +134,13 @@ def write_prefill_blocks(pool: KVPool, blocks: jax.Array,
     # [L, S, N_kv, D] -> [L, N_kv, nb, bs, D] (head-major pool tiles).
     k_blk = k_all.reshape(l, nb, bs, nkv, d).transpose(0, 3, 1, 2, 4)
     v_blk = v_all.reshape(l, nb, bs, nkv, d).transpose(0, 3, 1, 2, 4)
+    if "ks" in pool:                       # int8 pool: quantize on write
+        k_blk, k_sc = quantize_kv_rows(k_blk)
+        v_blk, v_sc = quantize_kv_rows(v_blk)
+        return {"k": pool["k"].at[:, :, blocks].set(k_blk),
+                "v": pool["v"].at[:, :, blocks].set(v_blk),
+                "ks": pool["ks"].at[:, :, blocks].set(k_sc),
+                "vs": pool["vs"].at[:, :, blocks].set(v_sc)}
     return {"k": pool["k"].at[:, :, blocks].set(k_blk),
             "v": pool["v"].at[:, :, blocks].set(v_blk)}
 
@@ -141,8 +177,14 @@ def chunk_prefill_paged(
     blk = table[flat_pos // bs]                              # [S_c]
     off = flat_pos % bs
 
+    quantized = "ks" in pool
+
     def layer(x, scanned):
-        lp, k_pool, v_pool = scanned
+        if quantized:
+            lp, k_pool, v_pool, ks_pool, vs_pool = scanned
+        else:
+            lp, k_pool, v_pool = scanned
+            ks_pool = vs_pool = None
         h_in = transformer.rms_norm(x, lp["ln1"], cfg.norm_eps)
         q = quant.matmul(h_in, lp["wq"]).reshape(b, s_c, cfg.num_heads, d)
         k = quant.matmul(h_in, lp["wk"]).reshape(b, s_c, cfg.num_kv_heads, d)
@@ -153,10 +195,18 @@ def chunk_prefill_paged(
         # Scatter the chunk's K/V to its (head, block, offset) cells, then
         # attend the table window (Pallas: in-kernel block walk; XLA:
         # gather-then-attend).
-        k_pool = k_pool.at[:, blk, off].set(jnp.swapaxes(k[0], 0, 1))
-        v_pool = v_pool.at[:, blk, off].set(jnp.swapaxes(v[0], 0, 1))
+        k_rows = jnp.swapaxes(k[0], 0, 1)              # [nkv, S_c, d]
+        v_rows = jnp.swapaxes(v[0], 0, 1)
+        if quantized:
+            k_rows, k_sc = quantize_kv_rows(k_rows)
+            v_rows, v_sc = quantize_kv_rows(v_rows)
+            ks_pool = ks_pool.at[:, blk, off].set(k_sc)
+            vs_pool = vs_pool.at[:, blk, off].set(v_sc)
+        k_pool = k_pool.at[:, blk, off].set(k_rows)
+        v_pool = v_pool.at[:, blk, off].set(v_rows)
         attn = attention.paged_chunk(q, k_pool, v_pool, table, start, q_pos,
-                                     window, impl=cfg.attention_impl)
+                                     window, impl=cfg.attention_impl,
+                                     k_scale=ks_pool, v_scale=vs_pool)
         x = x + quant.matmul(attn.reshape(b, s_c, cfg.num_heads * d),
                              lp["wo"])
         h_ffn = transformer.rms_norm(x, lp["ln2"], cfg.norm_eps)
@@ -167,12 +217,21 @@ def chunk_prefill_paged(
         else:
             x = x + transformer._swiglu(h_ffn, lp["w_gate"], lp["w_up"],
                                         lp["w_down"])
+        if quantized:
+            return x, (k_pool, v_pool, ks_pool, vs_pool)
         return x, (k_pool, v_pool)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer, x, (params["layers"], pool["k"], pool["v"]))
+    if quantized:
+        x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            layer, x, (params["layers"], pool["k"], pool["v"],
+                       pool["ks"], pool["vs"]))
+        new_pool = {"k": k_new, "v": v_new, "ks": ks_new, "vs": vs_new}
+    else:
+        x, (k_new, v_new) = jax.lax.scan(
+            layer, x, (params["layers"], pool["k"], pool["v"]))
+        new_pool = {"k": k_new, "v": v_new}
     hidden = transformer.rms_norm(x, params["final_ln"], cfg.norm_eps)
-    return hidden, {"k": k_new, "v": v_new}
+    return hidden, new_pool
 
 
 def decode_step_paged(
@@ -203,8 +262,14 @@ def decode_step_paged(
     off = pos % bs                                     # [B]
     batch_ix = jnp.arange(b)
 
+    quantized = "ks" in pool
+
     def layer(x, scanned):
-        lp, k_pool, v_pool = scanned                   # pools: [nkv, NB, bs, d]
+        if quantized:
+            lp, k_pool, v_pool, ks_pool, vs_pool = scanned
+        else:
+            lp, k_pool, v_pool = scanned               # pools: [nkv, NB, bs, d]
+            ks_pool = vs_pool = None
         h_in = transformer.rms_norm(x, lp["ln1"], cfg.norm_eps)
         q = quant.matmul(h_in, lp["wq"]).reshape(b, cfg.num_heads, d)
         k = quant.matmul(h_in, lp["wk"]).reshape(b, cfg.num_kv_heads, d)
@@ -214,14 +279,22 @@ def decode_step_paged(
 
         # Write-before-attend at (head, block, offset); batched scatter —
         # active slots hit distinct blocks, idle ones collide in trash.
-        k_pool = k_pool.at[:, blk, off].set(jnp.swapaxes(k, 0, 1))
-        v_pool = v_pool.at[:, blk, off].set(jnp.swapaxes(v, 0, 1))
+        k_rows = jnp.swapaxes(k, 0, 1)                 # [nkv, B, d]
+        v_rows = jnp.swapaxes(v, 0, 1)
+        if quantized:
+            k_rows, k_sc = quantize_kv_rows(k_rows)
+            v_rows, v_sc = quantize_kv_rows(v_rows)
+            ks_pool = ks_pool.at[:, blk, off].set(k_sc)
+            vs_pool = vs_pool.at[:, blk, off].set(v_sc)
+        k_pool = k_pool.at[:, blk, off].set(k_rows)
+        v_pool = v_pool.at[:, blk, off].set(v_rows)
 
         # Attend this slot's logical window: position p is
         # (table[p//bs], p%bs).  The Pallas path streams table blocks
         # through VMEM in-kernel; the XLA path gathers them contiguous.
         attn = attention.paged_decode(q, k_pool, v_pool, tables, pos,
-                                      impl=cfg.attention_impl)
+                                      impl=cfg.attention_impl,
+                                      k_scale=ks_pool, v_scale=vs_pool)
 
         x = x + quant.matmul(attn.reshape(b, cfg.num_heads * d), lp["wo"])
         h_ffn = transformer.rms_norm(x, lp["ln2"], cfg.norm_eps)
@@ -231,10 +304,18 @@ def decode_step_paged(
         else:
             x = x + transformer._swiglu(h_ffn, lp["w_gate"], lp["w_up"],
                                         lp["w_down"])
+        if quantized:
+            return x, (k_pool, v_pool, ks_pool, vs_pool)
         return x, (k_pool, v_pool)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer, x, (params["layers"], pool["k"], pool["v"]))
+    if quantized:
+        x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            layer, x, (params["layers"], pool["k"], pool["v"],
+                       pool["ks"], pool["vs"]))
+        new_pool = {"k": k_new, "v": v_new, "ks": ks_new, "vs": vs_new}
+    else:
+        x, (k_new, v_new) = jax.lax.scan(
+            layer, x, (params["layers"], pool["k"], pool["v"]))
+        new_pool = {"k": k_new, "v": v_new}
     hidden = transformer.rms_norm(x, params["final_ln"], cfg.norm_eps)
-    return transformer.logits_from_hidden(params, hidden), \
-        {"k": k_new, "v": v_new}
+    return transformer.logits_from_hidden(params, hidden), new_pool

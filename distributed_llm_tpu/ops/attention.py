@@ -114,36 +114,49 @@ def chunk(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
 def paged_decode(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
                  tables: jax.Array, pos: jax.Array,
-                 impl: str = "auto") -> jax.Array:
+                 impl: str = "auto", k_scale: jax.Array = None,
+                 v_scale: jax.Array = None) -> jax.Array:
     """Dispatching batched decode attention over a paged KV pool
     (engine/paged_kv.py): q [B, Nq, D], pools [Nkv, NB, bs, D], tables
     [B, MB], pos [B] -> [B, Nq, D].  The Pallas path walks the block table
     in-kernel; the XLA path gathers the table into a contiguous view and
-    reuses ``decode_attention`` (portable / GSPMD-shardable fallback)."""
-    if _choose(impl, "paged_decode",
-               tables.shape[1] * k_pool.shape[2]) == "pallas":
-        from .pallas_attention import paged_decode_attention
-        return paged_decode_attention(q, k_pool, v_pool, tables, pos)
+    reuses ``decode_attention`` (portable / GSPMD-shardable fallback).
+
+    ``k_scale``/``v_scale`` ([Nkv, NB, bs]) mark an int8 pool: the gather
+    reads HALF the bytes and dequantizes per row after.  int8 pools take
+    the XLA path unconditionally for now — the Pallas kernel's int8+scale
+    block streaming is unmeasured on hardware."""
     b, mb = tables.shape
     nkv, bs, d = k_pool.shape[0], k_pool.shape[2], k_pool.shape[3]
+    if (k_scale is None
+            and _choose(impl, "paged_decode", mb * bs) == "pallas"):
+        from .pallas_attention import paged_decode_attention
+        return paged_decode_attention(q, k_pool, v_pool, tables, pos)
     # [Nkv, B, MB, bs, D] -> [B, S, Nkv, D]
     k_seq = k_pool[:, tables].reshape(nkv, b, mb * bs, d).transpose(1, 2, 0, 3)
     v_seq = v_pool[:, tables].reshape(nkv, b, mb * bs, d).transpose(1, 2, 0, 3)
+    if k_scale is not None:
+        k_sc = k_scale[:, tables].reshape(nkv, b, mb * bs).transpose(1, 2, 0)
+        v_sc = v_scale[:, tables].reshape(nkv, b, mb * bs).transpose(1, 2, 0)
+        k_seq = (k_seq.astype(jnp.float32) * k_sc[..., None]).astype(q.dtype)
+        v_seq = (v_seq.astype(jnp.float32) * v_sc[..., None]).astype(q.dtype)
     return decode_attention(q, k_seq, v_seq, pos)
 
 
 def paged_chunk(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
                 table: jax.Array, start: jax.Array, q_pos: jax.Array,
-                window: int, impl: str = "auto") -> jax.Array:
+                window: int, impl: str = "auto", k_scale: jax.Array = None,
+                v_scale: jax.Array = None) -> jax.Array:
     """Dispatching suffix-chunk attention over a paged KV pool
     (engine/paged_kv.chunk_prefill_paged): q [1, S_c, Nq, D], pools
     [Nkv, NB, bs, D], table [MB], start [1], q_pos [1, S_c] clamped
     absolute positions, static ``window``.  The Pallas path reconstructs
     positions from ``start`` (contiguous-chunk contract, like
     flash_chunk_attention); the XLA path gathers the window and masks by
-    ``q_pos`` (portable / GSPMD-shardable fallback)."""
+    ``q_pos`` (portable / GSPMD-shardable fallback).  ``k_scale``/
+    ``v_scale`` mark an int8 pool (XLA dequant path, see paged_decode)."""
     nkv, bs, d = k_pool.shape[0], k_pool.shape[2], k_pool.shape[3]
-    if _choose(impl, "paged_chunk", window) == "pallas":
+    if k_scale is None and _choose(impl, "paged_chunk", window) == "pallas":
         from .pallas_attention import paged_chunk_attention
         return paged_chunk_attention(q, k_pool, v_pool, table, start, window)
     wb = window // bs
@@ -151,6 +164,13 @@ def paged_chunk(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
         k_pool[:, table[:wb]].reshape(nkv, window, d), 0, 1)[None]
     v_seq = jnp.swapaxes(
         v_pool[:, table[:wb]].reshape(nkv, window, d), 0, 1)[None]
+    if k_scale is not None:
+        k_sc = jnp.swapaxes(
+            k_scale[:, table[:wb]].reshape(nkv, window), 0, 1)[None]
+        v_sc = jnp.swapaxes(
+            v_scale[:, table[:wb]].reshape(nkv, window), 0, 1)[None]
+        k_seq = (k_seq.astype(jnp.float32) * k_sc[..., None]).astype(q.dtype)
+        v_seq = (v_seq.astype(jnp.float32) * v_sc[..., None]).astype(q.dtype)
     return chunk_attention(q, k_seq, v_seq, q_pos)
 
 

@@ -150,17 +150,25 @@ def kv_cache_specs(tp_axis: str = "tp") -> Dict[str, P]:
             "v": P(None, None, None, tp_axis, None)}
 
 
-def kv_pool_specs(tp_axis: str = "tp") -> Dict[str, P]:
+def kv_pool_specs(tp_axis: str = "tp",
+                  quantized: bool = False) -> Dict[str, P]:
     """Paged KV pool [L, N_kv, NB, bs, D] (engine/paged_kv.py head-major
     layout): shard the kv-head axis over tp, like the contiguous cache —
     each shard owns its heads' blocks, and the decode step's scatter/gather
-    batch over the head axis without resharding."""
-    return {"k": P(None, tp_axis, None, None, None),
+    batch over the head axis without resharding.  int8 pools carry per-row
+    scale planes [L, N_kv, NB, bs], head-sharded the same way."""
+    spec = {"k": P(None, tp_axis, None, None, None),
             "v": P(None, tp_axis, None, None, None)}
+    if quantized:
+        spec["ks"] = P(None, tp_axis, None, None)
+        spec["vs"] = P(None, tp_axis, None, None)
+    return spec
 
 
-def kv_pool_shardings(mesh: Mesh, tp_axis: str = "tp") -> Dict[str, NamedSharding]:
-    return {k: NamedSharding(mesh, s) for k, s in kv_pool_specs(tp_axis).items()}
+def kv_pool_shardings(mesh: Mesh, tp_axis: str = "tp",
+                      quantized: bool = False) -> Dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, s)
+            for k, s in kv_pool_specs(tp_axis, quantized).items()}
 
 
 def kv_cache_shardings(mesh: Mesh, tp_axis: str = "tp") -> Dict[str, NamedSharding]:

@@ -74,9 +74,13 @@ def weight_bytes(cfg, quantize: str = "none") -> int:
     return body + (cfg.vocab_size * h + (2 * l + 1) * h) * 2
 
 
-def kv_bytes_per_pos(cfg) -> int:
-    """K+V bytes per cached position (bf16 cache)."""
-    return 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim * 2
+def kv_bytes_per_pos(cfg, kv_quantize: str = "none") -> int:
+    """K+V bytes per cached position: bf16, or int8 + f32 per-row scales
+    (engine/paged_kv.py)."""
+    rows = 2 * cfg.num_layers * cfg.num_kv_heads
+    if kv_quantize == "int8":
+        return rows * (cfg.head_dim + 4)
+    return rows * cfg.head_dim * 2
 
 
 def prefill_work(cfg, end: int, start: int = 0,
@@ -98,7 +102,8 @@ def prefill_work(cfg, end: int, start: int = 0,
 
 
 def decode_work(cfg, steps: int, ctx: int, batch: int = 1,
-                wbytes: Optional[int] = None) -> Dict[str, float]:
+                wbytes: Optional[int] = None,
+                kv_quantize: str = "none") -> Dict[str, float]:
     """Work for ``steps`` sequential decode steps of a ``batch`` of
     sequences whose kernels each span ``ctx`` cached positions (the
     ALLOCATED span the kernel computes over, masked or not)."""
@@ -107,7 +112,8 @@ def decode_work(cfg, steps: int, ctx: int, batch: int = 1,
     flops = float(steps) * batch * (2.0 * pm + 4.0 * h * l * ctx)
     if wbytes is None:
         wbytes = weight_bytes(cfg)
-    hbm = float(steps) * (wbytes + batch * kv_bytes_per_pos(cfg) * ctx)
+    hbm = float(steps) * (wbytes + batch
+                          * kv_bytes_per_pos(cfg, kv_quantize) * ctx)
     return {"flops": flops, "hbm_bytes": hbm, "tokens": steps * batch}
 
 
