@@ -134,6 +134,20 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
                    _time_fn(jax.jit(PA.paged_decode_attention),
                             (q, kp, vp, tables, pos), repeat), {"batch": b})
 
+            # int8 pool variant: XLA half-byte gather+dequant vs the
+            # in-VMEM dequant kernel.
+            from ..engine.paged_kv import quantize_kv_rows
+            kq, ksc = quantize_kv_rows(kp)
+            vq, vsc = quantize_kv_rows(vp)
+            record("paged_decode_q8", s,
+                   _time_fn(jax.jit(lambda *a: A.paged_decode(
+                       a[0], a[1], a[2], a[5], a[6], impl="xla",
+                       k_scale=a[3], v_scale=a[4])),
+                       (q, kq, vq, ksc, vsc, tables, pos), repeat),
+                   _time_fn(jax.jit(PA.paged_decode_attention_q8),
+                            (q, kq, vq, ksc, vsc, tables, pos), repeat),
+                   {"batch": b})
+
     # Dispatch decision: pallas must win (or tie) at EVERY tested batch of
     # a (kind, length) to own it — robust beats optimal.
     dispatch = {kind: {length: ("pallas" if all(v) else "xla")

@@ -122,16 +122,20 @@ def paged_decode(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
     in-kernel; the XLA path gathers the table into a contiguous view and
     reuses ``decode_attention`` (portable / GSPMD-shardable fallback).
 
-    ``k_scale``/``v_scale`` ([Nkv, NB, bs]) mark an int8 pool: the gather
-    reads HALF the bytes and dequantizes per row after.  int8 pools take
-    the XLA path unconditionally for now — the Pallas kernel's int8+scale
-    block streaming is unmeasured on hardware."""
+    ``k_scale``/``v_scale`` ([Nkv, NB, bs]) mark an int8 pool: the Pallas
+    path streams int8 blocks + scales and dequantizes in VMEM
+    (paged_decode_attention_q8, its own dispatch kind); the XLA path
+    gathers HALF the bytes and dequantizes after."""
     b, mb = tables.shape
     nkv, bs, d = k_pool.shape[0], k_pool.shape[2], k_pool.shape[3]
-    if (k_scale is None
-            and _choose(impl, "paged_decode", mb * bs) == "pallas"):
-        from .pallas_attention import paged_decode_attention
-        return paged_decode_attention(q, k_pool, v_pool, tables, pos)
+    if k_scale is None:
+        if _choose(impl, "paged_decode", mb * bs) == "pallas":
+            from .pallas_attention import paged_decode_attention
+            return paged_decode_attention(q, k_pool, v_pool, tables, pos)
+    elif _choose(impl, "paged_decode_q8", mb * bs) == "pallas":
+        from .pallas_attention import paged_decode_attention_q8
+        return paged_decode_attention_q8(q, k_pool, v_pool, k_scale,
+                                         v_scale, tables, pos)
     # [Nkv, B, MB, bs, D] -> [B, S, Nkv, D]
     k_seq = k_pool[:, tables].reshape(nkv, b, mb * bs, d).transpose(1, 2, 0, 3)
     v_seq = v_pool[:, tables].reshape(nkv, b, mb * bs, d).transpose(1, 2, 0, 3)

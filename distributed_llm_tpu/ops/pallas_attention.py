@@ -444,6 +444,103 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
     return out.reshape(b, nq, d)
 
 
+def _paged_decode_kernel_q8(tables_ref, pos_ref, q_ref, k_ref, v_ref,
+                            ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref,
+                            *, bs: int, scale: float):
+    """int8 twin of _paged_decode_kernel: pool blocks arrive as int8
+    [bs, D] tiles plus per-row f32 scales [bs, 1]; dequantization happens
+    in VMEM after the half-width DMA — the HBM read is what shrinks."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * bs <= pos_ref[b])
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32) * scale          # [G, D]
+        k = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]   # [bs, D]
+        v = v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0]
+
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bs
+        s = jnp.where(col <= pos_ref[b], s, NEG_INF)         # ragged mask
+
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(j == nb - 1)
+    def _done():
+        o_ref[0, 0] = (acc_ref[...] /
+                       jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_decode_attention_q8(q: jax.Array, k_pool: jax.Array,
+                              v_pool: jax.Array, k_scale: jax.Array,
+                              v_scale: jax.Array, tables: jax.Array,
+                              pos: jax.Array) -> jax.Array:
+    """``paged_decode_attention`` over an int8 pool (engine/paged_kv.py
+    kv_quantize='int8'): pools [Nkv, NB, bs, D] int8, scales
+    [Nkv, NB, bs] f32.  Streams half the KV bytes of the bf16 kernel and
+    never materializes the dequantized window in HBM (the XLA fallback's
+    gather does)."""
+    b, nq, d = q.shape
+    nkv, bs = k_pool.shape[0], k_pool.shape[2]
+    mb = tables.shape[1]
+    groups = nq // nkv
+
+    qh = q.reshape(b, nkv, groups, d)                        # group-major
+    tables32 = tables.astype(jnp.int32)
+    pos32 = pos.astype(jnp.int32)
+    # Scales as [Nkv, NB, bs, 1]: the trailing singleton keeps Mosaic on
+    # its (sublane, lane) tiling for the tiny per-row plane.
+    ks = k_scale[..., None].astype(jnp.float32)
+    vs = v_scale[..., None].astype(jnp.float32)
+
+    kernel = functools.partial(_paged_decode_kernel_q8, bs=bs,
+                               scale=d ** -0.5)
+
+    def kv_index(b_, h, j, tbl, p):
+        return (h, tbl[b_, jnp.minimum(j, p[b_] // bs)], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nkv, mb),
+        in_specs=[
+            pl.BlockSpec((1, 1, groups, d),
+                         lambda b_, h, j, tbl, p: (b_, h, 0, 0)),
+            pl.BlockSpec((1, 1, bs, d), kv_index),
+            pl.BlockSpec((1, 1, bs, d), kv_index),
+            pl.BlockSpec((1, 1, bs, 1), kv_index),
+            pl.BlockSpec((1, 1, bs, 1), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, groups, d),
+                               lambda b_, h, j, tbl, p: (b_, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((groups, d), jnp.float32),
+            pltpu.VMEM((groups, 1), jnp.float32),
+            pltpu.VMEM((groups, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        interpret=_interpret(),
+    )(tables32, pos32, qh, k_pool, v_pool, ks, vs)
+    return out.reshape(b, nq, d)
+
+
 # =============================================================================
 # Decode: masked ("ragged") single-token attention over the KV cache
 # =============================================================================
