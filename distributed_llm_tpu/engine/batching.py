@@ -229,12 +229,23 @@ class ContinuousBatchingEngine:
         cfg = self.cfg
         max_pos = cfg.max_seq_len - 1
         steps = self.steps_per_tick
+        mesh = self.mesh
+        quantized = self.tier.kv_quantize == "int8"
 
         def run(params, pool, tables, pos, cur, temps, rng):
+            # TP tiers: per-head-shard paged flash decode (the window
+            # width is static per trace, so the hook resolves here).
+            attn = None
+            if cfg.num_experts == 1:
+                from ..parallel.tp_attention import tp_paged_decode_attn
+                attn = tp_paged_decode_attn(
+                    mesh, cfg, tables.shape[1] * self.paged.block_size,
+                    quantized=quantized)
+
             def step(carry, _):
                 pool, pos, cur, rng = carry
                 logits, pool = decode_step_paged(cfg, params, cur, pos, pool,
-                                                 tables)
+                                                 tables, attn=attn)
                 rng, sub = jax.random.split(rng)
                 nxt = _sample_batched(logits, sub, temps)
                 # Clamp: finished/overshooting slots keep writing into

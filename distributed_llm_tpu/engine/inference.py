@@ -386,6 +386,14 @@ class InferenceEngine:
         eos = self.tokenizer.eos_id
         pad = self.tokenizer.pad_id
         max_new = self.tier.max_new_tokens   # static cap: sizes the buffer
+        # TP tiers: per-head-shard flash decode (frontier-clamped KV
+        # streaming) instead of the GSPMD XLA path; dense models only.
+        decode_kw = {}
+        if cfg.num_experts == 1:
+            from ..parallel.tp_attention import tp_decode_attn
+            hook = tp_decode_attn(self.mesh, cfg, cache_len)
+            if hook is not None:
+                decode_kw["attn"] = hook
 
         def run(params, cache, first_token, prompt_len, rng, temperature,
                 token_budget):
@@ -406,7 +414,7 @@ class InferenceEngine:
                 cur = out[:, step - 1]
                 pos = prompt_len + step - 1       # position of `cur`
                 logits, cache = models.model_module(cfg).decode_step(
-                    cfg, params, cur, pos, cache)
+                    cfg, params, cur, pos, cache, **decode_kw)
                 rng, sub = jax.random.split(rng)
                 nxt = sample_token_dynamic(logits, sub, temperature)
                 nxt = jnp.where(done, pad, nxt)

@@ -241,6 +241,7 @@ def decode_step_paged(
     pos: jax.Array,            # [B] its position (0-based)
     pool: KVPool,
     tables: jax.Array,         # [B, MB] block ids per slot
+    attn=None,                 # (q, kp, vp, tables, pos, ks, vs) override
 ) -> Tuple[jax.Array, KVPool]:
     """One batched autoregressive step over paged caches.
 
@@ -263,6 +264,10 @@ def decode_step_paged(
     batch_ix = jnp.arange(b)
 
     quantized = "ks" in pool
+    if attn is None:
+        attn = lambda q, kp, vp, tbl, p, ks, vs: attention.paged_decode(
+            q, kp, vp, tbl, p, impl=cfg.attention_impl,
+            k_scale=ks, v_scale=vs)
 
     def layer(x, scanned):
         if quantized:
@@ -292,11 +297,10 @@ def decode_step_paged(
         # Attend this slot's logical window: position p is
         # (table[p//bs], p%bs).  The Pallas path streams table blocks
         # through VMEM in-kernel; the XLA path gathers them contiguous.
-        attn = attention.paged_decode(q, k_pool, v_pool, tables, pos,
-                                      impl=cfg.attention_impl,
-                                      k_scale=ks_pool, v_scale=vs_pool)
+        attn_out = attn(q, k_pool, v_pool, tables, pos, ks_pool, vs_pool)
 
-        x = x + quant.matmul(attn.reshape(b, cfg.num_heads * d), lp["wo"])
+        x = x + quant.matmul(attn_out.reshape(b, cfg.num_heads * d),
+                             lp["wo"])
         h_ffn = transformer.rms_norm(x, lp["ln2"], cfg.norm_eps)
         if cfg.num_experts > 1:
             from ..models.moe import moe_ffn_decode

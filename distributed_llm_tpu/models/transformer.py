@@ -149,18 +149,25 @@ def logits_from_hidden(params: Params, hidden: jax.Array) -> jax.Array:
 # =============================================================================
 
 def decode_step(cfg: ModelConfig, params: Params, token: jax.Array,
-                pos: jax.Array, kv: KVCache
+                pos: jax.Array, kv: KVCache, attn=None
                 ) -> Tuple[jax.Array, KVCache]:
     """One autoregressive step for every sequence in the batch.
 
     token: [B] current input token; pos: [B] its position (0-based);
     kv: cache with [L,B,S_max,N_kv,D] arrays, written in-place at ``pos``.
+    ``attn`` optionally replaces the decode-attention op
+    (q, k_cache, v_cache, pos) -> [B,Nq,D] — the hook tensor-parallel
+    tiers use to run the flash decode kernel per head-shard
+    (parallel/tp_attention.py).
     Returns (logits [B,V] float32, updated cache).
     """
     b = token.shape[0]
     d = cfg.head_dim
     x = quant.embed_rows(params["embed"], token)      # [B,H]
     sin, cos = rope_sincos(pos, d, cfg.rope_theta)    # [B, D/2]
+    if attn is None:
+        attn = lambda q, kc, vc, p: attention.decode(
+            q, kc, vc, p, impl=cfg.attention_impl)
 
     def layer(x, scanned):
         lp, k_cache, v_cache = scanned
@@ -179,9 +186,9 @@ def decode_step(cfg: ModelConfig, params: Params, token: jax.Array,
         k_cache = write(k_cache, k)
         v_cache = write(v_cache, v)
 
-        attn = attention.decode(q, k_cache, v_cache, pos,
-                                impl=cfg.attention_impl)
-        x = x + quant.matmul(attn.reshape(b, cfg.num_heads * d), lp["wo"])
+        attn_out = attn(q, k_cache, v_cache, pos)
+        x = x + quant.matmul(attn_out.reshape(b, cfg.num_heads * d),
+                             lp["wo"])
         x = x + _swiglu(rms_norm(x, lp["ln2"], cfg.norm_eps),
                         lp["w_gate"], lp["w_up"], lp["w_down"])
         return x, (k_cache, v_cache)

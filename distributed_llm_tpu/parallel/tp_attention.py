@@ -41,6 +41,93 @@ def tp_flash_causal(mesh: jax.sharding.Mesh,
                      check_vma=False)
 
 
+def tp_flash_decode(mesh: jax.sharding.Mesh,
+                    head_axis: str = "tp") -> Callable:
+    """(q [B,Nq,D], k/v [B,S,Nkv,D], pos [B]) -> [B,Nq,D], head-sharded:
+    the KV-length-tiled flash decode kernel runs per head-shard — each
+    chip streams only its own heads' frontier-clamped cache slice."""
+    from jax import shard_map
+
+    from ..ops.pallas_attention import flash_decode_attention
+
+    qspec = P(None, head_axis, None)
+    cspec = P(None, None, head_axis, None)
+    return shard_map(flash_decode_attention, mesh=mesh,
+                     in_specs=(qspec, cspec, cspec, P(None)),
+                     out_specs=qspec, check_vma=False)
+
+
+def tp_paged_decode(mesh: jax.sharding.Mesh, quantized: bool = False,
+                    head_axis: str = "tp") -> Callable:
+    """Paged-pool twin: pools [Nkv, NB, bs, D] (+ scale planes when
+    ``quantized``) shard on the kv-head axis — exactly the batched
+    engine's pool sharding (parallel/sharding.py kv_pool_specs) — so the
+    in-kernel block walk is shard-local.  Signature matches the
+    decode_step_paged attention hook: (q, k_pool, v_pool, tables, pos,
+    k_scale, v_scale)."""
+    from jax import shard_map
+
+    from ..ops.pallas_attention import (paged_decode_attention,
+                                        paged_decode_attention_q8)
+
+    qspec = P(None, head_axis, None)
+    pspec = P(head_axis, None, None, None)
+    if quantized:
+        sspec = P(head_axis, None, None)
+        fn = shard_map(
+            lambda q, kp, vp, ks, vs, tbl, pos: paged_decode_attention_q8(
+                q, kp, vp, ks, vs, tbl, pos),
+            mesh=mesh,
+            in_specs=(qspec, pspec, pspec, sspec, sspec, P(None), P(None)),
+            out_specs=qspec, check_vma=False)
+        return lambda q, kp, vp, tbl, pos, ks, vs: fn(q, kp, vp, ks, vs,
+                                                      tbl, pos)
+    fn = shard_map(paged_decode_attention, mesh=mesh,
+                   in_specs=(qspec, pspec, pspec, P(None), P(None)),
+                   out_specs=qspec, check_vma=False)
+    return lambda q, kp, vp, tbl, pos, ks, vs: fn(q, kp, vp, tbl, pos)
+
+
+def _tp_policy(mesh: Optional[jax.sharding.Mesh], cfg, kind: str,
+               length: int) -> bool:
+    """Shared gate for every shard-mapped Pallas hook: tp-only mesh,
+    dense model, divisible heads, Pallas preferred for (kind, length)."""
+    if mesh is None or cfg.num_experts > 1:
+        return False
+    shape = dict(mesh.shape)
+    tp = shape.get("tp", 1)
+    if tp <= 1 or shape.get("sp", 1) > 1:
+        return False
+    if cfg.num_kv_heads % tp or cfg.num_heads % tp:
+        return False
+    env = os.environ.get("DLLM_ATTENTION")
+    if env == "xla":
+        return False
+    if env != "pallas" and jax.default_backend() != "tpu":
+        return False
+    from ..ops.attention import _choose
+    return _choose("pallas", kind, length) == "pallas"
+
+
+def tp_decode_attn(mesh: Optional[jax.sharding.Mesh], cfg,
+                   cache_len: int) -> Optional[Callable]:
+    """Decode hook for TP tiers with a contiguous cache, or None for the
+    GSPMD XLA path."""
+    if not _tp_policy(mesh, cfg, "decode", cache_len):
+        return None
+    return tp_flash_decode(mesh)
+
+
+def tp_paged_decode_attn(mesh: Optional[jax.sharding.Mesh], cfg,
+                         window: int,
+                         quantized: bool = False) -> Optional[Callable]:
+    """Decode hook for TP tiers over the paged pool, or None."""
+    kind = "paged_decode_q8" if quantized else "paged_decode"
+    if not _tp_policy(mesh, cfg, kind, window):
+        return None
+    return tp_paged_decode(mesh, quantized)
+
+
 def tp_prefill_attn(mesh: Optional[jax.sharding.Mesh], cfg,
                     bucket: int) -> Optional[Callable]:
     """Policy twin of engine upgrade_attention_impl for TP meshes: the
@@ -50,22 +137,8 @@ def tp_prefill_attn(mesh: Optional[jax.sharding.Mesh], cfg,
     the preferred prefill impl — TPU backend or an explicit
     DLLM_ATTENTION=pallas, minus dispatch-table demotions
     (ops/attention.py).  None = stay on the GSPMD XLA path."""
-    if mesh is None or cfg.num_experts > 1:
-        return None
-    shape = dict(mesh.shape)
-    tp = shape.get("tp", 1)
-    if tp <= 1 or shape.get("sp", 1) > 1:
-        return None
-    if cfg.num_kv_heads % tp or cfg.num_heads % tp:
-        return None
     if bucket % min(bucket, 128):
         return None                       # flash kernel block contract
-    env = os.environ.get("DLLM_ATTENTION")
-    if env == "xla":
+    if not _tp_policy(mesh, cfg, "prefill", bucket):
         return None
-    if env != "pallas" and jax.default_backend() != "tpu":
-        return None
-    from ..ops.attention import _choose
-    if _choose("pallas", "prefill", bucket) != "pallas":
-        return None                       # measured demotion for this shape
     return tp_flash_causal(mesh)
