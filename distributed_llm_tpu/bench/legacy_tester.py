@@ -116,7 +116,12 @@ def main(argv: Optional[List[str]] = None) -> None:
                    default=[100, 500, 1000, 2000, 4000])
     p.add_argument("--strategy", default="perf")
     p.add_argument("--output-csv", default="final_results.csv")
+    p.add_argument("--platform", default=None,
+                   help="pin jax_platforms (e.g. cpu); see bench/tester.py")
     args = p.parse_args(argv)
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
     tester = ChatbotTester(query_sets[args.query_set], args.thresholds,
                            strategy=args.strategy)
     tester.run(args.query_set, args.output_csv)

@@ -413,6 +413,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--output-per-query-csv", default="benchmark_per_query.csv")
     p.add_argument("--no-telemetry", action="store_true",
                    help="Disable the HBM telemetry sampler")
+    p.add_argument("--platform", default=None,
+                   help="pin jax_platforms (e.g. cpu) — the env var alone "
+                        "loses to this image's PJRT sitecustomize, and an "
+                        "unpinned run on a wedged chip blocks in the claim "
+                        "loop")
     # Accepted-and-ignored: the reference required SSH endpoints for its
     # Jetson power loggers; TPU tiers are in-process.
     for flag, default in (("--nano-ip", None), ("--orin-ip", None),
@@ -426,6 +431,9 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
 
 def main(argv: Optional[List[str]] = None) -> None:
     args = parse_args(argv)
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
     if args.query_set not in query_sets:
         raise ValueError(f"Unknown query set: {args.query_set}. "
                          f"Available: {list(query_sets)}")

@@ -1,0 +1,41 @@
+"""training/pretrain.py: plateau detection, mid-run checkpointing, and
+the published-artifact layout serving reads (VERDICT r1 #4 machinery)."""
+
+import jax
+import pytest
+
+from distributed_llm_tpu.training import pretrain as pt
+
+
+def test_pretrain_plateaus_and_publishes(tmp_path):
+    out = tmp_path / "ck"
+    res = pt.pretrain("nano_test", str(out), batch_size=4, seq_len=32,
+                      max_steps=60, eval_every=10, patience=2,
+                      min_delta=10.0,          # huge delta => early plateau
+                      log=lambda *_: None)
+    # Plateau must trigger well before max_steps with an unmeetable delta.
+    assert res["steps"] < 60
+    assert (out / "latest").is_symlink()
+    from distributed_llm_tpu.config import MODEL_PRESETS
+    from distributed_llm_tpu.utils.checkpoint import load_params_for_tier
+    params = load_params_for_tier(str(out), MODEL_PRESETS["nano_test"])
+    assert "embed" in params
+
+
+def test_pretrain_save_every_leaves_resumable_latest(tmp_path):
+    out = tmp_path / "ck"
+    pt.pretrain("nano_test", str(out), batch_size=4, seq_len=32,
+                max_steps=10, eval_every=50, save_every=5,
+                log=lambda *_: None)
+    # v5 (mid-run), v10 (final); prune keeps the newest two.
+    versions = sorted(d.name for d in out.iterdir() if d.name.startswith("v"))
+    assert versions == ["v10", "v5"], versions
+    # The artifact resumes into a Trainer (cross-run restore path).
+    import numpy as np
+    from distributed_llm_tpu.config import MODEL_PRESETS
+    from distributed_llm_tpu.training.trainer import TrainConfig, Trainer
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    tr = Trainer(MODEL_PRESETS["nano_test"],
+                 TrainConfig(batch_size=4, seq_len=32), mesh)
+    tr.load(str(out))
+    assert tr.step_count == 10
