@@ -139,6 +139,15 @@ class SpeculativeEngine:
         self._prefill_fns: Dict[int, Any] = {}
         self._spec_fn = None
         self.accept_history: list = []
+        # Phase wall-time + roofline work (GET /stats, bench MFU/HBM —
+        # same surface as the other engines).  Draft and target work both
+        # accumulate; the verify chunk is accounted as γ+1 decode queries
+        # over the full cache span.
+        from ..utils import roofline
+        from ..utils.telemetry import PhaseTimer
+        self.phases = PhaseTimer()
+        self._wbytes_t = roofline.weight_bytes(self.cfg_t, target.quantize)
+        self._wbytes_d = roofline.weight_bytes(self.cfg_d, target.quantize)
 
     # -- compiled stages ---------------------------------------------------
 
@@ -268,10 +277,16 @@ class SpeculativeEngine:
 
                 tokens = np.full((1, bucket), pad, np.int32)
                 tokens[0, :n] = ids
-                first, cache_t, cache_d = self._prefill_fn(bucket)(
-                    self.params_t, self.params_d, jnp.asarray(tokens),
-                    jnp.asarray([n], np.int32))
-                first = int(jax.block_until_ready(first)[0])
+                from ..utils import roofline
+                with self.phases.phase("prefill"):
+                    first, cache_t, cache_d = self._prefill_fn(bucket)(
+                        self.params_t, self.params_d, jnp.asarray(tokens),
+                        jnp.asarray([n], np.int32))
+                    first = int(jax.block_until_ready(first)[0])
+                self.phases.add_work("prefill", **roofline.prefill_work(
+                    self.cfg_t, bucket, 0, wbytes=self._wbytes_t))
+                self.phases.add_work("prefill", **roofline.prefill_work(
+                    self.cfg_d, bucket, 0, wbytes=self._wbytes_d))
                 ttft_ms = (time.perf_counter() - t0) * 1000.0
 
                 out_tokens = [first]
@@ -285,10 +300,17 @@ class SpeculativeEngine:
                 while (len(out_tokens) < budget
                        and out_tokens[-1] not in (eos, pad)
                        and int(pos[0]) + self.gamma + 1 < self._max_seq):
-                    out, n_acc, cur, pos, cache_t, cache_d = step(
-                        self.params_t, self.params_d, cache_t, cache_d, cur,
-                        pos)
-                    n_acc_i = int(n_acc[0])
+                    with self.phases.phase("decode"):
+                        out, n_acc, cur, pos, cache_t, cache_d = step(
+                            self.params_t, self.params_d, cache_t, cache_d,
+                            cur, pos)
+                        n_acc_i = int(n_acc[0])
+                    self.phases.add_work("decode", **roofline.decode_work(
+                        self.cfg_d, self.gamma + 1, self._max_seq,
+                        wbytes=self._wbytes_d))
+                    self.phases.add_work("decode", **roofline.decode_work(
+                        self.cfg_t, 1, self._max_seq, batch=self.gamma + 1,
+                        wbytes=self._wbytes_t))
                     self.accept_history.append(n_acc_i)
                     for tok in np.asarray(out)[0][:n_acc_i + 1].tolist():
                         tok = int(tok)
