@@ -254,12 +254,22 @@ def bench_cluster() -> ClusterConfig:
     int8 weight-only serving mirrors the reference deployment (Ollama runs
     GGML-quantized models on the Jetsons) and roughly halves decode's HBM
     weight traffic on the bandwidth-bound decode loop.
+
+    DLLM_BENCH_SPEC_ORIN=1 puts the nano model in front of the orin tier
+    as a speculative draft (greedy-exact): at the measured ~0.5
+    acceptance, the weight-bound orin decode does ~1 full weight pass per
+    ~3 tokens instead of per token.  A/B'd by scripts/tpu_round.sh before
+    any default flip.
     """
+    import os
+    draft = ("nano_bench"
+             if os.environ.get("DLLM_BENCH_SPEC_ORIN") == "1" else None)
     return ClusterConfig(
         nano=TierConfig(name="nano", model_preset="nano_bench", tp=1,
                         max_new_tokens=64, quantize="int8"),
         orin=TierConfig(name="orin", model_preset="orin_bench", tp=1,
-                        max_new_tokens=128, quantize="int8"),
+                        max_new_tokens=128, quantize="int8",
+                        draft_preset=draft),
     )
 
 

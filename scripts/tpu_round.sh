@@ -34,5 +34,10 @@ log=/tmp/tpu_round.log
   python bench.py > /tmp/BENCH_tpu.json 2> /tmp/bench_tpu.log \
     || echo "bench exited nonzero ($?)"
 
+  # 4. Speculative-orin headline A/B (draft = nano model, greedy-exact):
+  #    decides whether the spec default flips next round.
+  DLLM_BENCH_SPEC_ORIN=1 python bench.py > /tmp/BENCH_tpu_spec.json \
+    2> /tmp/bench_tpu_spec.log || echo "spec bench exited nonzero ($?)"
+
   echo "=== tpu_round done $(date -u) ==="
 } >> "$log" 2>&1
