@@ -253,10 +253,15 @@ def features_phase(cluster, n_prompts: int = 3, max_new: int = 48) -> dict:
                 dataclasses.replace(base, quantize="none"), seed=5))
             i8 = decode_tokps(InferenceEngine(
                 dataclasses.replace(base, quantize="int8"), seed=5))
+            i8kv = decode_tokps(InferenceEngine(
+                dataclasses.replace(base, quantize="int8",
+                                    kv_quantize="int8"), seed=5))
             quant[tier_name] = {
                 "bf16_decode_tok_per_s": bf16,
                 "int8_decode_tok_per_s": i8,
+                "int8_weights_and_kv_decode_tok_per_s": i8kv,
                 "speedup": round(i8 / max(bf16, 1e-9), 2),
+                "kv_int8_speedup": round(i8kv / max(i8, 1e-9), 2),
             }
         except Exception as exc:
             quant[tier_name] = {"error": str(exc)[:200]}

@@ -215,10 +215,12 @@ class TierConfig:
     # unsharded tiers only (sharding rules and the trainer see
     # full-precision leaf paths).
     quantize: str = "none"
-    # KV-cache quantization for the batched engine's paged pool ("none" |
-    # "int8", engine/paged_kv.py): halves decode's KV read traffic — the
-    # term that overtakes weights at long context × batch.  Symmetric
-    # per-row scales; writes quantize, the attention gather dequantizes.
+    # KV-cache quantization ("none" | "int8"): halves decode's KV read
+    # traffic — the term that overtakes weights at long context × batch.
+    # Symmetric per-row int8 with f32 scales; writes quantize, attention
+    # reads dequantize.  Applies to the batched engine's paged pool
+    # (engine/paged_kv.py) AND the sequential engine's contiguous cache
+    # (models/transformer.py); dense family only (MoE keeps bf16).
     kv_quantize: str = "none"
     # Cross-host tier: base URL of a tpu_api server on another host
     # (serving/remote.py — the DCN twin of the reference's SSH-tunneled

@@ -24,6 +24,7 @@ feeding the perf routing strategy and the req/s + p50 TTFT headline metric
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Union
@@ -36,6 +37,8 @@ from ..config import TierConfig
 from .. import models
 from ..models import transformer
 from ..ops.sampling import sample_token_dynamic
+
+logger = logging.getLogger(__name__)
 from .tokenizer import ByteTokenizer
 
 
@@ -189,6 +192,14 @@ class InferenceEngine:
         # decode step streams, for MFU / HBM-utilization in the bench.
         from ..utils import roofline
         self._wbytes = roofline.weight_bytes(self.cfg, tier.quantize)
+        # int8 contiguous KV cache (models/transformer.py seed/decode/
+        # chunk paths).  Dense only: the MoE family keeps a bf16 cache.
+        self._kv_quantize = tier.kv_quantize
+        if self._kv_quantize != "none" and self.cfg.num_experts > 1:
+            logger.warning("tier %s: kv_quantize=%s ignored for the MoE "
+                           "family (bf16 cache)", tier.name,
+                           self._kv_quantize)
+            self._kv_quantize = "none"
 
         # Session KV prefix reuse (engine/prefix_cache.py), both model
         # families (transformer/moe each export chunk_prefill).  Each
@@ -263,13 +274,8 @@ class InferenceEngine:
             logits = transformer.logits_from_hidden(params, last)
             first = sample_token_dynamic(logits, rng, temperature)
 
-            cache = transformer.init_kv_cache(cfg, b, cache_len)
-            cache = {
-                "k": jax.lax.dynamic_update_slice(
-                    cache["k"], k_all, (0, 0, 0, 0, 0)),
-                "v": jax.lax.dynamic_update_slice(
-                    cache["v"], v_all, (0, 0, 0, 0, 0)),
-            }
+            cache = transformer.seed_kv_cache(cfg, k_all, v_all, cache_len,
+                                              self._kv_quantize)
             return first, cache
 
         fn = jax.jit(run)
@@ -282,8 +288,9 @@ class InferenceEngine:
         key = ("init", cache_len)
         if key not in self._grow_fns:
             cfg = self.cfg
+            kvq = self._kv_quantize
             self._grow_fns[key] = jax.jit(
-                lambda: transformer.init_kv_cache(cfg, 1, cache_len))
+                lambda: transformer.init_kv_cache(cfg, 1, cache_len, kvq))
         return self._grow_fns[key]
 
     def _long_prefill(self, ids, cache_len: int, rng, temp,
@@ -329,14 +336,15 @@ class InferenceEngine:
         if key not in self._grow_fns:
             cfg = self.cfg
 
+            kvq = self._kv_quantize
+
             def run(cache):
                 b = cache["k"].shape[1]
-                big = transformer.init_kv_cache(cfg, b, dst_len)
+                big = transformer.init_kv_cache(cfg, b, dst_len, kvq)
                 return {
-                    "k": jax.lax.dynamic_update_slice(
-                        big["k"], cache["k"], (0, 0, 0, 0, 0)),
-                    "v": jax.lax.dynamic_update_slice(
-                        big["v"], cache["v"], (0, 0, 0, 0, 0)),
+                    key: jax.lax.dynamic_update_slice(
+                        big[key], cache[key], (0,) * big[key].ndim)
+                    for key in big
                 }
 
             donate = (0,) if jax.default_backend() != "cpu" else ()
@@ -389,7 +397,7 @@ class InferenceEngine:
         # TP tiers: per-head-shard flash decode (frontier-clamped KV
         # streaming) instead of the GSPMD XLA path; dense models only.
         decode_kw = {}
-        if cfg.num_experts == 1:
+        if cfg.num_experts == 1 and self._kv_quantize == "none":
             from ..parallel.tp_attention import tp_decode_attn
             hook = tp_decode_attn(self.mesh, cfg, cache_len)
             if hook is not None:
@@ -574,7 +582,7 @@ class InferenceEngine:
         from ..utils import roofline
         self.phases.add_work("decode", **roofline.decode_work(
             self.cfg, max(0, int(steps) - 1), cache_len,
-            wbytes=self._wbytes))
+            wbytes=self._wbytes, kv_quantize=self._kv_quantize))
         total_ms = (time.perf_counter() - t0) * 1000.0
 
         if self.prefix_cache is not None:
@@ -654,7 +662,8 @@ class InferenceEngine:
                     from ..utils import roofline
                     self.phases.add_work("decode", **roofline.decode_work(
                         self.cfg, max(0, int(steps) - 1), cache_len,
-                        wbytes=self._wbytes))
+                        wbytes=self._wbytes,
+                        kv_quantize=self._kv_quantize))
                     for tok in out[1:int(steps)].tolist():
                         gen.append(tok)
                         if tok in (eos, pad):

@@ -45,19 +45,9 @@ KVPool = Dict[str, jax.Array]    # {"k","v": [L, N_kv, NB, bs, D]}
 
 TRASH_BLOCK = 0
 
-
-def quantize_kv_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """Symmetric per-row int8: scale over the trailing D axis.  Returns
-    (int8 values, float32 scales with the D axis dropped)."""
-    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.round(x.astype(jnp.float32) / scale[..., None])
-    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
-
-
-def dequantize_kv_rows(q: jax.Array, scale: jax.Array,
-                       dtype) -> jax.Array:
-    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+# Canonical impls live in ops/quant.py (the contiguous cache shares them);
+# re-exported here for the paged call sites and existing tests.
+from ..ops.quant import dequantize_kv_rows, quantize_kv_rows  # noqa: E402,F401
 
 
 @dataclasses.dataclass(frozen=True)

@@ -165,12 +165,30 @@ def decode_step(cfg: ModelConfig, params: Params, token: jax.Array,
     d = cfg.head_dim
     x = quant.embed_rows(params["embed"], token)      # [B,H]
     sin, cos = rope_sincos(pos, d, cfg.rope_theta)    # [B, D/2]
-    if attn is None:
-        attn = lambda q, kc, vc, p: attention.decode(
-            q, kc, vc, p, impl=cfg.attention_impl)
+    quantized = "ks" in kv
+    if attn is None or quantized:
+        # int8 caches always use the scale-aware dispatcher (the TP flash
+        # hook carries no scale operands; its policy skips quantized
+        # tiers, engine/inference.py).
+        attn = lambda q, kc, vc, p, ks=None, vs=None: attention.decode(
+            q, kc, vc, p, impl=cfg.attention_impl, k_scale=ks, v_scale=vs)
+    else:
+        base = attn
+        attn = lambda q, kc, vc, p, ks=None, vs=None: base(q, kc, vc, p)
+
+    def write_rows(cache, new):
+        # Write this step's K/V (or scale) rows at each sequence's pos.
+        def one(c, n, p):
+            return jax.lax.dynamic_update_slice(
+                c, n[None], (p,) + (0,) * (c.ndim - 1))
+        return jax.vmap(one)(cache, new, pos)
 
     def layer(x, scanned):
-        lp, k_cache, v_cache = scanned
+        if quantized:
+            lp, k_cache, v_cache, ks_cache, vs_cache = scanned
+        else:
+            lp, k_cache, v_cache = scanned
+            ks_cache = vs_cache = None
         h_in = rms_norm(x, lp["ln1"], cfg.norm_eps)
         q = quant.matmul(h_in, lp["wq"]).reshape(b, cfg.num_heads, d)
         k = quant.matmul(h_in, lp["wk"]).reshape(b, cfg.num_kv_heads, d)
@@ -178,25 +196,34 @@ def decode_step(cfg: ModelConfig, params: Params, token: jax.Array,
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
 
-        # Write this step's K/V at each sequence's own position.
-        def write(cache, new):
-            def one(c, n, p):
-                return jax.lax.dynamic_update_slice(c, n[None], (p, 0, 0))
-            return jax.vmap(one)(cache, new, pos)
-        k_cache = write(k_cache, k)
-        v_cache = write(v_cache, v)
+        if quantized:
+            k, k_sc = quant.quantize_kv_rows(k)
+            v, v_sc = quant.quantize_kv_rows(v)
+            ks_cache = write_rows(ks_cache, k_sc)
+            vs_cache = write_rows(vs_cache, v_sc)
+        k_cache = write_rows(k_cache, k)
+        v_cache = write_rows(v_cache, v)
 
-        attn_out = attn(q, k_cache, v_cache, pos)
+        attn_out = attn(q, k_cache, v_cache, pos, ks_cache, vs_cache)
         x = x + quant.matmul(attn_out.reshape(b, cfg.num_heads * d),
                              lp["wo"])
         x = x + _swiglu(rms_norm(x, lp["ln2"], cfg.norm_eps),
                         lp["w_gate"], lp["w_up"], lp["w_down"])
+        if quantized:
+            return x, (k_cache, v_cache, ks_cache, vs_cache)
         return x, (k_cache, v_cache)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer, x, (params["layers"], kv["k"], kv["v"]))
+    if quantized:
+        x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            layer, x, (params["layers"], kv["k"], kv["v"],
+                       kv["ks"], kv["vs"]))
+        new_kv = {"k": k_new, "v": v_new, "ks": ks_new, "vs": vs_new}
+    else:
+        x, (k_new, v_new) = jax.lax.scan(
+            layer, x, (params["layers"], kv["k"], kv["v"]))
+        new_kv = {"k": k_new, "v": v_new}
     hidden = rms_norm(x, params["final_ln"], cfg.norm_eps)
-    return logits_from_hidden(params, hidden), {"k": k_new, "v": v_new}
+    return logits_from_hidden(params, hidden), new_kv
 
 
 def chunk_prefill(cfg: ModelConfig, params: Params, tokens: jax.Array,
@@ -229,8 +256,20 @@ def chunk_prefill(cfg: ModelConfig, params: Params, tokens: jax.Array,
     q_pos = jnp.minimum(positions, jnp.maximum(true_len, 1)[:, None] - 1)
     sin, cos = rope_sincos(positions, d, cfg.rope_theta)
 
+    quantized = "ks" in kv
+
+    def write_rows(cache, new):
+        def one(c, n, p):
+            return jax.lax.dynamic_update_slice(
+                c, n, (p,) + (0,) * (c.ndim - 1))
+        return jax.vmap(one)(cache, new, start)
+
     def layer(x, scanned):
-        lp, k_cache, v_cache = scanned
+        if quantized:
+            lp, k_cache, v_cache, ks_cache, vs_cache = scanned
+        else:
+            lp, k_cache, v_cache = scanned
+            ks_cache = vs_cache = None
         h_in = rms_norm(x, lp["ln1"], cfg.norm_eps)
         q = quant.matmul(h_in, lp["wq"]).reshape(b, s_c, cfg.num_heads, d)
         k = quant.matmul(h_in, lp["wk"]).reshape(b, s_c, cfg.num_kv_heads, d)
@@ -238,29 +277,84 @@ def chunk_prefill(cfg: ModelConfig, params: Params, tokens: jax.Array,
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
 
-        def write(cache, new):
-            def one(c, n, p):
-                return jax.lax.dynamic_update_slice(c, n, (p, 0, 0))
-            return jax.vmap(one)(cache, new, start)
-        k_cache = write(k_cache, k)
-        v_cache = write(v_cache, v)
+        if quantized:
+            k, k_sc = quant.quantize_kv_rows(k)
+            v, v_sc = quant.quantize_kv_rows(v)
+            ks_cache = write_rows(ks_cache, k_sc)
+            vs_cache = write_rows(vs_cache, v_sc)
+        k_cache = write_rows(k_cache, k)
+        v_cache = write_rows(v_cache, v)
 
         k_att = k_cache[:, :window] if window else k_cache
         v_att = v_cache[:, :window] if window else v_cache
+        scales = ((ks_cache[:, :window] if window else ks_cache,
+                   vs_cache[:, :window] if window else vs_cache)
+                  if quantized else (None, None))
         attn = attention.chunk(q, k_att, v_att, q_pos,
-                               impl=cfg.attention_impl)
+                               impl=cfg.attention_impl,
+                               k_scale=scales[0], v_scale=scales[1])
         x = x + quant.matmul(attn.reshape(b, s_c, cfg.num_heads * d), lp["wo"])
         x = x + _swiglu(rms_norm(x, lp["ln2"], cfg.norm_eps),
                         lp["w_gate"], lp["w_up"], lp["w_down"])
+        if quantized:
+            return x, (k_cache, v_cache, ks_cache, vs_cache)
         return x, (k_cache, v_cache)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer, x, (params["layers"], kv["k"], kv["v"]))
+    if quantized:
+        x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            layer, x, (params["layers"], kv["k"], kv["v"],
+                       kv["ks"], kv["vs"]))
+        new_kv = {"k": k_new, "v": v_new, "ks": ks_new, "vs": vs_new}
+    else:
+        x, (k_new, v_new) = jax.lax.scan(
+            layer, x, (params["layers"], kv["k"], kv["v"]))
+        new_kv = {"k": k_new, "v": v_new}
     hidden = rms_norm(x, params["final_ln"], cfg.norm_eps)
-    return hidden, {"k": k_new, "v": v_new}
+    return hidden, new_kv
 
 
-def init_kv_cache(cfg: ModelConfig, batch: int, max_seq: int) -> KVCache:
+def init_kv_cache(cfg: ModelConfig, batch: int, max_seq: int,
+                  kv_quantize: str = "none") -> KVCache:
+    """``kv_quantize="int8"``: K/V stored as symmetric per-row int8 with
+    f32 scale planes {"ks","vs": [L,B,S,N_kv]} — decode streams the whole
+    cache every step, so halving its bytes is a direct bandwidth win
+    (ops/quant.quantize_kv_rows; the paged pool's contiguous twin)."""
     shape = (cfg.num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_dim)
+    if kv_quantize == "int8":
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "ks": jnp.ones(shape[:-1], jnp.float32),
+                "vs": jnp.ones(shape[:-1], jnp.float32)}
+    if kv_quantize != "none":
+        raise ValueError(f"kv_quantize={kv_quantize!r}: expected 'none' "
+                         "or 'int8'")
     dtype = jnp.dtype(cfg.dtype)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def seed_kv_cache(cfg: ModelConfig, k_all: jax.Array, v_all: jax.Array,
+                  cache_len: int, kv_quantize: str = "none") -> KVCache:
+    """Build a cache of length ``cache_len`` holding a prefill's K/V
+    ([L,B,S,N_kv,D]) at positions [0, S) — quantizing on write when the
+    cache is int8."""
+    b = k_all.shape[1]
+    cache = init_kv_cache(cfg, b, cache_len, kv_quantize)
+    if "ks" in cache:
+        kq, ks = quant.quantize_kv_rows(k_all)
+        vq, vs = quant.quantize_kv_rows(v_all)
+        return {
+            "k": jax.lax.dynamic_update_slice(cache["k"], kq,
+                                              (0, 0, 0, 0, 0)),
+            "v": jax.lax.dynamic_update_slice(cache["v"], vq,
+                                              (0, 0, 0, 0, 0)),
+            "ks": jax.lax.dynamic_update_slice(cache["ks"], ks,
+                                               (0, 0, 0, 0)),
+            "vs": jax.lax.dynamic_update_slice(cache["vs"], vs,
+                                               (0, 0, 0, 0)),
+        }
+    return {
+        "k": jax.lax.dynamic_update_slice(cache["k"], k_all,
+                                          (0, 0, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(cache["v"], v_all,
+                                          (0, 0, 0, 0, 0)),
+    }

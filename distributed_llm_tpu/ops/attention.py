@@ -91,9 +91,26 @@ def causal(q: jax.Array, k: jax.Array, v: jax.Array,
     return causal_attention(q, k, v)
 
 
+def _dequant_cache(k_cache, v_cache, k_scale, v_scale, dtype):
+    """Contiguous int8 cache ([.., S, Nkv, D] + [.., S, Nkv] scales) ->
+    model-dtype views for the XLA attention math (the cast fuses into the
+    attention einsum read; the HBM-resident cache stays int8)."""
+    k = (k_cache.astype(jnp.float32) * k_scale[..., None]).astype(dtype)
+    v = (v_cache.astype(jnp.float32) * v_scale[..., None]).astype(dtype)
+    return k, v
+
+
 def decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
-           pos: jax.Array, impl: str = "auto") -> jax.Array:
-    """Dispatching single-step decode attention."""
+           pos: jax.Array, impl: str = "auto", k_scale: jax.Array = None,
+           v_scale: jax.Array = None) -> jax.Array:
+    """Dispatching single-step decode attention.  ``k_scale``/``v_scale``
+    mark an int8 contiguous cache (TierConfig.kv_quantize): the XLA
+    dequant path runs (a 'decode_q8' Pallas twin would dispatch here once
+    measured)."""
+    if k_scale is not None:
+        k_cache, v_cache = _dequant_cache(k_cache, v_cache, k_scale,
+                                          v_scale, q.dtype)
+        return decode_attention(q, k_cache, v_cache, pos)
     if _choose(impl, "decode", k_cache.shape[1]) == "pallas":
         from .pallas_attention import flash_decode_attention
         return flash_decode_attention(q, k_cache, v_cache, pos)
@@ -101,11 +118,18 @@ def decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
 
 def chunk(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
-          q_positions: jax.Array, impl: str = "auto") -> jax.Array:
+          q_positions: jax.Array, impl: str = "auto",
+          k_scale: jax.Array = None,
+          v_scale: jax.Array = None) -> jax.Array:
     """Dispatching chunked-prefill attention (suffix queries vs the cache
     window).  The Pallas path keeps cold prefill and prefix-reuse hits on
     the same kernel family on TPU (flash recurrence, per-query frontier);
-    the XLA path is the portable/shardable fallback."""
+    the XLA path is the portable/shardable fallback — and the only path
+    for int8 caches (scales given)."""
+    if k_scale is not None:
+        k_cache, v_cache = _dequant_cache(k_cache, v_cache, k_scale,
+                                          v_scale, q.dtype)
+        return chunk_attention(q, k_cache, v_cache, q_positions)
     if _choose(impl, "chunk", k_cache.shape[1]) == "pallas":
         from .pallas_attention import flash_chunk_attention
         return flash_chunk_attention(q, k_cache, v_cache, q_positions)

@@ -32,6 +32,21 @@ def is_quantized(w: Any) -> bool:
     return isinstance(w, dict) and "q" in w and "s" in w
 
 
+def quantize_kv_rows(x: jax.Array) -> tuple:
+    """Symmetric per-row int8 for KV caches: scale over the trailing D
+    axis.  Returns (int8 values, float32 scales with the D axis dropped).
+    Shared by the paged pool (engine/paged_kv.py) and the contiguous
+    cache (models/transformer.py) under ``TierConfig.kv_quantize``."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.round(x.astype(jnp.float32) / scale[..., None])
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+def dequantize_kv_rows(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def quantize_tensor(w: jax.Array, contract_axis: int = -2) -> QTensor:
     """Per-output-channel symmetric int8: scale over the contraction axis.
 
