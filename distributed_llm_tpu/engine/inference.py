@@ -736,7 +736,8 @@ class InferenceEngine:
                 # against the cache length such a conversation would use.
                 window = self._suffix_window(sb + 1)
                 cache_len = self._pick_cache_len(max(sb + 1 + cap, window))
-                cache = transformer.init_kv_cache(self.cfg, 1, cache_len)
+                cache = transformer.init_kv_cache(self.cfg, 1, cache_len,
+                                                  self._kv_quantize)
                 first, _ = self._suffix_prefill_fn(
                     sb, min(window, cache_len))(
                     self.params, cache,

@@ -95,9 +95,9 @@ def _dequant_cache(k_cache, v_cache, k_scale, v_scale, dtype):
     """Contiguous int8 cache ([.., S, Nkv, D] + [.., S, Nkv] scales) ->
     model-dtype views for the XLA attention math (the cast fuses into the
     attention einsum read; the HBM-resident cache stays int8)."""
-    k = (k_cache.astype(jnp.float32) * k_scale[..., None]).astype(dtype)
-    v = (v_cache.astype(jnp.float32) * v_scale[..., None]).astype(dtype)
-    return k, v
+    from .quant import dequantize_kv_rows
+    return (dequantize_kv_rows(k_cache, k_scale, dtype),
+            dequantize_kv_rows(v_cache, v_scale, dtype))
 
 
 def decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,

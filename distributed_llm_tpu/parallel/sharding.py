@@ -144,10 +144,16 @@ def _shardings_with_fallback(cfg: ModelConfig, mesh: Mesh,
                         is_leaf=lambda x: isinstance(x, P))
 
 
-def kv_cache_specs(tp_axis: str = "tp") -> Dict[str, P]:
-    """KV cache [L, B, S, N_kv, D]: shard the kv-head axis over tp."""
-    return {"k": P(None, None, None, tp_axis, None),
+def kv_cache_specs(tp_axis: str = "tp",
+                   quantized: bool = False) -> Dict[str, P]:
+    """KV cache [L, B, S, N_kv, D]: shard the kv-head axis over tp.  int8
+    caches carry {ks,vs: [L, B, S, N_kv]} scale planes, same sharding."""
+    spec = {"k": P(None, None, None, tp_axis, None),
             "v": P(None, None, None, tp_axis, None)}
+    if quantized:
+        spec["ks"] = P(None, None, None, tp_axis)
+        spec["vs"] = P(None, None, None, tp_axis)
+    return spec
 
 
 def kv_pool_specs(tp_axis: str = "tp",
@@ -171,8 +177,10 @@ def kv_pool_shardings(mesh: Mesh, tp_axis: str = "tp",
             for k, s in kv_pool_specs(tp_axis, quantized).items()}
 
 
-def kv_cache_shardings(mesh: Mesh, tp_axis: str = "tp") -> Dict[str, NamedSharding]:
-    return {k: NamedSharding(mesh, s) for k, s in kv_cache_specs(tp_axis).items()}
+def kv_cache_shardings(mesh: Mesh, tp_axis: str = "tp",
+                       quantized: bool = False) -> Dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, s)
+            for k, s in kv_cache_specs(tp_axis, quantized).items()}
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

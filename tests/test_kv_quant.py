@@ -205,7 +205,6 @@ def test_sequential_kv_int8_long_prompt_chunked_prefill():
 
 
 def test_moe_tier_kv_int8_falls_back_to_bf16():
-    from distributed_llm_tpu.config import MODEL_PRESETS
     from distributed_llm_tpu.engine.inference import InferenceEngine
     tier = dataclasses.replace(tiny_cluster().nano,
                                model_preset="moe_test",
