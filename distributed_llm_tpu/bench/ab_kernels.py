@@ -94,6 +94,7 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
                         repeat), {})
 
     # decode + chunk + paged_decode across batch × cache length
+    from ..ops.quant import quantize_kv_rows as _qkv
     for s in lengths:
         for b in batches:
             q = jax.random.normal(key, (b, nq, d), bf16)
@@ -105,6 +106,20 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
                             repeat),
                    _time_fn(jax.jit(PA.flash_decode_attention),
                             (q, kc, vc, pos), repeat), {"batch": b})
+
+            # int8 contiguous cache: XLA dequant view vs in-VMEM kernel.
+            kq, ksc = _qkv(kc)
+            vq, vsc = _qkv(vc)
+            ksc_c = ksc.astype(jnp.float32)
+            vsc_c = vsc.astype(jnp.float32)
+            record("decode_q8", s,
+                   _time_fn(jax.jit(lambda *a: A.decode(
+                       a[0], a[1], a[2], a[5], impl="xla",
+                       k_scale=a[3], v_scale=a[4])),
+                       (q, kq, vq, ksc_c, vsc_c, pos), repeat),
+                   _time_fn(jax.jit(PA.flash_decode_attention_q8),
+                            (q, kq, vq, ksc_c, vsc_c, pos), repeat),
+                   {"batch": b})
 
         # chunk prefill: one 128-token suffix against the window
         sc = min(128, s)

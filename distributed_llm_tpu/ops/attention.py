@@ -104,10 +104,14 @@ def decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
            pos: jax.Array, impl: str = "auto", k_scale: jax.Array = None,
            v_scale: jax.Array = None) -> jax.Array:
     """Dispatching single-step decode attention.  ``k_scale``/``v_scale``
-    mark an int8 contiguous cache (TierConfig.kv_quantize): the XLA
-    dequant path runs (a 'decode_q8' Pallas twin would dispatch here once
-    measured)."""
+    mark an int8 contiguous cache (TierConfig.kv_quantize): the Pallas
+    path streams int8 tiles + scales with in-VMEM dequant (its own
+    'decode_q8' dispatch kind); the XLA path dequantizes a view."""
     if k_scale is not None:
+        if _choose(impl, "decode_q8", k_cache.shape[1]) == "pallas":
+            from .pallas_attention import flash_decode_attention_q8
+            return flash_decode_attention_q8(q, k_cache, v_cache, k_scale,
+                                             v_scale, pos)
         k_cache, v_cache = _dequant_cache(k_cache, v_cache, k_scale,
                                           v_scale, q.dtype)
         return decode_attention(q, k_cache, v_cache, pos)

@@ -640,3 +640,97 @@ def flash_decode_attention(q: jax.Array, k_cache: jax.Array,
         interpret=_interpret(),
     )(pos32, qh, kh, vh)
     return out.reshape(b, nq, d)
+
+
+def _decode_kernel_q8(pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                      acc_ref, m_ref, l_ref, *, bk: int, scale: float):
+    """int8 twin of _decode_kernel: KV tiles arrive int8 with per-row f32
+    scales; dequantization happens in VMEM after the half-width DMA."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * bk <= pos_ref[b])
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32) * scale           # [G, D]
+        k = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]    # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0]
+
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bk
+        s = jnp.where(col <= pos_ref[b], s, NEG_INF)          # ragged mask
+
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(j == nb - 1)
+    def _done():
+        o_ref[0, 0] = (acc_ref[...] /
+                       jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def flash_decode_attention_q8(q: jax.Array, k_cache: jax.Array,
+                              v_cache: jax.Array, k_scale: jax.Array,
+                              v_scale: jax.Array,
+                              pos: jax.Array) -> jax.Array:
+    """``flash_decode_attention`` over an int8 contiguous cache
+    (TierConfig.kv_quantize): caches [B,S_max,Nkv,D] int8, scales
+    [B,S_max,Nkv] f32.  Streams half the KV bytes of the bf16 kernel with
+    the same frontier-clamped tiling; the XLA fallback dequantizes a
+    gathered view instead."""
+    b, nq, d = q.shape
+    s_max, nkv = k_cache.shape[1], k_cache.shape[2]
+    groups = nq // nkv
+    bk = next((t for t in (256, 128) if s_max % t == 0), s_max)
+
+    qh = q.reshape(b, nkv, groups, d)                        # group-major
+    kh = k_cache.transpose(0, 2, 1, 3)                       # [B, Nkv, S, D]
+    vh = v_cache.transpose(0, 2, 1, 3)
+    # Scales [B, S, Nkv] -> [B, Nkv, S, 1]: the trailing singleton keeps
+    # Mosaic on its (sublane, lane) tiling for the per-row plane.
+    ks = k_scale.transpose(0, 2, 1)[..., None].astype(jnp.float32)
+    vs = v_scale.transpose(0, 2, 1)[..., None].astype(jnp.float32)
+    pos32 = pos.astype(jnp.int32)
+
+    kernel = functools.partial(_decode_kernel_q8, bk=bk, scale=d ** -0.5)
+
+    def kv_index(b_, h, j, p):
+        return (b_, h, jnp.minimum(j, p[b_] // bk), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, nkv, s_max // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, groups, d), lambda b_, h, j, p: (b_, h, 0, 0)),
+            pl.BlockSpec((1, 1, bk, d), kv_index),
+            pl.BlockSpec((1, 1, bk, d), kv_index),
+            pl.BlockSpec((1, 1, bk, 1), kv_index),
+            pl.BlockSpec((1, 1, bk, 1), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, groups, d),
+                               lambda b_, h, j, p: (b_, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((groups, d), jnp.float32),
+            pltpu.VMEM((groups, 1), jnp.float32),
+            pltpu.VMEM((groups, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        interpret=_interpret(),
+    )(pos32, qh, kh, vh, ks, vs)
+    return out.reshape(b, nq, d)

@@ -105,6 +105,35 @@ def test_paged_decode_q8_pallas_matches_xla_dequant(monkeypatch):
                                np.asarray(got, np.float32), atol=1e-6)
 
 
+def test_flash_decode_q8_matches_xla_dequant(monkeypatch):
+    """Contiguous int8 flash decode (in-VMEM dequant, interpret mode)
+    agrees with the XLA dequant path, and the dispatcher routes to it
+    when the measured table prefers pallas for 'decode_q8'."""
+    from distributed_llm_tpu.ops import attention as A
+    from distributed_llm_tpu.ops.pallas_attention import \
+        flash_decode_attention_q8
+    key = jax.random.PRNGKey(9)
+    b, s, nkv, d, nq = 2, 64, 2, 32, 4
+    kq, ks = quantize_kv_rows(
+        jax.random.normal(key, (b, s, nkv, d), jnp.bfloat16))
+    vq, vs = quantize_kv_rows(
+        jax.random.normal(jax.random.PRNGKey(10), (b, s, nkv, d),
+                          jnp.bfloat16))
+    q = jax.random.normal(jax.random.PRNGKey(11), (b, nq, d), jnp.bfloat16)
+    pos = jnp.asarray([10, 63], jnp.int32)
+    want = A.decode(q, kq, vq, pos, impl="xla", k_scale=ks, v_scale=vs)
+    got = flash_decode_attention_q8(q, kq, vq, ks, vs, pos)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               atol=2e-2, rtol=2e-2)
+    monkeypatch.setattr(A, "_DISPATCH_TABLE",
+                        {"decode_q8": {"default": "pallas"}})
+    monkeypatch.delenv("DLLM_ATTENTION", raising=False)
+    via = A.decode(q, kq, vq, pos, impl="pallas", k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(via, np.float32),
+                               np.asarray(got, np.float32), atol=1e-6)
+
+
 def _tier(**kw):
     return dataclasses.replace(tiny_cluster().nano, decode_batch=2,
                                max_new_tokens=8, **kw)
