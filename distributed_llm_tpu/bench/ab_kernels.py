@@ -73,13 +73,40 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
                      "repeat": repeat, "cases": []}
     wins: dict = {}
 
-    def record(kind, length, ms_xla, ms_pallas, detail):
-        case = {"kind": kind, "length": length, "xla_ms": round(ms_xla, 3),
-                "pallas_ms": round(ms_pallas, 3), **detail}
+    def record(kind, length, fn_xla, args_xla, fn_pallas, args_pallas,
+               detail):
+        """Time both legs; a leg that RAISES (e.g. a Mosaic compile
+        failure on new hardware) loses with ms=None instead of aborting
+        the whole A/B — the dispatch table must still be written."""
+        import jax as _jax
+
+        def leg(fn, args):
+            try:
+                return _time_fn(_jax.jit(fn), args, repeat), None
+            except Exception as exc:
+                return None, str(exc)[:160]
+
+        ms_xla, err_x = leg(fn_xla, args_xla)
+        ms_pallas, err_p = leg(fn_pallas, args_pallas)
+        case = {"kind": kind, "length": length,
+                "xla_ms": round(ms_xla, 3) if ms_xla is not None else None,
+                "pallas_ms": (round(ms_pallas, 3)
+                              if ms_pallas is not None else None), **detail}
+        if err_x:
+            case["xla_error"] = err_x
+        if err_p:
+            case["pallas_error"] = err_p
         results["cases"].append(case)
         print(json.dumps(case), flush=True)
         slot = wins.setdefault(kind, {}).setdefault(str(length), [])
-        slot.append(ms_pallas <= ms_xla)
+        # Pallas wins only if it ran AND beat a working XLA leg; a broken
+        # XLA leg with working pallas also counts (something must run).
+        if ms_pallas is None:
+            slot.append(False)
+        elif ms_xla is None:
+            slot.append(True)
+        else:
+            slot.append(ms_pallas <= ms_xla)
 
     # prefill (one sequence per call, bucket-sized)
     for s in lengths:
@@ -88,10 +115,8 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
         q = jax.random.normal(key, (1, s, nq, d), bf16)
         k = jax.random.normal(key, (1, s, nkv, d), bf16)
         v = jax.random.normal(key, (1, s, nkv, d), bf16)
-        record("prefill", s,
-               _time_fn(jax.jit(A.causal_attention), (q, k, v), repeat),
-               _time_fn(jax.jit(PA.flash_causal_attention), (q, k, v),
-                        repeat), {})
+        record("prefill", s, A.causal_attention, (q, k, v),
+               PA.flash_causal_attention, (q, k, v), {})
 
     # decode + chunk + paged_decode across batch × cache length
     from ..ops.quant import quantize_kv_rows as _qkv
@@ -101,11 +126,9 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
             kc = jax.random.normal(key, (b, s, nkv, d), bf16)
             vc = jax.random.normal(key, (b, s, nkv, d), bf16)
             pos = jnp.full((b,), s - 1, jnp.int32)     # worst-case frontier
-            record("decode", s,
-                   _time_fn(jax.jit(A.decode_attention), (q, kc, vc, pos),
-                            repeat),
-                   _time_fn(jax.jit(PA.flash_decode_attention),
-                            (q, kc, vc, pos), repeat), {"batch": b})
+            record("decode", s, A.decode_attention, (q, kc, vc, pos),
+                   PA.flash_decode_attention, (q, kc, vc, pos),
+                   {"batch": b})
 
             # int8 contiguous cache: XLA dequant view vs in-VMEM kernel.
             kq, ksc = _qkv(kc)
@@ -113,13 +136,11 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
             ksc_c = ksc.astype(jnp.float32)
             vsc_c = vsc.astype(jnp.float32)
             record("decode_q8", s,
-                   _time_fn(jax.jit(lambda *a: A.decode(
-                       a[0], a[1], a[2], a[5], impl="xla",
-                       k_scale=a[3], v_scale=a[4])),
-                       (q, kq, vq, ksc_c, vsc_c, pos), repeat),
-                   _time_fn(jax.jit(PA.flash_decode_attention_q8),
-                            (q, kq, vq, ksc_c, vsc_c, pos), repeat),
-                   {"batch": b})
+                   lambda *a: A.decode(a[0], a[1], a[2], a[5], impl="xla",
+                                       k_scale=a[3], v_scale=a[4]),
+                   (q, kq, vq, ksc_c, vsc_c, pos),
+                   PA.flash_decode_attention_q8,
+                   (q, kq, vq, ksc_c, vsc_c, pos), {"batch": b})
 
         # chunk prefill: one 128-token suffix against the window
         sc = min(128, s)
@@ -127,11 +148,8 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
         kc = jax.random.normal(key, (1, s, nkv, d), bf16)
         vc = jax.random.normal(key, (1, s, nkv, d), bf16)
         qpos = (jnp.arange(sc, dtype=jnp.int32) + (s - sc))[None]
-        record("chunk", s,
-               _time_fn(jax.jit(A.chunk_attention), (q, kc, vc, qpos),
-                        repeat),
-               _time_fn(jax.jit(PA.flash_chunk_attention), (q, kc, vc, qpos),
-                        repeat), {"chunk": sc})
+        record("chunk", s, A.chunk_attention, (q, kc, vc, qpos),
+               PA.flash_chunk_attention, (q, kc, vc, qpos), {"chunk": sc})
 
         # paged decode: pool sized for 8 slots of this length
         bs = 64
@@ -143,25 +161,22 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
                 np.arange(b * (s // bs), dtype=np.int32).reshape(b, s // bs))
             pos = jnp.full((b,), s - 1, jnp.int32)
             q = jax.random.normal(key, (b, nq, d), bf16)
-            record("paged_decode", s,
-                   _time_fn(jax.jit(A.paged_decode),
-                            (q, kp, vp, tables, pos), repeat),
-                   _time_fn(jax.jit(PA.paged_decode_attention),
-                            (q, kp, vp, tables, pos), repeat), {"batch": b})
+            record("paged_decode", s, A.paged_decode,
+                   (q, kp, vp, tables, pos),
+                   PA.paged_decode_attention, (q, kp, vp, tables, pos),
+                   {"batch": b})
 
             # int8 pool variant: XLA half-byte gather+dequant vs the
             # in-VMEM dequant kernel.
-            from ..engine.paged_kv import quantize_kv_rows
-            kq, ksc = quantize_kv_rows(kp)
-            vq, vsc = quantize_kv_rows(vp)
+            kq, ksc = _qkv(kp)
+            vq, vsc = _qkv(vp)
             record("paged_decode_q8", s,
-                   _time_fn(jax.jit(lambda *a: A.paged_decode(
-                       a[0], a[1], a[2], a[5], a[6], impl="xla",
-                       k_scale=a[3], v_scale=a[4])),
-                       (q, kq, vq, ksc, vsc, tables, pos), repeat),
-                   _time_fn(jax.jit(PA.paged_decode_attention_q8),
-                            (q, kq, vq, ksc, vsc, tables, pos), repeat),
-                   {"batch": b})
+                   lambda *a: A.paged_decode(a[0], a[1], a[2], a[5], a[6],
+                                             impl="xla", k_scale=a[3],
+                                             v_scale=a[4]),
+                   (q, kq, vq, ksc, vsc, tables, pos),
+                   PA.paged_decode_attention_q8,
+                   (q, kq, vq, ksc, vsc, tables, pos), {"batch": b})
 
     # Dispatch decision: pallas must win (or tie) at EVERY tested batch of
     # a (kind, length) to own it — robust beats optimal.
