@@ -37,7 +37,14 @@ def _measured_impl(kind: str, length: Optional[int]) -> Optional[str]:
     if _DISPATCH_TABLE is None:
         try:
             with open(_DISPATCH_PATH) as f:
-                _DISPATCH_TABLE = json.load(f).get("dispatch", {})
+                data = json.load(f)
+            # A table measured on another backend is meaningless here
+            # (interpreter-mode CPU timings would wrongly demote every
+            # kernel on TPU): ignore it.
+            if data.get("backend") == jax.default_backend():
+                _DISPATCH_TABLE = data.get("dispatch", {})
+            else:
+                _DISPATCH_TABLE = {}
         except (OSError, ValueError):
             _DISPATCH_TABLE = {}
     entry = _DISPATCH_TABLE.get(kind)
