@@ -51,7 +51,18 @@ def _measured_impl(kind: str, length: Optional[int]) -> Optional[str]:
     if isinstance(entry, str):
         return entry
     if isinstance(entry, dict):
-        return entry.get(str(length), entry.get("default"))
+        hit = entry.get(str(length))
+        if hit is None and length is not None:
+            # Off-ladder shape (e.g. the batched engine's trimmed paged
+            # window, which takes many values): snap to the nearest
+            # measured rung so demotions cover it (ADVICE r2).
+            rungs = [int(k) for k in entry if str(k).isdigit()]
+            if rungs:
+                hit = entry[str(min(rungs,
+                                    key=lambda r: abs(r - int(length))))]
+        if hit is None:
+            hit = entry.get("default")
+        return hit
     return None
 
 
@@ -62,6 +73,29 @@ def _choose(impl: str, kind: str, length: Optional[int]) -> str:
         if measured in ("xla", "pallas"):
             return measured
     return resolved
+
+
+def decode_kv_span(kind: str, length: int, positions, impl: str = "auto",
+                   block: Optional[int] = None) -> float:
+    """Average per-sequence KV span the ACTIVE decode kernel streams per
+    step, for roofline accounting (utils/roofline.py decode_work kv_ctx).
+
+    The XLA paths read the full allocated span; the Pallas decode kernels
+    clamp their grid onto the causal frontier and stream only
+    ceil((pos+1)/block) tiles (pallas_attention.py ``_decode_kernel`` /
+    paged index maps), so charging the allocated span would overstate
+    hbm_util — the judged decode metric — past 1.0 (ADVICE r2).
+
+    ``positions`` iterates the 0-based query positions of the accounted
+    steps (per step for a single sequence, per row for a batched tick);
+    ``block`` is the paged pool's block size, or None for the contiguous
+    kernels' own tile ladder."""
+    if _choose(impl, kind, length) != "pallas":
+        return float(length)
+    if block is None:      # flash_decode_* tile ladder (pallas_attention.py)
+        block = next((t for t in (256, 128) if length % t == 0), length)
+    spans = [min(length, (int(p) // block + 1) * block) for p in positions]
+    return float(sum(spans)) / max(len(spans), 1)
 
 
 def resolve_impl(impl: str = "auto") -> str:

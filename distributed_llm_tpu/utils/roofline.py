@@ -103,17 +103,29 @@ def prefill_work(cfg, end: int, start: int = 0,
 
 def decode_work(cfg, steps: int, ctx: int, batch: int = 1,
                 wbytes: Optional[int] = None,
-                kv_quantize: str = "none") -> Dict[str, float]:
+                kv_quantize: str = "none",
+                kv_ctx: Optional[float] = None,
+                kv_batch: Optional[int] = None) -> Dict[str, float]:
     """Work for ``steps`` sequential decode steps of a ``batch`` of
     sequences whose kernels each span ``ctx`` cached positions (the
-    ALLOCATED span the kernel computes over, masked or not)."""
+    ALLOCATED span the full-span XLA kernels compute over, masked or not).
+
+    ``kv_ctx`` overrides the span per sequence when the ACTIVE kernel
+    prunes past the causal frontier: the Pallas decode kernels stream (and
+    compute) only ceil((pos+1)/bk) KV tiles, not the allocated span — the
+    engines pass ``ops.attention.decode_kv_span`` so hbm_util reflects the
+    tiles the kernel actually moved.  ``kv_batch`` overrides how many
+    DISTINCT cache streams one step reads: a chunked verify of γ+1 queries
+    reads its shared cache once, not γ+1 times (engine/speculative.py)."""
     pm = active_matmul_params(cfg)
     h, l = cfg.hidden_size, cfg.num_layers
-    flops = float(steps) * batch * (2.0 * pm + 4.0 * h * l * ctx)
+    span = float(ctx) if kv_ctx is None else min(float(kv_ctx), float(ctx))
+    kvb = batch if kv_batch is None else kv_batch
+    flops = float(steps) * batch * (2.0 * pm + 4.0 * h * l * span)
     if wbytes is None:
         wbytes = weight_bytes(cfg)
-    hbm = float(steps) * (wbytes + batch
-                          * kv_bytes_per_pos(cfg, kv_quantize) * ctx)
+    hbm = float(steps) * (wbytes + kvb
+                          * kv_bytes_per_pos(cfg, kv_quantize) * span)
     return {"flops": flops, "hbm_bytes": hbm, "tokens": steps * batch}
 
 

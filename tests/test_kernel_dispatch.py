@@ -22,10 +22,20 @@ def table(monkeypatch):
 
 
 def test_measured_table_demotes_per_length(table):
-    table({"decode": {"default": "xla", "256": "pallas"}})
-    assert A._choose("pallas", "decode", 512) == "xla"
+    table({"decode": {"default": "xla", "256": "pallas", "2048": "xla"}})
+    # Exact rung wins.
     assert A._choose("pallas", "decode", 256) == "pallas"
+    assert A._choose("pallas", "decode", 2048) == "xla"
+    # Off-ladder shapes snap to the NEAREST measured rung (ADVICE r2: the
+    # batched engine's trimmed paged windows take many values; nearest
+    # rung beats the kind-wide default when rungs exist).
+    assert A._choose("pallas", "decode", 320) == "pallas"
+    assert A._choose("pallas", "decode", 1600) == "xla"
+    # No numeric rungs at all: the kind-wide default applies.
+    table({"decode": {"default": "xla"}})
+    assert A._choose("pallas", "decode", 512) == "xla"
     # Unknown kind: engine's choice stands.
+    table({"decode": {"default": "xla", "256": "pallas"}})
     assert A._choose("pallas", "paged_decode", 512) == "pallas"
 
 
