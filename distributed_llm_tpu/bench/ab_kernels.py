@@ -179,18 +179,42 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
                    (q, kq, vq, ksc, vsc, tables, pos), {"batch": b})
 
     # Dispatch decision: pallas must win (or tie) at EVERY tested batch of
-    # a (kind, length) to own it — robust beats optimal.
-    dispatch = {kind: {length: ("pallas" if all(v) else "xla")
-                       for length, v in per.items()}
-                for kind, per in wins.items()}
+    # a (kind, length) to own it — robust beats optimal.  Each kind also
+    # gets a "default" (the majority winner across its measured lengths,
+    # ties to xla) so off-ladder shapes — e.g. the batched engine's
+    # trimmed paged window — inherit a measured demotion instead of
+    # silently staying on Pallas (ADVICE r2).
+    dispatch = {}
+    for kind, per in wins.items():
+        owns = {length: all(v) for length, v in per.items()}
+        table = {length: ("pallas" if won else "xla")
+                 for length, won in owns.items()}
+        table["default"] = ("pallas"
+                            if sum(owns.values()) * 2 > len(owns) else "xla")
+        dispatch[kind] = table
     results["dispatch"] = dispatch
     print(json.dumps({"dispatch": dispatch}), flush=True)
     if write_dispatch:
-        with open(DISPATCH_PATH, "w") as f:
-            json.dump({"backend": results["backend"],
-                       "model": results["model"],
-                       "dispatch": dispatch}, f, indent=1)
-        print(f"# wrote {DISPATCH_PATH}", flush=True)
+        # A table measured on real hardware is a committed artifact; never
+        # let a CPU run clobber it (ops/attention.py would then ignore the
+        # file entirely and silently drop the TPU measurements — ADVICE r2).
+        prior_backend = None
+        try:
+            with open(DISPATCH_PATH) as f:
+                prior_backend = json.load(f).get("backend")
+        except (OSError, ValueError):
+            pass
+        if prior_backend is not None and prior_backend != results["backend"]:
+            print(f"# REFUSING to overwrite {DISPATCH_PATH}: it was "
+                  f"measured on {prior_backend!r}, this run is "
+                  f"{results['backend']!r} (delete the file to force)",
+                  flush=True)
+        else:
+            with open(DISPATCH_PATH, "w") as f:
+                json.dump({"backend": results["backend"],
+                           "model": results["model"],
+                           "dispatch": dispatch}, f, indent=1)
+            print(f"# wrote {DISPATCH_PATH}", flush=True)
     return results
 
 
@@ -218,11 +242,13 @@ def measure(impl: str, tier_name: str, prompt_tokens: int, max_new: int,
     filler = "user: " + ("benchmark the attention kernels now. " * 400)
     ttfts, tokps = [], []
     for i in range(repeat):
-        # Head-varied per iteration, sliced AFTER prepending so the total
-        # stays at the requested token count (byte-level tokenizer:
-        # chars ≈ tokens) and lands in the intended prefill bucket.
-        prompt = (f"variant {i} " + filler)[:prompt_tokens]
-        res = engine.generate(prompt, max_new_tokens=max_new)
+        # Head-varied per iteration, trimmed AFTER prepending so the total
+        # stays at the requested token count under the ENGINE's tokenizer
+        # (subword BPE since r3) and lands in the intended prefill bucket.
+        tok = engine.tokenizer
+        ids = tok.encode(f"variant {i} " + filler,
+                         add_bos=False)[:prompt_tokens]
+        res = engine.generate(tok.decode(ids), max_new_tokens=max_new)
         ttfts.append(res.ttft_ms)
         if res.tokens_per_s:
             tokps.append(res.tokens_per_s)

@@ -40,7 +40,7 @@ from .inference import (GenerationResult, prepare_prompt, trim_at_eos,
 from .paged_kv import (BlockAllocator, PagedConfig, TRASH_BLOCK,
                        chunk_prefill_paged, decode_step_paged, init_pool,
                        write_prefill_blocks)
-from .tokenizer import ByteTokenizer
+from .tokenizer import ByteTokenizer, get_tokenizer
 
 History = Union[str, Sequence[Dict[str, Any]]]
 
@@ -106,7 +106,7 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"prefill buckets {bad} not multiples of kv_block_size="
                 f"{tier.kv_block_size}: prefilled K/V must page evenly")
-        self.tokenizer = ByteTokenizer()
+        self.tokenizer = get_tokenizer(self.cfg)
         self.devices = list(devices) if devices else None
         self._rng = jax.random.PRNGKey(seed ^ 0xBA7C4)
 
@@ -512,11 +512,25 @@ class ContinuousBatchingEngine:
                         jnp.asarray(self._temps), rng)
                     toks = np.asarray(jax.block_until_ready(toks))  # [T, B]
                 from ..utils import roofline
+                from ..ops import attention as attn_ops
+                window = wb * self.paged.block_size
+                kind = ("paged_decode_q8"
+                        if self.tier.kv_quantize == "int8"
+                        else "paged_decode")
+                # Mid-tick per-row positions (each row advances
+                # steps_per_tick this tick): frontier-clamped Pallas paged
+                # kernels stream ceil((pos+1)/bs) blocks, not the window.
+                mid = self.steps_per_tick // 2
                 self.phases.add_work("decode", **roofline.decode_work(
                     self.cfg, self.steps_per_tick,
-                    wb * self.paged.block_size, batch=len(active),
+                    window, batch=len(active),
                     wbytes=self._wbytes,
-                    kv_quantize=self.tier.kv_quantize))
+                    kv_quantize=self.tier.kv_quantize,
+                    kv_ctx=attn_ops.decode_kv_span(
+                        kind, window,
+                        [self._pos[ix] + mid for ix in active],
+                        impl=self.cfg.attention_impl,
+                        block=self.paged.block_size)))
             except BaseException as exc:
                 # A dead tick must not become a dead scheduler: fail the
                 # in-flight requests and keep serving new ones.
@@ -614,7 +628,7 @@ class ContinuousBatchingEngine:
                           token_queue=queue.Queue())
 
         def deltas():
-            decoder = StreamDecoder()
+            decoder = StreamDecoder(self.tokenizer)
             while True:
                 tok = req.token_queue.get()
                 if tok is None:

@@ -39,7 +39,7 @@ from ..models import transformer
 from ..ops.sampling import sample_token_dynamic
 
 logger = logging.getLogger(__name__)
-from .tokenizer import ByteTokenizer
+from .tokenizer import ByteTokenizer, get_tokenizer
 
 
 @dataclasses.dataclass
@@ -126,7 +126,7 @@ class InferenceEngine:
     ):
         self.tier = tier
         self.cfg = upgrade_attention_impl(tier.model(), mesh)
-        self.tokenizer = ByteTokenizer()
+        self.tokenizer = get_tokenizer(self.cfg)
         self.mesh = mesh
         self._rng = jax.random.PRNGKey(seed ^ 0x5EED)
 
@@ -233,6 +233,16 @@ class InferenceEngine:
         """Smallest cache-length candidate covering ``needed`` positions."""
         return next(c for c in self._cache_lens if c >= min(needed,
                                                             self._max_seq))
+
+    def _decode_kv_span(self, cache_len: int, start: int, steps: int) -> float:
+        """Average KV span the active decode kernel streamed over ``steps``
+        steps starting at query position ``start`` (roofline kv_ctx —
+        full span on XLA, frontier-clamped tiles on Pallas)."""
+        from ..ops import attention as attn_ops
+        kind = "decode_q8" if self._kv_quantize == "int8" else "decode"
+        return attn_ops.decode_kv_span(kind, cache_len,
+                                       range(start, start + max(steps, 1)),
+                                       impl=self.cfg.attention_impl)
 
     def _sp_attn(self, bucket: int):
         """Prefill attention override for mesh tiers: ring attention when
@@ -580,9 +590,11 @@ class InferenceEngine:
                 temp, jnp.int32(budget))
             out = np.asarray(jax.block_until_ready(out))[0]
         from ..utils import roofline
+        nsteps = max(0, int(steps) - 1)
         self.phases.add_work("decode", **roofline.decode_work(
-            self.cfg, max(0, int(steps) - 1), cache_len,
-            wbytes=self._wbytes, kv_quantize=self._kv_quantize))
+            self.cfg, nsteps, cache_len,
+            wbytes=self._wbytes, kv_quantize=self._kv_quantize,
+            kv_ctx=self._decode_kv_span(cache_len, n, nsteps)))
         total_ms = (time.perf_counter() - t0) * 1000.0
 
         if self.prefix_cache is not None:
@@ -628,7 +640,7 @@ class InferenceEngine:
 
         def deltas():
             from .tokenizer import StreamDecoder
-            decoder = StreamDecoder()
+            decoder = StreamDecoder(self.tokenizer)
             eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
             try:
                 (first, cache, cache_len, ids, budget, rng, temp, ttft_ms,
@@ -660,10 +672,13 @@ class InferenceEngine:
                             sub, temp, jnp.int32(seg + 1))
                         out = np.asarray(jax.block_until_ready(out))[0]
                     from ..utils import roofline
+                    nsteps = max(0, int(steps) - 1)
                     self.phases.add_work("decode", **roofline.decode_work(
-                        self.cfg, max(0, int(steps) - 1), cache_len,
+                        self.cfg, nsteps, cache_len,
                         wbytes=self._wbytes,
-                        kv_quantize=self._kv_quantize))
+                        kv_quantize=self._kv_quantize,
+                        kv_ctx=self._decode_kv_span(
+                            cache_len, n + len(gen) - 1, nsteps)))
                     for tok in out[1:int(steps)].tolist():
                         gen.append(tok)
                         if tok in (eos, pad):

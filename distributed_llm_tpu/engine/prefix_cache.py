@@ -73,8 +73,16 @@ def select_reuse(store: "Optional[PrefixCache]", ids: Sequence[int],
 class PrefixCache:
     """Small LRU of (token-id prefix → KV cache) for one engine."""
 
-    def __init__(self, capacity: int = 4, min_prefix: int = 16,
+    def __init__(self, capacity: int = 4, min_prefix: int = 4,
                  on_evict=None):
+        # min_prefix is in TOKENS of the serving tokenizer: 4 subword ids
+        # ≈ 14 chars of prompt (engine/bpe.py) — short enough that a
+        # one-line opener parks a reusable prefix, long enough that the
+        # take/grow bookkeeping never outweighs the skipped prefill.
+        # Matching is exact-token, so short matches are always sound.
+        # (The old value 16 was calibrated in BYTE tokens and silently
+        # barred short openers from ever matching after the subword
+        # migration.)
         """``on_evict(entry)`` is called for every entry dropped by put()/
         clear()/pop_oldest() — the paged engine uses it to return the
         entry's pool blocks to the allocator (HBM-array entries just get

@@ -6,6 +6,9 @@ work to llama.cpp; here the equivalents we own live in-tree).  Currently:
 
 - ``featurizer.cc`` — hashed n-gram text features for the routing embedder
   (runs on every routed query and semantic-cache lookup).
+- ``bpe_encoder.cc`` — the subword tokenizer's merge loop (engine/bpe.py):
+  runs on every request's prompt AND every routing token count; the
+  Python twin stays the reference semantics and the non-ASCII path.
 
 The library auto-builds with g++ on first import (cached next to the
 source), and everything degrades to the pure-Python implementations when
@@ -27,9 +30,11 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_SRC_DIR, "featurizer.cc")
+_SOURCES = [os.path.join(_SRC_DIR, "featurizer.cc"),
+            os.path.join(_SRC_DIR, "bpe_encoder.cc")]
+_SRC = _SOURCES[0]                       # kept for log/messages
 _LIB = os.path.join(_SRC_DIR, "_libdllm.so")
-_ABI_VERSION = 1
+_ABI_VERSION = 2
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -40,7 +45,8 @@ def _build() -> bool:
     # Compile to a process-unique temp file, then atomically publish:
     # concurrent first-imports must never CDLL a half-written ELF.
     tmp = f"{_LIB}.tmp.{os.getpid()}"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    cmd = (["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
+           + _SOURCES + ["-o", tmp])
     try:
         res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
         if res.returncode != 0:
@@ -72,8 +78,10 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = None
         try:
             if os.environ.get("DLLM_NATIVE") != "0":
-                stale = (os.path.exists(_SRC) and os.path.exists(_LIB)
-                         and os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+                stale = (os.path.exists(_LIB)
+                         and any(os.path.exists(s)
+                                 and os.path.getmtime(_LIB)
+                                 < os.path.getmtime(s) for s in _SOURCES))
                 if (not os.path.exists(_LIB) or stale) and not _build():
                     raise OSError("native build unavailable")
                 lib = ctypes.CDLL(_LIB)
@@ -89,6 +97,13 @@ def _load() -> Optional[ctypes.CDLL]:
                 lib.dllm_featurize_batch.argtypes = [
                     ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
                     ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+                lib.dllm_bpe_load.argtypes = [
+                    ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+                lib.dllm_bpe_load.restype = ctypes.c_int
+                lib.dllm_bpe_encode.argtypes = [
+                    ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                    ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+                lib.dllm_bpe_encode.restype = ctypes.c_int
         except Exception as exc:
             logger.info("native featurizer unavailable (%s); "
                         "using Python fallback", exc)
@@ -100,6 +115,34 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return _load() is not None
+
+
+def bpe_load(merges: Sequence[Sequence[int]]) -> Optional[int]:
+    """Register a merge table; returns an encode handle, or None when the
+    native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    flat = np.asarray(merges, dtype=np.int32).reshape(-1)
+    return int(lib.dllm_bpe_load(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(merges)))
+
+
+def bpe_encode(handle: int, text: str) -> Optional[list]:
+    """Encode ASCII ``text`` with a registered merge table.  None on any
+    failure (caller falls back to the Python path)."""
+    lib = _load()
+    if lib is None:
+        return None
+    data = text.encode("utf-8")
+    out = np.empty(max(len(data), 1), dtype=np.int32)
+    n = lib.dllm_bpe_encode(
+        handle, data, len(data),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), out.size)
+    if n < 0:
+        return None
+    return out[:n].tolist()
 
 
 def featurize_batch(texts: Sequence[str], dim: int) -> Optional[np.ndarray]:

@@ -134,6 +134,6 @@ void dllm_featurize_batch(const char** texts, int n, float* out, int dim) {
   for (int i = 0; i < n; ++i) dllm_featurize(texts[i], out + (size_t)i * dim, dim);
 }
 
-int dllm_abi_version() { return 1; }
+int dllm_abi_version() { return 2; }   // 2: + bpe_encoder.cc
 
 }  // extern "C"

@@ -67,8 +67,9 @@ def pretrain(preset: str, out: str, *,
     stale = 0
     t0 = time.perf_counter()
     final = float("nan")
-    for step, (toks, mask) in enumerate(batches(batch_size, seq, seed=seed),
-                                        start=1):
+    from ..engine.tokenizer import get_tokenizer
+    data = batches(batch_size, seq, seed=seed, tokenizer=get_tokenizer(cfg))
+    for step, (toks, mask) in enumerate(data, start=1):
         metrics = trainer.train_step(toks, mask)
         window.append(metrics["loss"])
         if step % eval_every == 0:

@@ -160,6 +160,27 @@ def load_train_state(path: str, trainer) -> None:
     trainer.step_count = int(restored["step"])
 
 
+def peek_vocab_size(path: str) -> Optional[int]:
+    """Row count of the saved embedding table, read from checkpoint
+    METADATA only (no tensor bytes) — lets scripts detect a
+    stale-vocabulary artifact (e.g. a byte-level 512 vocab from before the
+    subword migration) before trying to serve it.  None if unreadable."""
+    latest = _latest_dir(path)
+    target = os.path.join(latest, "state") if latest else _abspath(path)
+    try:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            meta = ckptr.metadata(target)
+        # Orbax returns a StepMetadata whose pytree lives under
+        # item_metadata.tree (older releases exposed .tree directly).
+        tree = getattr(getattr(meta, "item_metadata", None), "tree", None)
+        if tree is None:
+            tree = getattr(meta, "tree", meta)
+        embed = tree["params"]["embed"]
+        return int(embed.shape[0])
+    except Exception:
+        return None
+
+
 def load_params_for_tier(path: str, cfg: ModelConfig,
                          mesh: Optional[jax.sharding.Mesh] = None,
                          devices: Optional[Any] = None) -> Dict[str, Any]:

@@ -105,3 +105,17 @@ def test_versioned_saves_keep_latest_and_prune(tmp_path):
     t2 = _trainer(jax.devices()[:2], seed=7)
     t2.load(root)
     assert t2.step_count == 3
+
+
+def test_peek_vocab_size_reads_metadata_only():
+    """scripts/tpu_round.sh's stale-vocab guard depends on this returning
+    the real embed row count (ADVICE-style regression: the orbax metadata
+    pytree lives under item_metadata.tree)."""
+    from distributed_llm_tpu.config import MODEL_PRESETS, default_checkpoint
+    from distributed_llm_tpu.utils.checkpoint import peek_vocab_size
+    ckpt = default_checkpoint("nano_test")
+    if ckpt is None:
+        import pytest
+        pytest.skip("checkpoints/nano_test not published")
+    assert peek_vocab_size(ckpt) == MODEL_PRESETS["nano_test"].vocab_size
+    assert peek_vocab_size("checkpoints/definitely_missing") is None

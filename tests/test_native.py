@@ -97,3 +97,78 @@ def test_native_not_pathologically_slower(lib_available):
     t_py = best_of(lambda: np.stack([embedder._features(t) for t in batch]))
     # Not a benchmark, just a sanity floor with slack for CI jitter.
     assert t_native < t_py * 1.5
+
+# -- native BPE encoder (bpe_encoder.cc ↔ engine/bpe.py) --------------------
+
+def test_bpe_native_matches_python_bitwise(lib_available):
+    """The C++ merge loop must reproduce the Python reference exactly on
+    ASCII — every id, every boundary — across corpus text, code, repeated
+    words, and degenerate whitespace."""
+    from distributed_llm_tpu.engine.bpe import load_default
+    tok = load_default()
+    handle = native.bpe_load(tok.merges)
+    assert handle is not None
+    cases = [
+        "user: What is the capital of Japan?",
+        "the chip routes tokens across the mesh " * 20,
+        "def get_max(items):\n    return max(items)\n\n" * 5,
+        "a",
+        "   leading and trailing   ",
+        "\n\n\t mixed \t\n whitespace \n",
+        "word " * 300,
+        "log\x1cline\x1done\x1etwo\x1fthree  end " * 12,  # \s ctrl seps
+    ]
+    for text in cases:
+        from distributed_llm_tpu.engine import bpe as bpe_mod
+        want = [i for m in bpe_mod._CHUNK_RE.finditer(text)
+                for i in tok._encode_chunk(m.group())]
+        got = native.bpe_encode(handle, text)
+        assert got == want, (text[:40], got[:10], want[:10])
+
+
+def test_bpe_native_matches_python_randomized(lib_available):
+    import random
+    from distributed_llm_tpu.engine.bpe import load_default
+    tok = load_default()
+    handle = native.bpe_load(tok.merges)
+    rng = random.Random(7)
+    alphabet = "abcdefghij THEthe chip mesh.,!?\n\t 0123456789"
+    for _ in range(200):
+        text = "".join(rng.choice(alphabet)
+                       for _ in range(rng.randrange(0, 120)))
+        want = tok.encode(text, add_bos=False)   # short → python path
+        got = native.bpe_encode(handle, text)
+        assert got == want, repr(text)
+
+
+def test_bpe_encode_uses_native_for_long_ascii(lib_available):
+    """encode() routes long ASCII prompts through the native loop and the
+    result is identical to the pure-Python path."""
+    from distributed_llm_tpu.engine.bpe import BPETokenizer, load_default
+    tok = load_default()
+    long_text = "user: benchmark the attention kernels now. " * 30
+    via_encode = tok.encode(long_text)
+    # Fresh tokenizer with native disabled = pure Python reference.
+    import os
+    os.environ["DLLM_NATIVE"] = "0"
+    try:
+        ref_tok = BPETokenizer(merges=tok.merges, vocab_size=tok.vocab_size)
+        # _native_encode consults the already-loaded library regardless of
+        # the env var (the flag gates LOADING), so compare via chunks.
+        from distributed_llm_tpu.engine import bpe as bpe_mod
+        want = [ref_tok.bos_id] + [
+            i for m in bpe_mod._CHUNK_RE.finditer(long_text)
+            for i in ref_tok._encode_chunk(m.group())]
+    finally:
+        os.environ.pop("DLLM_NATIVE", None)
+    assert via_encode == want
+
+
+def test_bpe_non_ascii_stays_on_python_path():
+    """Non-ASCII text must never reach the byte-wise C++ chunker (unicode
+    whitespace semantics differ); encode() handles it correctly."""
+    from distributed_llm_tpu.engine.bpe import load_default
+    tok = load_default()
+    text = ("café — naïve snowman ☃ " * 30)
+    ids = tok.encode(text, add_bos=False)
+    assert tok.decode(ids) == text

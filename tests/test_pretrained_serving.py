@@ -74,7 +74,9 @@ def test_trained_weights_beat_random_on_corpus_nll():
     cfg = tier.model()
     trained = load_params_for_tier(CKPT, cfg)
     random_p = jax.jit(lambda: models.init_params(cfg, seed=7))()
-    toks, mask = next(batches(8, 128, seed=31337))     # unseen eval seed
+    from distributed_llm_tpu.engine.tokenizer import get_tokenizer
+    toks, mask = next(batches(8, 128, seed=31337,      # unseen eval seed
+                              tokenizer=get_tokenizer(cfg)))
     nll_t = float(lm_loss(cfg, trained, toks, mask, remat=False))
     nll_r = float(lm_loss(cfg, random_p, toks, mask, remat=False))
     assert nll_t < nll_r / 3, (nll_t, nll_r)

@@ -100,7 +100,7 @@ def test_draft_cache_has_no_hole_after_full_accept():
     n, bucket = len(ids), 16
     tokens = np.full((1, bucket), spec.tokenizer.pad_id, np.int32)
     tokens[0, :n] = ids
-    first, cache_t, cache_d = spec._prefill_fn(bucket)(
+    first, cache_t, cache_d = spec._prefill_fn(bucket, spec._cache_lens[0])(
         spec.params_t, spec.params_d, jnp.asarray(tokens),
         jnp.asarray([n], np.int32))
 
