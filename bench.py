@@ -6,7 +6,10 @@ Router pipeline — routing decision, tier dispatch onto TPU engines, failover,
 perf feedback — under all five strategies, on whatever accelerator is
 attached (tiny models on CPU so the script always completes).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Prints the full result as one JSON line, then a compact (≤ ~1.2 KB) final
+JSON line {"metric", "value", "unit", "vs_baseline", ...verdicts} — the
+driver tails stdout with a small window, so the LAST line must stay small
+(VERDICT r2 weak #2); the detail also checkpoints to BENCH_partial.json.
 
 Baseline: the reference serves general_knowledge in 922.2 s (nano) + 176.0 s
 (orin) at ctx-threshold 100 — 12 queries / 1098.2 s ≈ 0.010927 req/s
@@ -73,6 +76,51 @@ class Progress:
             return dict(self.data)
 
 
+def compact(result: dict) -> dict:
+    """The FINAL printed line, sized for the driver's tail capture.
+
+    BENCH_r02.json was recorded as an unparseable fragment because the
+    single giant result line outgrew the driver's ~2 KB tail window
+    (VERDICT r2 weak #2).  The full detail still goes to an earlier
+    stdout line and BENCH_partial.json; the last line carries only the
+    headline, per-strategy table, roofline verdicts and one-number
+    feature verdicts (≤ ~1.2 KB)."""
+    keep = ("metric", "value", "unit", "vs_baseline", "p50_ttft_ms",
+            "p50_latency_ms", "routing_accuracy", "decode_tok_per_s",
+            "backend", "queries", "mfu_prefill", "hbm_util_decode",
+            "per_strategy", "aborted")
+    out = {k: result[k] for k in keep if result.get(k) is not None}
+    util = result.get("utilization") or {}
+    for key, ph, field in (("mfu_prefill", "prefill", "mfu"),
+                           ("hbm_util_decode", "decode", "hbm_util")):
+        if out.get(key) is None:
+            val = (util.get(ph) or {}).get(field)
+            if val is not None:
+                out[key] = val
+    bat = result.get("continuous_batching") or {}
+    verdicts = {
+        "batching_speedup": bat.get("batching_speedup"),
+        "kv_int8_speedup": (bat.get("kv_int8") or {}).get(
+            "speedup_vs_bf16_kv"),
+        "spec_speedup": (result.get("speculative") or {}).get("speedup"),
+        "quant_speedup": {t: q.get("speedup")
+                          for t, q in (result.get("quant") or {}).items()
+                          if isinstance(q, dict) and q.get("speedup")},
+        "prefix_reuse_speedup": (result.get("long_context") or {}).get(
+            "prefix_reuse_speedup"),
+        "orin_prefix_hits": (result.get("orin_prefix") or {}).get(
+            "prefix_hits"),
+        "orin_followup_ttft_speedup": (result.get("orin_prefix") or {}).get(
+            "followup_ttft_speedup"),
+        "flagship_decode_tok_per_s": {
+            t: f.get("decode_tok_per_s")
+            for t, f in (result.get("flagship") or {}).items()
+            if isinstance(f, dict) and f.get("decode_tok_per_s")},
+    }
+    out["verdicts"] = {k: v for k, v in verdicts.items() if v}
+    return out
+
+
 def start_watchdog(progress: Progress, timeout_s: float) -> threading.Thread:
     def watch():
         while not progress.done.wait(10.0):
@@ -86,7 +134,10 @@ def start_watchdog(progress: Progress, timeout_s: float) -> threading.Thread:
                 partial["aborted"] = (f"no device progress for "
                                       f"{progress.idle_s():.0f}s — chip "
                                       "wedged mid-run; partial results")
+                # Full partial detail first, compact parseable line LAST
+                # (the driver tails stdout).
                 print(json.dumps(partial), flush=True)
+                print(json.dumps(compact(partial)), flush=True)
                 import os
                 os._exit(3)
 
@@ -269,6 +320,94 @@ def features_phase(cluster, n_prompts: int = 3, max_new: int = 48) -> dict:
     return out
 
 
+def flagship_phase(max_new: int = 48, n_prompts: int = 3) -> dict:
+    """Serve the north-star presets at real scale (VERDICT r2 #2b):
+    nano_1b, and orin_8b-int8 on the single-chip box (flagship_cluster).
+    Random weights are fine — the kernels don't care — the numbers that
+    matter are decode tok/s and the roofline utilization at 1B/8B scale.
+    Every leg is budget-gated by the eval_shape HBM accounting
+    (utils/hbm_budget.py) so an over-budget config reports instead of
+    OOMing the run."""
+    import sys
+
+    import jax
+    from distributed_llm_tpu.config import flagship_cluster
+    from distributed_llm_tpu.engine.inference import InferenceEngine
+    from distributed_llm_tpu.utils import roofline
+    from distributed_llm_tpu.utils.hbm_budget import tier_hbm_budget
+
+    out: dict = {}
+    cluster = flagship_cluster()
+    peaks = roofline.chip_peaks(jax.default_backend())
+    for tname in ("nano", "orin"):
+        tier = dataclasses.replace(getattr(cluster, tname),
+                                   max_new_tokens=max_new,
+                                   enable_prefix_cache=False)
+        label = tier.model_preset + ("_int8" if tier.quantize == "int8"
+                                     else "")
+        print(f"[bench] flagship {label}", file=sys.stderr, flush=True)
+        try:
+            budget = tier_hbm_budget(tier)
+            entry = {k: budget[k] for k in ("params_gb_per_chip",
+                                            "kv_gb_per_chip",
+                                            "total_gb_per_chip", "fits")}
+            if not budget["fits"]:
+                entry["skipped"] = "over HBM budget"
+                out[label] = entry
+                continue
+            # The engine must realize the SAME layout the budget
+            # validated: tensor-sharded over a tp submesh when tp>1
+            # (unsharded orin_8b bf16 would OOM one chip), single-device
+            # otherwise.
+            mesh = None
+            if tier.tp > 1:
+                from distributed_llm_tpu.parallel.mesh import tp_mesh
+                devs = jax.devices()
+                if len(devs) < tier.tp:
+                    out[label] = {**entry,
+                                  "skipped": f"needs {tier.tp} devices, "
+                                             f"have {len(devs)}"}
+                    continue
+                mesh = tp_mesh(devs[:tier.tp], tier.tp)
+            params = None
+            if tier.quantize == "int8":
+                # Fuse init+quantize in ONE jit: XLA frees each bf16
+                # weight right after quantizing it, so the 14 GB bf16
+                # tree never fully materializes on the 16 GB chip.
+                from distributed_llm_tpu import models as _models
+                from distributed_llm_tpu.ops.quant import quantize_params
+                cfg = tier.model()
+                params = jax.jit(
+                    lambda: quantize_params(_models.init_params(cfg, 9)))()
+            engine = InferenceEngine(tier, seed=9, params=params, mesh=mesh)
+            del params
+            engine.generate("user: warm the flagship up",
+                            max_new_tokens=4)      # compile outside timing
+            rates, ttfts = [], []
+            for i in range(n_prompts):
+                res = engine.generate(
+                    f"user: flagship probe {i}: explain the chip's memory "
+                    "system in a few sentences.", max_new_tokens=max_new)
+                ttfts.append(res.ttft_ms)
+                if res.tokens_per_s:
+                    rates.append(res.tokens_per_s)
+            work = engine.phases.work_summary()
+            util = {ph: roofline.utilization(w, w["seconds"], peaks)
+                    for ph, w in work.items() if w.get("seconds")}
+            entry.update({
+                "decode_tok_per_s": (round(statistics.median(rates), 1)
+                                     if rates else None),
+                "p50_ttft_ms": round(statistics.median(ttfts), 2),
+                "mfu_prefill": (util.get("prefill") or {}).get("mfu"),
+                "hbm_util_decode": (util.get("decode") or {}).get("hbm_util"),
+            })
+            out[label] = entry
+            del engine
+        except Exception as exc:          # never lose the headline line
+            out[label] = {"error": str(exc)[:200]}
+    return out
+
+
 def run(progress: "Progress" = None) -> dict:
     # Attention path for the headline run.  All Pallas kernels (flash
     # prefill/chunk, paged + contiguous decode) compile and match XLA
@@ -428,16 +567,74 @@ def run(progress: "Progress" = None) -> dict:
         long_hist += [{"role": "assistant", "content": cold.text},
                       {"role": "user", "content": "and one more thing?"}]
         warm = eng.generate(long_hist, max_new_tokens=8)
+        # First follow-up may pay a one-off suffix-prefill compile (a
+        # fresh (suffix, window) shape); the second is steady state —
+        # report both so the O(delta) claim rests on the honest number.
+        long_hist += [{"role": "assistant", "content": warm.text},
+                      {"role": "user", "content": "and another?"}]
+        warm2 = eng.generate(long_hist, max_new_tokens=8)
+        best_warm = min(warm.ttft_ms, warm2.ttft_ms)
         long_context = {
             "prompt_tokens": cold.prompt_tokens,
             "cold_ttft_ms": round(cold.ttft_ms, 2),
             "followup_ttft_ms": round(warm.ttft_ms, 2),
+            "followup2_ttft_ms": round(warm2.ttft_ms, 2),
             "prefix_reuse_speedup": round(cold.ttft_ms /
-                                          max(warm.ttft_ms, 1e-6), 2),
+                                          max(best_warm, 1e-6), 2),
         }
     except Exception as exc:              # never lose the headline line
         long_context = {"error": str(exc)[:200]}
     progress.section("long_context", long_context)
+
+    # Orin multi-turn prefix reuse THROUGH the router (VERDICT r2 #6: the
+    # strategy sweep's sliding HISTORY_LIMIT window shifts the prompt
+    # head every turn, so the big tier's parked prefixes never match and
+    # the headline artifact showed orin 0 hits).  A short orin-routed
+    # conversation that stays inside the window is the shape prefix reuse
+    # serves — follow-up TTFT should be O(delta), not O(history).
+    try:
+        import sys
+        print("[bench] orin multi-turn prefix pass", file=sys.stderr,
+              flush=True)
+        router.query_router.change_strategy("heuristic")
+        orin_eng = router.tiers["orin"].server_manager.engine()
+        before = (orin_eng.prefix_cache.stats()
+                  if getattr(orin_eng, "prefix_cache", None) else
+                  {"hits": 0})
+        convo = []
+        turn_ttfts = []
+        for q in ("Please implement a function that merges two sorted "
+                  "lists and explain its complexity.",
+                  "Now refactor that implementation to be stable and "
+                  "discuss the trade-offs.",
+                  "Please analyze the algorithm's worst case in detail.",
+                  "Finally, implement a regression test function for it."):
+            convo.append({"role": "user", "content": q})
+            _, _, dev = router.route_query(convo[-HISTORY_LIMIT:])
+            progress.beat()
+            res = router.tiers[dev].last_result
+            convo.append({"role": "assistant",
+                          "content": res.text if res else ""})
+            turn_ttfts.append(round(res.ttft_ms, 2) if res else None)
+        after = (orin_eng.prefix_cache.stats()
+                 if getattr(orin_eng, "prefix_cache", None) else
+                 {"hits": 0})
+        orin_prefix = {
+            "turn_ttft_ms": turn_ttfts,
+            "prefix_hits": after.get("hits", 0) - before.get("hits", 0),
+            "followup_ttft_speedup": (
+                round(turn_ttfts[0] / max(min(turn_ttfts[1:]), 1e-6), 2)
+                if len(turn_ttfts) > 1 and all(turn_ttfts) else None),
+        }
+        # Refresh the recorded tier block so the artifact shows the big
+        # tier's prefix counters with this traffic included.
+        entry = engine_stats(orin_eng)
+        if entry and "orin" in phases:
+            phases["orin"]["prefix_cache"] = entry.get("prefix_cache")
+            progress.section("tiers", phases)
+    except Exception as exc:              # never lose the headline line
+        orin_prefix = {"error": str(exc)[:200]}
+    progress.section("orin_prefix", orin_prefix)
 
     # Free the sweep engines' HBM before the load test spins up its pool.
     for tier in router.tiers.values():
@@ -451,6 +648,16 @@ def run(progress: "Progress" = None) -> dict:
     features = features_phase(router.cluster)
     progress.section("speculative", features.get("speculative"))
     progress.section("quant", features.get("quant"))
+
+    # North-star-scale serving (VERDICT r2 #2b).  Skipped on the CPU
+    # fallback (a 1B model on one host core is not a measurement) unless
+    # explicitly forced.
+    import os
+    if backend != "cpu" or os.environ.get("DLLM_BENCH_FLAGSHIP") == "1":
+        flagship = flagship_phase()
+    else:
+        flagship = {"skipped": "cpu fallback backend"}
+    progress.section("flagship", flagship)
 
     return {
         "metric": "req_per_s_general_knowledge_all_strategies",
@@ -471,6 +678,8 @@ def run(progress: "Progress" = None) -> dict:
         "speculative": features.get("speculative"),
         "quant": features.get("quant"),
         "long_context": long_context,
+        "orin_prefix": orin_prefix,
+        "flagship": flagship,
         "tiers": phases,
     }
 
@@ -559,4 +768,8 @@ if __name__ == "__main__":
                                                   "900")))
     result = run(progress)
     progress.done.set()
-    print(json.dumps(result))
+    # Full detail on the first line (and in BENCH_partial.json); the
+    # LAST line stays compact so the driver's tail capture parses it
+    # (VERDICT r2 weak #2).
+    print(json.dumps(result), flush=True)
+    print(json.dumps(compact(result)))

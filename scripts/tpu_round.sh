@@ -1,15 +1,30 @@
 #!/bin/bash
-# Full TPU measurement sequence for a freshly healthy chip (round 2).
+# Full TPU measurement sequence for a freshly healthy chip (round 3).
 # Run exactly ONE instance; every step is a separate sequential claimant.
 # Never kill these processes mid-run — a killed claimant wedges the chip.
 cd /root/repo
 log=/tmp/tpu_round.log
 {
-  echo "=== tpu_round start $(date -u) ==="
+  echo "=== tpu_round start $(date -u) @ $(git rev-parse --short HEAD) ==="
 
-  # 1. Bench-tier pretrained checkpoints (VERDICT r1 #4 at bench scale).
-  #    Minutes on a v5e; --save-every leaves a resumable 'latest' if the
-  #    chip dies mid-run.  Local-only artifacts (gitignored by size).
+  # 0. Bench-tier checkpoints from an older vocabulary are unloadable
+  #    (round 3 moved the engine to the 4096-id subword BPE): clear any
+  #    stale ones so step 1 retrains at the current vocab.
+  python - <<'PY'
+import shutil
+from distributed_llm_tpu.config import MODEL_PRESETS
+from distributed_llm_tpu.utils.checkpoint import peek_vocab_size
+for preset in ("nano_bench", "orin_bench"):
+    path = f"checkpoints/{preset}"
+    v = peek_vocab_size(path)
+    if v is not None and v != MODEL_PRESETS[preset].vocab_size:
+        print(f"clearing stale-vocab checkpoint {path} (saved vocab {v})")
+        shutil.rmtree(path, ignore_errors=True)
+PY
+
+  # 1. Bench-tier pretrained checkpoints (VERDICT r2 #8).  Minutes on a
+  #    v5e; --save-every leaves a resumable 'latest' if the chip dies
+  #    mid-run.  Local-only artifacts (gitignored by size).
   if [ ! -L checkpoints/nano_bench/latest ]; then
     python -m distributed_llm_tpu.training.pretrain --preset nano_bench \
       --out checkpoints/nano_bench --batch-size 16 --seq-len 256 \
@@ -24,18 +39,22 @@ log=/tmp/tpu_round.log
   fi
 
   # 2. Per-kernel micro A/B on quiet hardware; publish the dispatch table
-  #    (VERDICT r1 #3).
+  #    (VERDICT r2 #4).  The writer refuses to clobber a table measured
+  #    on a different backend and emits per-kind "default" winners.
   python -m distributed_llm_tpu.bench.ab_kernels micro --tier orin \
     --repeat 20 --write-dispatch > /tmp/ab_micro_tpu.json 2>&1 \
     || echo "micro A/B failed"
 
-  # 3. Headline TPU bench (VERDICT r1 #1): partials checkpoint to
-  #    BENCH_partial.json; watchdog aborts with partials on a wedge.
+  # 3. Headline TPU bench (VERDICT r2 #1): prints full detail first and a
+  #    compact driver-parseable FINAL line; partials checkpoint to
+  #    BENCH_partial.json; the watchdog aborts with partials on a wedge.
+  #    Includes the flagship nano_1b / orin_8b-int8 phase and the orin
+  #    prefix-reuse pass (VERDICT r2 #2/#6).
   python bench.py > /tmp/BENCH_tpu.json 2> /tmp/bench_tpu.log \
     || echo "bench exited nonzero ($?)"
 
   # 4. Speculative-orin headline A/B (draft = nano model, greedy-exact):
-  #    decides whether the spec default flips next round.
+  #    decides whether the spec default flips (VERDICT r2 #5).
   DLLM_BENCH_SPEC_ORIN=1 python bench.py > /tmp/BENCH_tpu_spec.json \
     2> /tmp/bench_tpu_spec.log || echo "spec bench exited nonzero ($?)"
 

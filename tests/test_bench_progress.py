@@ -46,6 +46,45 @@ def test_watchdog_leaves_live_run_alone(tmp_path):
     assert True
 
 
+def test_compact_final_line_fits_driver_tail():
+    """BENCH_r02.json was an unparseable fragment: the final printed line
+    outgrew the driver's ~2 KB tail capture.  compact() must keep the
+    last line small while preserving the headline contract and the
+    roofline verdicts."""
+    result = {
+        "metric": "req_per_s_general_knowledge_all_strategies",
+        "value": 37.99, "unit": "req/s", "vs_baseline": 3477.0,
+        "p50_ttft_ms": 11.2, "p50_latency_ms": 25.0,
+        "routing_accuracy": 0.817, "decode_tok_per_s": 700.1,
+        "backend": "tpu", "queries": 60,
+        "utilization": {"prefill": {"mfu": 0.41, "tflops_per_s": 80.0},
+                        "decode": {"hbm_util": 0.62, "hbm_gb_per_s": 500.0}},
+        "per_strategy": {
+            s: {"req_per_s": 9.0, "p50_ttft_ms": 11.0,
+                "routing_accuracy": 0.83}
+            for s in ("token", "semantic", "heuristic", "hybrid", "perf")},
+        "continuous_batching": {"batching_speedup": 2.9,
+                                "kv_int8": {"speedup_vs_bf16_kv": 1.24}},
+        "speculative": {"speedup": 1.4, "acceptance_rate": 0.8},
+        "quant": {"nano": {"speedup": 1.6}, "orin": {"speedup": 1.7}},
+        "long_context": {"prefix_reuse_speedup": 8.2},
+        # Bulky blocks that must NOT survive into the final line:
+        "tiers": {"nano": {"phases": ["x" * 50] * 40}},
+        "flagship": {"nano_1b": {"decode_tok_per_s": 51.0,
+                                 "hbm_util": 0.7, "params_gb": 2.1}},
+    }
+    line = json.dumps(bench.compact(result))
+    assert len(line) < 1600, len(line)
+    data = json.loads(line)
+    assert data["value"] == 37.99 and data["unit"] == "req/s"
+    assert data["mfu_prefill"] == 0.41
+    assert data["hbm_util_decode"] == 0.62
+    assert data["verdicts"]["spec_speedup"] == 1.4
+    assert data["verdicts"]["quant_speedup"]["orin"] == 1.7
+    assert data["verdicts"]["flagship_decode_tok_per_s"]["nano_1b"] == 51.0
+    assert "tiers" not in data
+
+
 def test_watchdog_emits_partial_on_stall(tmp_path):
     """The stall path os._exit(3)s after printing the partial headline —
     exercised in a subprocess."""
