@@ -67,18 +67,13 @@ def decode_chunk(cfg, params, tokens: jax.Array, start_pos: jax.Array,
         k_cache = write(k_cache, k)
         v_cache = write(v_cache, v)
 
-        # Per-query ragged mask: query g attends cols <= pos[b, g].
-        s_max = k_cache.shape[1]
-        groups = cfg.num_heads // cfg.num_kv_heads
-        k_exp = jnp.repeat(k_cache, groups, axis=2)
-        v_exp = jnp.repeat(v_cache, groups, axis=2)
-        scale = d ** -0.5
-        logits = jnp.einsum("bgnd,bknd->bngk", q, k_exp
-                            ).astype(jnp.float32) * scale
-        valid = (jnp.arange(s_max)[None, None, :] <= pos[:, :, None])
-        logits = jnp.where(valid[:, None], logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(v_exp.dtype)
-        attn = jnp.einsum("bngk,bknd->bgnd", probs, v_exp)
+        # Per-query ragged attention (query g attends cols <= pos[b, g])
+        # through the dispatching chunk op: the verify chunk rides the
+        # same Pallas flash-chunk kernel as prefix-reuse suffix prefill
+        # on TPU (per the measured dispatch table), XLA elsewhere.
+        from ..ops import attention as attention_ops
+        attn = attention_ops.chunk(q, k_cache, v_cache, pos,
+                                   impl=cfg.attention_impl)
 
         x = x + quant.matmul(attn.reshape(b, g, cfg.num_heads * d), lp["wo"])
         x = x + transformer._swiglu(

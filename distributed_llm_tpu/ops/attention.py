@@ -175,7 +175,12 @@ def chunk(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         k_cache, v_cache = _dequant_cache(k_cache, v_cache, k_scale,
                                           v_scale, q.dtype)
         return chunk_attention(q, k_cache, v_cache, q_positions)
-    if _choose(impl, "chunk", k_cache.shape[1]) == "pallas":
+    # Sublane-unaligned chunk rows (e.g. the speculative verify's γ+1=5)
+    # would hand Mosaic a block shape no hardware run has validated — the
+    # micro A/B measures 'chunk' at bucket-sized rows only.  Keep those
+    # on XLA until a measured table covers them.
+    if (q.shape[1] % 8 == 0
+            and _choose(impl, "chunk", k_cache.shape[1]) == "pallas"):
         from .pallas_attention import flash_chunk_attention
         return flash_chunk_attention(q, k_cache, v_cache, q_positions)
     return chunk_attention(q, k_cache, v_cache, q_positions)

@@ -167,6 +167,7 @@ def test_resolve_impl(monkeypatch):
     (1, 64, 128, 4, 4, 16),     # MHA, one kv block
     (2, 64, 256, 4, 2, 32),     # GQA, multiple kv blocks
     (1, 128, 256, 8, 2, 16),    # multiple q blocks too
+    (1, 5, 256, 4, 2, 16),      # γ+1-row verify chunk (speculative.py)
 ])
 def test_flash_chunk_matches_xla(b, s_c, w, nq, nkv, d):
     ks = jax.random.split(jax.random.PRNGKey(3), 3)
