@@ -604,6 +604,7 @@ def run(progress: "Progress" = None) -> dict:
         convo = []
         turn_ttfts = []
         last_hist = None
+        last_dev = None
         for q in ("Please implement a function that merges two sorted "
                   "lists and explain its complexity.",
                   "Now refactor that implementation to be stable and "
@@ -613,6 +614,7 @@ def run(progress: "Progress" = None) -> dict:
             convo.append({"role": "user", "content": q})
             last_hist = list(convo[-HISTORY_LIMIT:])
             _, _, dev = router.route_query(last_hist)
+            last_dev = dev
             progress.beat()
             res = router.tiers[dev].last_result
             convo.append({"role": "assistant",
@@ -624,8 +626,11 @@ def run(progress: "Progress" = None) -> dict:
         # The honest reuse comparison: the LAST turn's warm TTFT vs a
         # cold replay of the same full history (prefix cache emptied) —
         # not turn 1 vs later turns, which also differ in prompt length.
+        # Only meaningful when the final turn really served on orin —
+        # otherwise the ratio would divide TTFTs of two different engines.
         cold_replay = None
-        if getattr(orin_eng, "prefix_cache", None) and turn_ttfts[-1]:
+        if (last_dev == "orin" and turn_ttfts[-1]
+                and getattr(orin_eng, "prefix_cache", None)):
             orin_eng.prefix_cache.clear()
             res = orin_eng.generate(last_hist, max_new_tokens=4)
             cold_replay = round(res.ttft_ms, 2)

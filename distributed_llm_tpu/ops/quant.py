@@ -13,9 +13,11 @@ weight's trailing (output) dimension and the model dtype, so dequantization
 is one cast + multiply.  Per-layer stacked weights [L, in, out] carry
 ``s: [L, 1, out]`` and slice cleanly through ``lax.scan``.
 
-Serving-only: the trainer always sees full-precision params, and sharded
-(tp>1) tiers skip quantization — parallel/sharding.py maps full-precision
-leaf paths (a quantized pytree would need its own PartitionSpec map).
+Serving-only: the trainer always sees full-precision params.  Sharded
+(tp>1) tiers quantize too — the quantized pytree has its own
+PartitionSpec map (parallel/sharding.py quantized_param_shardings: q
+sharded like the weight, scales unsharded on their size-1 contraction
+axis), so a tensor-parallel tier streams half the weight bytes per chip.
 """
 
 from __future__ import annotations
@@ -133,28 +135,24 @@ _QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 def maybe_quantize(params: Dict[str, Any], tier, cfg,
                    mesh=None) -> Dict[str, Any]:
     """Apply a tier's quantize mode with central validation — the one
-    entry point every engine uses, so modes and support guards can't drift.
-
-    Unknown modes raise; the one supported-but-inapplicable combination
-    (a sharded mesh) WARNS and serves full precision, so an operator who
-    asked for int8 can see in the logs that it did not take effect.
-    Dense and MoE families both quantize.
+    entry point every engine uses, so modes and support guards can't
+    drift.  Unknown modes raise.  Dense and MoE families both quantize,
+    sharded or not: on a tensor-parallel submesh the quantized tree is
+    placed by the quantized sharding rules
+    (parallel/sharding.quantized_param_shardings), so a tp tier streams
+    half the weight bytes PER CHIP — decode is weight-bandwidth-bound,
+    which is the entire point of int8 serving.
     """
-    import logging
-
     mode = getattr(tier, "quantize", "none")
     if mode == "none":
         return params
     if mode != "int8":
         raise ValueError(f"unknown quantize mode {mode!r} "
                          "(expected 'none' or 'int8')")
-    log = logging.getLogger(__name__)
     if mesh is not None:
-        log.warning(
-            "tier %s: quantize='int8' ignored — sharded tiers serve full "
-            "precision (sharding rules map full-precision leaf paths)",
-            getattr(tier, "name", "?"))
-        return params
+        from ..parallel.sharding import quantized_param_shardings
+        shardings = quantized_param_shardings(cfg, mesh)
+        return jax.jit(quantize_params, out_shardings=shardings)(params)
     return jax.jit(quantize_params)(params)
 
 

@@ -120,12 +120,59 @@ def train_param_shardings(cfg: ModelConfig, mesh: Mesh,
                                     train_param_specs(cfg, dp_axis, tp_axis))
 
 
+def quantized_param_specs(cfg: ModelConfig, tp_axis: str = "tp",
+                          ep_axis: str = "ep") -> Dict[str, Any]:
+    """PartitionSpec pytree matching ops.quant.quantize_params' output:
+    each quantized leaf becomes {"q": <weight spec>, "s": <weight spec
+    with the contraction axis unsharded — the scale is size 1 there>};
+    norms and the MoE router keep their serving specs."""
+    from ..ops.quant import _QUANT_LAYER_KEYS
+    specs = param_specs(cfg, tp_axis, ep_axis)
+
+    def qpair(spec: P, contract_axis: int) -> Dict[str, P]:
+        s_spec = list(spec)
+        s_spec[contract_axis] = None
+        return {"q": spec, "s": P(*s_spec)}
+
+    layers = dict(specs["layers"])
+    for k in _QUANT_LAYER_KEYS:
+        if k in layers:
+            layers[k] = qpair(layers[k], -2)
+    out = dict(specs)
+    out["layers"] = layers
+    out["embed"] = qpair(specs["embed"], -1)   # per-ROW scales [V, 1]
+    return out
+
+
+def quantized_param_shardings(cfg: ModelConfig, mesh: Mesh,
+                              tp_axis: str = "tp",
+                              shapes: Any = None) -> Dict[str, Any]:
+    """NamedSharding pytree for an int8-quantized params tree on a tier
+    mesh — int8 weight-only serving composes with tensor parallelism, so
+    a tp submesh streams HALF the weight bytes per chip per decode step
+    (decode is weight-bandwidth-bound; this is the whole point of int8).
+    ``shapes``: pass an existing eval_shape of the quantized tree to skip
+    re-tracing the init+quantize graph (hbm_budget already holds one)."""
+    if cfg.num_heads % mesh.shape[tp_axis] or cfg.num_kv_heads % mesh.shape[tp_axis]:
+        raise ValueError(
+            f"tp={mesh.shape[tp_axis]} must divide heads "
+            f"({cfg.num_heads}/{cfg.num_kv_heads}) for {cfg.name}")
+    if shapes is None:
+        from ..models import init_params
+        from ..ops.quant import quantize_params
+        shapes = jax.eval_shape(lambda: quantize_params(init_params(cfg, 0)))
+    return _shardings_with_fallback(cfg, mesh, quantized_param_specs(
+        cfg, tp_axis), shapes=shapes)
+
+
 def _shardings_with_fallback(cfg: ModelConfig, mesh: Mesh,
-                             specs: Dict[str, Any]) -> Dict[str, Any]:
+                             specs: Dict[str, Any],
+                             shapes: Any = None) -> Dict[str, Any]:
     """Map specs onto the mesh, dropping axes the mesh lacks or that don't
     divide the dimension they shard (tiny test models on wide meshes)."""
     from ..models import init_params
-    shapes = jax.eval_shape(lambda: init_params(cfg, seed=0))
+    if shapes is None:
+        shapes = jax.eval_shape(lambda: init_params(cfg, seed=0))
 
     def fix(spec: P, shaped) -> NamedSharding:
         dims = shaped.shape

@@ -59,7 +59,7 @@ def tier_hbm_budget(tier, devices: Optional[Sequence[jax.Device]] = None,
     chips = tp * max(1, tier.sp)
 
     # -- params (the serving engines' exact init + quantize pipeline) -----
-    quantized = tier.quantize == "int8" and tp == 1   # sharded tiers: bf16
+    quantized = tier.quantize == "int8"
     if quantized:
         shapes = jax.eval_shape(
             lambda: quantize_params(models.init_params(cfg, 0)))
@@ -72,9 +72,12 @@ def tier_hbm_budget(tier, devices: Optional[Sequence[jax.Device]] = None,
             raise ValueError(f"need {tp} devices to evaluate the tp "
                              f"sharding, have {len(devices)}")
         from ..parallel.mesh import tp_mesh
-        from ..parallel.sharding import param_shardings
+        from ..parallel.sharding import (param_shardings,
+                                         quantized_param_shardings)
         mesh = tp_mesh(list(devices)[:tp], tp)
-        params_gb = _sharded_tree_gb(shapes, param_shardings(cfg, mesh))
+        shardings = (quantized_param_shardings(cfg, mesh, shapes=shapes)
+                     if quantized else param_shardings(cfg, mesh))
+        params_gb = _sharded_tree_gb(shapes, shardings)
     else:
         params_gb = _tree_gb(shapes)
 
