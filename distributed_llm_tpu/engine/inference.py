@@ -211,6 +211,35 @@ class InferenceEngine:
             if tier.enable_prefix_cache and tier.prefix_cache_entries > 0
             else None)
 
+        # Sequence-parallel DECODE (parallel/sp_attention.py): keep the
+        # KV cache's sequence axis sharded over 'sp' so context capacity
+        # and per-chip KV streaming both scale with the sp degree (ring
+        # attention already covers prefill).  Dense bf16 caches only.
+        # The suffix/chunk prefix-reuse paths would regather the sharded
+        # cache per layer — exactly the buffer sp exists to split — so
+        # prefix reuse turns off on these tiers.
+        self._sp_shard = (mesh is not None
+                          and dict(mesh.shape).get("sp", 1) > 1
+                          and self.cfg.num_experts == 1
+                          and self._kv_quantize == "none")
+        self._cache_shardings = None
+        if self._sp_shard:
+            if self.prefix_cache is not None:
+                logger.info("tier %s: prefix cache disabled under "
+                            "sequence-parallel decode", tier.name)
+                self.prefix_cache = None
+            from ..parallel.sharding import kv_cache_shardings
+            self._cache_shardings = kv_cache_shardings(
+                mesh, sp_axis="sp")
+
+    def _constrain_cache(self, cache, cache_len: int):
+        """Pin the sequence-sharded cache layout (no-op otherwise)."""
+        if self._cache_shardings is None or cache_len % dict(
+                self.mesh.shape)["sp"]:
+            return cache
+        return jax.lax.with_sharding_constraint(cache,
+                                                self._cache_shardings)
+
     # ------------------------------------------------------------------
 
     def _init_params(self, seed: int) -> Dict[str, Any]:
@@ -286,7 +315,7 @@ class InferenceEngine:
 
             cache = transformer.seed_kv_cache(cfg, k_all, v_all, cache_len,
                                               self._kv_quantize)
-            return first, cache
+            return first, self._constrain_cache(cache, cache_len)
 
         fn = jax.jit(run)
         self._prefill_fns[key] = fn
@@ -300,7 +329,9 @@ class InferenceEngine:
             cfg = self.cfg
             kvq = self._kv_quantize
             self._grow_fns[key] = jax.jit(
-                lambda: transformer.init_kv_cache(cfg, 1, cache_len, kvq))
+                lambda: self._constrain_cache(
+                    transformer.init_kv_cache(cfg, 1, cache_len, kvq),
+                    cache_len))
         return self._grow_fns[key]
 
     def _long_prefill(self, ids, cache_len: int, rng, temp,
@@ -404,12 +435,19 @@ class InferenceEngine:
         eos = self.tokenizer.eos_id
         pad = self.tokenizer.pad_id
         max_new = self.tier.max_new_tokens   # static cap: sizes the buffer
-        # TP tiers: per-head-shard flash decode (frontier-clamped KV
-        # streaming) instead of the GSPMD XLA path; dense models only.
+        # Sequence-parallel tiers: partial+merge decode over the
+        # 'sp'-sharded cache (parallel/sp_attention.py).  TP-only tiers:
+        # per-head-shard flash decode (frontier-clamped KV streaming)
+        # instead of the GSPMD XLA path.  Dense models only.
         decode_kw = {}
         if cfg.num_experts == 1 and self._kv_quantize == "none":
-            from ..parallel.tp_attention import tp_decode_attn
-            hook = tp_decode_attn(self.mesh, cfg, cache_len)
+            hook = None
+            if self._sp_shard:
+                from ..parallel.sp_attention import sp_decode_attn
+                hook = sp_decode_attn(self.mesh, cfg, cache_len)
+            if hook is None:
+                from ..parallel.tp_attention import tp_decode_attn
+                hook = tp_decode_attn(self.mesh, cfg, cache_len)
             if hook is not None:
                 decode_kw["attn"] = hook
 
@@ -418,6 +456,7 @@ class InferenceEngine:
             # ``token_budget`` is a runtime operand (≤ max_new): per-request
             # num_predict overrides exit the loop early instead of decoding
             # the full tier cap and trimming on host.
+            cache = self._constrain_cache(cache, cache_len)
             b = first_token.shape[0]
             out = jnp.full((b, max_new), pad, jnp.int32)
             out = out.at[:, 0].set(first_token)

@@ -192,14 +192,18 @@ def _shardings_with_fallback(cfg: ModelConfig, mesh: Mesh,
 
 
 def kv_cache_specs(tp_axis: str = "tp",
-                   quantized: bool = False) -> Dict[str, P]:
+                   quantized: bool = False,
+                   sp_axis: str = None) -> Dict[str, P]:
     """KV cache [L, B, S, N_kv, D]: shard the kv-head axis over tp.  int8
-    caches carry {ks,vs: [L, B, S, N_kv]} scale planes, same sharding."""
-    spec = {"k": P(None, None, None, tp_axis, None),
-            "v": P(None, None, None, tp_axis, None)}
+    caches carry {ks,vs: [L, B, S, N_kv]} scale planes, same sharding.
+    ``sp_axis``: additionally shard the SEQUENCE axis — sequence-parallel
+    decode (parallel/sp_attention.py) keeps only S/sp cached positions
+    per chip, so a tier's context capacity scales with its sp degree."""
+    spec = {"k": P(None, None, sp_axis, tp_axis, None),
+            "v": P(None, None, sp_axis, tp_axis, None)}
     if quantized:
-        spec["ks"] = P(None, None, None, tp_axis)
-        spec["vs"] = P(None, None, None, tp_axis)
+        spec["ks"] = P(None, None, sp_axis, tp_axis)
+        spec["vs"] = P(None, None, sp_axis, tp_axis)
     return spec
 
 
@@ -225,9 +229,11 @@ def kv_pool_shardings(mesh: Mesh, tp_axis: str = "tp",
 
 
 def kv_cache_shardings(mesh: Mesh, tp_axis: str = "tp",
-                       quantized: bool = False) -> Dict[str, NamedSharding]:
+                       quantized: bool = False,
+                       sp_axis: str = None) -> Dict[str, NamedSharding]:
     return {k: NamedSharding(mesh, s)
-            for k, s in kv_cache_specs(tp_axis, quantized).items()}
+            for k, s in kv_cache_specs(tp_axis, quantized,
+                                       sp_axis=sp_axis).items()}
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

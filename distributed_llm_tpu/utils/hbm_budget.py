@@ -97,11 +97,18 @@ def tier_hbm_budget(tier, devices: Optional[Sequence[jax.Device]] = None,
         kvq = tier.kv_quantize if cfg.num_experts == 1 else "none"
         cache = jax.eval_shape(
             lambda: transformer.init_kv_cache(cfg, 1, cfg.max_seq_len, kvq))
-        kv_gb = _tree_gb(cache) / tp    # cache shards its kv-head axis
+        # The cache shards its kv-head axis over tp, and — under
+        # sequence-parallel decode (dense bf16 caches,
+        # parallel/sp_attention.py) — its sequence axis over sp.
+        sp_div = (tier.sp if tier.sp > 1 and cfg.num_experts == 1
+                  and kvq == "none" else 1)
+        kv_gb = _tree_gb(cache) / tp / sp_div
         # Each parked prefix-cache entry pins one full cache
-        # (engine/prefix_cache.py, TierConfig.prefix_cache_entries).
+        # (engine/prefix_cache.py, TierConfig.prefix_cache_entries) —
+        # except under sequence-parallel decode, where the engine
+        # disables prefix reuse (engine/inference.py _sp_shard).
         parked = (kv_gb * tier.prefix_cache_entries
-                  if tier.enable_prefix_cache else 0.0)
+                  if tier.enable_prefix_cache and sp_div == 1 else 0.0)
 
     total = params_gb + kv_gb + parked
     return {
