@@ -49,9 +49,17 @@ def _time_fn(fn, args, repeat: int) -> float:
 
 
 def micro_ab(tier_name: str = "orin", repeat: int = 20,
-             write_dispatch: bool = False) -> dict:
+             write_dispatch: bool = False, fast: bool = False,
+             beat=None) -> dict:
     """Direct kernel A/B at serving shapes; returns (and optionally
-    publishes) the per-(kind, length) winner table."""
+    publishes) the per-(kind, length) winner table.
+
+    ``fast`` trims the grid to the shapes the headline bench actually
+    serves (one mid-ladder length + the model max, batches 1/8) so the
+    A/B fits inside the bench run itself — the driver's round-end bench
+    can measure its own dispatch table on a freshly healthy chip instead
+    of serving un-dispatched.  ``beat`` is called after every case
+    (bench.py's wedge watchdog counts it as liveness)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -67,6 +75,9 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
     lengths = sorted({c for c in (256, 1024) if c < cfg.max_seq_len}
                      | {cfg.max_seq_len})
     batches = (1, 4, 8)
+    if fast:
+        lengths = sorted({min(1024, cfg.max_seq_len), cfg.max_seq_len})
+        batches = (1, 8)
     key = jax.random.PRNGKey(0)
     bf16 = jnp.bfloat16
     results: dict = {"backend": jax.default_backend(), "model": cfg.name,
@@ -98,6 +109,8 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
             case["pallas_error"] = err_p
         results["cases"].append(case)
         print(json.dumps(case), flush=True)
+        if beat is not None:
+            beat()
         slot = wins.setdefault(kind, {}).setdefault(str(length), [])
         # Pallas wins only if it ran AND beat a working XLA leg; a broken
         # XLA leg with working pallas also counts (something must run).

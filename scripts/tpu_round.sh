@@ -58,5 +58,21 @@ PY
   DLLM_BENCH_SPEC_ORIN=1 python bench.py > /tmp/BENCH_tpu_spec.json \
     2> /tmp/bench_tpu_spec.log || echo "spec bench exited nonzero ($?)"
 
+  # 5. Reference-CLI harness sweep ON CHIP (bench tiers, trained
+  #    checkpoints): the r2/r3 artifact sets were CPU-only.
+  mkdir -p bench/results_r3_tpu && ( cd bench/results_r3_tpu && \
+    python -m distributed_llm_tpu.bench.tester \
+      --query-set general_knowledge \
+      --strategies token semantic heuristic hybrid perf \
+      --cache-modes off on --thresholds 1000 \
+      --output-csv benchmark_results.csv \
+      --output-per-query-csv benchmark_per_query.csv \
+      > tester.log 2>&1 && \
+    python -m distributed_llm_tpu.bench.analysis \
+      --summary-csv benchmark_results.csv \
+      --per-query-csv benchmark_per_query.csv \
+      --output-md REPORT.md --plots-dir plots >> tester.log 2>&1 \
+  ) || echo "tpu tester sweep failed"
+
   echo "=== tpu_round done $(date -u) ==="
 } >> "$log" 2>&1
