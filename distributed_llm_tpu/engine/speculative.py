@@ -188,11 +188,10 @@ class SpeculativeEngine:
         self._prefill_fns[key] = fn
         return fn
 
-    def _spec_step(self):
-        """One speculative round, fully on device:
-        draft γ tokens → target verifies γ+1 positions → accept prefix."""
-        if self._spec_fn is not None:
-            return self._spec_fn
+    def _round_body(self):
+        """The traced speculative round shared by BOTH compiled paths
+        (the streaming per-round jit and the fused whole-generation
+        loop), so they cannot diverge."""
         cfg_t, cfg_d, gamma = self.cfg_t, self.cfg_d, self.gamma
 
         def run(params_t, params_d, cache_t, cache_d, cur, pos):
@@ -236,19 +235,155 @@ class SpeculativeEngine:
             new_pos = pos + n_acc + 1
             return out, n_acc, new_cur, new_pos, cache_t, cache_d
 
-        self._spec_fn = jax.jit(run)
+        return run
+
+    def _spec_step(self):
+        """One speculative round, fully on device:
+        draft γ tokens → target verifies γ+1 positions → accept prefix.
+        (The streaming path's unit of work — one host round trip per
+        round, so accepted tokens can yield as text deltas.)"""
+        if self._spec_fn is not None:
+            return self._spec_fn
+        self._spec_fn = jax.jit(self._round_body())
         return self._spec_fn
+
+    def _spec_loop(self, cache_len: int):
+        """The WHOLE speculative generation as one device call: a
+        ``lax.while_loop`` over rounds with emit/EOS/budget logic on
+        device.  The plain engine's decode is a single compiled loop —
+        paying a host↔device round trip per γ accepted tokens instead
+        was pure overhead (on a tunneled chip, dozens of extra RTTs per
+        reply), and is the non-streaming path's whole disadvantage.
+        ``token_budget`` is a runtime operand; compiled once per
+        cache_len like the plain decode loop."""
+        key = ("loop", cache_len)
+        if key in self._prefill_fns:
+            return self._prefill_fns[key]
+        gamma = self.gamma
+        eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
+        max_new = self.target.max_new_tokens
+        round_fn = self._round_body()
+
+        def run(params_t, params_d, cache_t, cache_d, first, prompt_len,
+                token_budget):
+            # out has γ+1 slack: a round writes its full window and only
+            # the kept prefix advances n_out (later rounds overwrite).
+            out = jnp.full((1, max_new + gamma + 1), pad, jnp.int32)
+            out = out.at[0, 0].set(first[0])
+            n_out = jnp.int32(1)
+            done = (first[0] == eos) | (first[0] == pad)
+            pos = prompt_len
+            state = (out, n_out, first, pos, cache_t, cache_d, done,
+                     jnp.int32(0), jnp.int32(0))
+
+            def cond(s):
+                _, n_out, _, pos, _, _, done, _, _ = s
+                return (~done & (n_out < token_budget)
+                        & (pos[0] + gamma + 1 < cache_len))
+
+            def body(s):
+                (out, n_out, cur, pos, cache_t, cache_d, done, rounds,
+                 accepted) = s
+                o, n_acc, cur, pos, cache_t, cache_d = round_fn(
+                    params_t, params_d, cache_t, cache_d, cur, pos)
+                emitted = o[0]                               # [γ+1]
+                take = jnp.minimum(n_acc[0] + 1, token_budget - n_out)
+                idx = jnp.arange(gamma + 1)
+                stop = (emitted == eos) | (emitted == pad)
+                in_take = idx < take
+                stop_any = jnp.any(stop & in_take)
+                stop_idx = jnp.min(jnp.where(stop & in_take, idx,
+                                             gamma + 1))
+                n_keep = jnp.minimum(take, stop_idx + 1)
+                out = jax.lax.dynamic_update_slice(out, o, (0, n_out))
+                n_out = n_out + n_keep
+                done = stop_any | (n_out >= token_budget)
+                return (out, n_out, cur, pos, cache_t, cache_d, done,
+                        rounds + 1, accepted + n_acc[0])
+
+            (out, n_out, _, _, _, _, _, rounds, accepted) = \
+                jax.lax.while_loop(cond, body, state)
+            return out, n_out, rounds, accepted
+
+        fn = jax.jit(run)
+        self._prefill_fns[key] = fn
+        return fn
 
     # -- host orchestration ------------------------------------------------
 
+    def _prepare_and_prefill(self, history, max_new_tokens):
+        """Shared front half of generate()/generate_stream(): tokenize,
+        clamp the budget, size both caches to the conversation (prompt +
+        decode budget + one speculative round of headroom — ADVICE r2:
+        the old flat max_seq allocation made every draft step and verify
+        compute over the full span), prefill both models, account the
+        roofline work.  Returns (first [1] device array, cache_t,
+        cache_d, cache_len, n, budget, ttft_ms, t0)."""
+        from ..utils import roofline
+        t0 = time.perf_counter()
+        ids, bucket = prepare_prompt(
+            self.tokenizer, history, self.target.prefill_buckets,
+            self._max_seq, self.target.max_new_tokens)
+        n = len(ids)
+        budget = self.target.max_new_tokens
+        if max_new_tokens and max_new_tokens > 0:
+            budget = min(budget, max_new_tokens)
+        needed = max(bucket, n + budget + self.gamma + 2)
+        cache_len = next(c for c in self._cache_lens
+                         if c >= min(needed, self._max_seq))
+
+        tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+        tokens[0, :n] = ids
+        with self.phases.phase("prefill"):
+            first, cache_t, cache_d = self._prefill_fn(bucket, cache_len)(
+                self.params_t, self.params_d, jnp.asarray(tokens),
+                jnp.asarray([n], np.int32))
+            first = jax.block_until_ready(first)
+        self.phases.add_work("prefill", **roofline.prefill_work(
+            self.cfg_t, bucket, 0, wbytes=self._wbytes_t))
+        self.phases.add_work("prefill", **roofline.prefill_work(
+            self.cfg_d, bucket, 0, wbytes=self._wbytes_d))
+        ttft_ms = (time.perf_counter() - t0) * 1000.0
+        return first, cache_t, cache_d, cache_len, n, budget, ttft_ms, t0
+
     def generate(self, history, max_new_tokens: Optional[int] = None,
                  temperature: Optional[float] = None) -> GenerationResult:
-        handle = self.generate_stream(history, max_new_tokens, temperature)
-        for _ in handle:          # drain; deltas are a byproduct here
-            pass
-        if handle.request.error is not None:
-            raise handle.request.error
-        return handle.request.result
+        """Non-streaming generation: prefill + ONE fused device call for
+        the whole speculative loop (_spec_loop) — same tokens as the
+        streaming path (both run _round_body), without its per-round
+        host round trips."""
+        if temperature:
+            raise NotImplementedError(
+                "speculative engine is greedy-only (reference default, "
+                "src/devices/nano_api.py:21)")
+        from ..utils import roofline
+        eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
+        (first, cache_t, cache_d, cache_len, n, budget, ttft_ms,
+         t0) = self._prepare_and_prefill(history, max_new_tokens)
+
+        with self.phases.phase("decode"):
+            out, n_out, rounds, accepted = self._spec_loop(cache_len)(
+                self.params_t, self.params_d, cache_t, cache_d, first,
+                jnp.asarray([n], np.int32), jnp.int32(budget))
+            out = np.asarray(jax.block_until_ready(out))[0]
+        rounds_i = int(rounds)
+        accepted_i = int(accepted)
+        self.phases.add_work("decode", **roofline.decode_work(
+            self.cfg_d, (self.gamma + 1) * rounds_i, cache_len,
+            wbytes=self._wbytes_d))
+        self.phases.add_work("decode", **roofline.decode_work(
+            self.cfg_t, rounds_i, cache_len, batch=self.gamma + 1,
+            wbytes=self._wbytes_t, kv_batch=1))
+        if rounds_i:
+            # Preserve acceptance_rate's mean exactly (per-round detail
+            # lives only on the streaming path).
+            self.accept_history.extend([accepted_i / rounds_i] * rounds_i)
+
+        gen_ids = trim_at_eos(out[:int(n_out)].tolist()[:budget], eos, pad)
+        return GenerationResult(
+            text=self.tokenizer.decode(gen_ids), token_ids=gen_ids,
+            prompt_tokens=n, gen_tokens=len(gen_ids), ttft_ms=ttft_ms,
+            total_ms=(time.perf_counter() - t0) * 1000.0)
 
     def generate_stream(self, history, max_new_tokens: Optional[int] = None,
                         temperature: Optional[float] = None):
@@ -270,35 +405,11 @@ class SpeculativeEngine:
             decoder = StreamDecoder(self.tokenizer)
             eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
             try:
-                t0 = time.perf_counter()
-                ids, bucket = prepare_prompt(
-                    self.tokenizer, history, self.target.prefill_buckets,
-                    self._max_seq, self.target.max_new_tokens)
-                n = len(ids)
-                budget = self.target.max_new_tokens
-                if max_new_tokens and max_new_tokens > 0:
-                    budget = min(budget, max_new_tokens)
-
-                # Size both caches to the conversation: prompt + decode
-                # budget + one full speculative round of headroom.
-                needed = max(bucket, n + budget + self.gamma + 2)
-                cache_len = next(c for c in self._cache_lens
-                                 if c >= min(needed, self._max_seq))
-
-                tokens = np.full((1, bucket), pad, np.int32)
-                tokens[0, :n] = ids
                 from ..utils import roofline
-                with self.phases.phase("prefill"):
-                    first, cache_t, cache_d = self._prefill_fn(
-                        bucket, cache_len)(
-                        self.params_t, self.params_d, jnp.asarray(tokens),
-                        jnp.asarray([n], np.int32))
-                    first = int(jax.block_until_ready(first)[0])
-                self.phases.add_work("prefill", **roofline.prefill_work(
-                    self.cfg_t, bucket, 0, wbytes=self._wbytes_t))
-                self.phases.add_work("prefill", **roofline.prefill_work(
-                    self.cfg_d, bucket, 0, wbytes=self._wbytes_d))
-                ttft_ms = (time.perf_counter() - t0) * 1000.0
+                (first_arr, cache_t, cache_d, cache_len, n, budget,
+                 ttft_ms, t0) = self._prepare_and_prefill(history,
+                                                          max_new_tokens)
+                first = int(first_arr[0])
 
                 out_tokens = [first]
                 if first not in (eos, pad):
@@ -364,5 +475,11 @@ class SpeculativeEngine:
         return float(np.mean(self.accept_history)) / self.gamma
 
     def warmup(self) -> None:
+        # Compile BOTH compiled paths: the fused loop (generate) and the
+        # per-round step (generate_stream) are separate jits — real
+        # traffic prefers streaming (serving/tiers.py process_stream),
+        # and its first request must not pay the round compile.
         self.generate("warmup", max_new_tokens=self.gamma + 2)
+        for _ in self.generate_stream("warmup", max_new_tokens=self.gamma):
+            pass
         self.accept_history.clear()   # don't skew acceptance_rate

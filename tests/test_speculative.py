@@ -152,3 +152,29 @@ def test_speculative_stream_matches_generate():
     text = "".join(handle)
     assert text == ref.text
     assert handle.result.token_ids == ref.token_ids
+
+
+def test_fused_loop_matches_streaming_tokens():
+    """generate() (one fused while_loop device call) and generate_stream()
+    (one device call per round) must emit identical tokens — both are
+    built on _round_body, and the fused emit/EOS/budget logic has to
+    mirror the streaming host loop exactly."""
+    import dataclasses
+
+    from distributed_llm_tpu.config import default_checkpoint, tiny_cluster
+
+    tgt = dataclasses.replace(tiny_cluster().orin, tp=1, temperature=0.0,
+                              checkpoint_path=default_checkpoint("orin_test"))
+    dr = dataclasses.replace(tiny_cluster().nano, name="draft",
+                             temperature=0.0)
+    se = SpeculativeEngine(tgt, dr, gamma=3, seed=2)
+    for prompt, mx in [("user: what is the largest ocean?", 12),
+                       ("user: hi", 4),
+                       ("user: name a mountain and a river and explain "
+                        "both in a sentence", 8)]:
+        g = se.generate(prompt, max_new_tokens=mx)
+        h = se.generate_stream(prompt, max_new_tokens=mx)
+        for _ in h:
+            pass
+        assert g.token_ids == h.request.result.token_ids, prompt
+        assert g.gen_tokens <= mx
