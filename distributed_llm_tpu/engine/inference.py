@@ -573,14 +573,21 @@ class InferenceEngine:
                 else:
                     tokens = np.full((1, sb), self.tokenizer.pad_id, np.int32)
                     tokens[0, :len(suffix)] = suffix
-                    window = min(self._suffix_window(m + sb), cache_len)
-                    first, cache = self._suffix_prefill_fn(sb, window)(
+                    # The suffix attends over the WHOLE allocated cache
+                    # (window == cache_len): a tighter bucketed window
+                    # would save only one decode-step's worth of reads
+                    # while multiplying the compiled-program count per
+                    # (sb, window, cache_len) combination — mid-chat XLA
+                    # compiles cost seconds (tens on chip), so suffix
+                    # shapes are (sb, cache_len) and warmup can cover
+                    # them all.
+                    first, cache = self._suffix_prefill_fn(sb, cache_len)(
                         self.params, cache0, jnp.asarray(tokens),
                         jnp.asarray([m], np.int32), jnp.asarray(true_len),
                         rng1, temp)
-                    # sb computed queries over the bucketed `window` span.
-                    pwork = roofline.prefill_work(self.cfg, window,
-                                                  window - sb,
+                    # sb computed queries over the allocated span.
+                    pwork = roofline.prefill_work(self.cfg, cache_len,
+                                                  cache_len - sb,
                                                   wbytes=self._wbytes)
             elif is_long:        # beyond the largest bucket: chunked stride
                 first, cache = self._long_prefill(ids, cache_len, rng1, temp)
@@ -766,6 +773,7 @@ class InferenceEngine:
         # rung of `bucket` or of `bucket + cap` — compile BOTH ends (the
         # range spans at most those rungs for any cap below the ladder
         # gap), plus each length's decode program.
+        warm_caches = {}
         for bucket in self._buckets:
             for cache_len in {self._pick_cache_len(bucket),
                               self._pick_cache_len(bucket + cap)}:
@@ -776,29 +784,62 @@ class InferenceEngine:
                     jnp.asarray([1], np.int32), jax.random.PRNGKey(0),
                     jnp.float32(0.0))
                 if fresh or cache_len not in self._decode_fns:
-                    out, _, _ = self._decode_loop(cache_len)(
+                    # NB the decode loop DONATES the cache: keep the one
+                    # it returns, not the prefill's (now-deleted) buffers.
+                    out, _, cache = self._decode_loop(cache_len)(
                         self.params, cache, jnp.asarray([0], np.int32),
                         jnp.asarray([1], np.int32), jax.random.PRNGKey(0),
                         jnp.float32(0.0), jnp.int32(1))
                     jax.block_until_ready(out)
                 else:
                     jax.block_until_ready(first)
+                warm_caches.setdefault(cache_len, cache)
         if self.prefix_cache is not None:
+            # Suffix programs are keyed (sb, cache_len) — window is always
+            # the allocated span — so the two typical-chat-turn suffix
+            # buckets × the cache rungs such conversations use cover the
+            # multi-turn hot path completely (no mid-chat compiles).
             for sb in self._buckets[:2]:
-                # A short-history hit's window is the bucket above the
-                # suffix bucket (prefix m + suffix sb rounds up one step),
-                # against the cache length such a conversation would use.
-                window = self._suffix_window(sb + 1)
-                cache_len = self._pick_cache_len(max(sb + 1 + cap, window))
-                cache = transformer.init_kv_cache(self.cfg, 1, cache_len,
-                                                  self._kv_quantize)
-                first, _ = self._suffix_prefill_fn(
-                    sb, min(window, cache_len))(
-                    self.params, cache,
-                    jnp.full((1, sb), self.tokenizer.pad_id, jnp.int32),
-                    jnp.asarray([0], jnp.int32), jnp.asarray([1], jnp.int32),
-                    jax.random.PRNGKey(0), jnp.float32(0.0))
-                jax.block_until_ready(first)
+                # Every rung a conversation with this suffix bucket can
+                # grow into (≤3 on the shipped ladder) — a rung skipped
+                # here is a mid-chat compile stall later.
+                floor = self._pick_cache_len(sb + 1 + cap)
+                for cache_len in [c for c in self._cache_lens
+                                  if c >= floor]:
+                    # Warm with a cache the ENGINE itself produced (the
+                    # bucket loop's): serving always passes a parked
+                    # jit-output cache — committed, placed on the tier's
+                    # devices/mesh — and jit keys compilations on exactly
+                    # that placement signature.  Warming with a
+                    # hand-built cache compiles a signature serving never
+                    # uses, and the real one then compiles mid-chat
+                    # (seconds; tens of seconds on chip).
+                    cache = warm_caches.get(cache_len)
+                    if cache is None:
+                        # Rung not minted by the bucket loop: produce one
+                        # the same way serving does (placement signature
+                        # must match — see above).
+                        _, cache = self._prefill_fn(
+                            self._buckets[0], cache_len)(
+                            self.params,
+                            jnp.full((1, self._buckets[0]),
+                                     self.tokenizer.pad_id, jnp.int32),
+                            jnp.asarray([1], np.int32),
+                            jax.random.PRNGKey(0), jnp.float32(0.0))
+                    # The suffix program donates its cache on TPU: keep
+                    # the returned one so the next rung/bucket can reuse
+                    # it.
+                    first, cache = self._suffix_prefill_fn(sb, cache_len)(
+                        self.params, cache,
+                        jnp.full((1, sb), self.tokenizer.pad_id, jnp.int32),
+                        jnp.asarray([0], jnp.int32),
+                        jnp.asarray([1], jnp.int32),
+                        jax.random.PRNGKey(0), jnp.float32(0.0))
+                    warm_caches[cache_len] = cache
+                    jax.block_until_ready(first)
+        # Free the pinned rung caches before the chunked-long block
+        # allocates its own max-rung cache (transient-HBM headroom).
+        warm_caches.clear()
         if self._buckets and self._buckets[-1] < self._max_seq:
             # Chunked-long-prefill programs: the largest-bucket chunk at
             # every window rung a max-length prompt walks through, plus
