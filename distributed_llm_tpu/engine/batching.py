@@ -162,6 +162,26 @@ class ContinuousBatchingEngine:
         self._decode_fn = None
         self._buckets = sorted(set(
             b for b in tier.prefill_buckets if b <= self.cfg.max_seq_len))
+        # Suffix-chunk attention windows use a COARSE rung set (same
+        # philosophy as the sequential engine's cache ladder): the chunk
+        # runs once per admission, so a wider gather costs one extra
+        # decode-tick's worth of reads, while a fine ladder multiplies
+        # compiled (sb, window) programs past what warmup can cover —
+        # each miss is a mid-chat XLA trace on the admit path.  The
+        # decode tick keeps the FINE bucket ladder (its gather runs every
+        # tick, where window width is real bandwidth).
+        span = self.paged.blocks_per_slot * self.paged.block_size
+        bs = self.paged.block_size
+        self._chunk_windows = sorted(
+            {min(span, -(-c // bs) * bs)          # block-aligned rungs
+             for c in (256, 1024) if c < span} | {span})
+        # Suffix buckets an admit will REUSE a prefix for: the first
+        # three rungs cover typical chat turns; a longer new turn goes
+        # through the (warmed) cold-prefill path instead of minting ever
+        # more (sb, window) chunk programs.  Together with the coarse
+        # window rungs this makes the warm set exhaustive — a prefix-hit
+        # admission can never trace mid-chat.
+        self._reuse_buckets = self._buckets[:3]
 
         # Session prefix reuse over pool blocks: a finished request's
         # prompt blocks are parked (ownership moves to the store) and a
@@ -342,7 +362,8 @@ class ContinuousBatchingEngine:
         # the chunk overwrites its own positions and stale entry KV past
         # n-1 is masked).
         from .prefix_cache import select_reuse
-        reused = select_reuse(self.prefix_cache, ids, self._buckets, max_seq)
+        reused = select_reuse(self.prefix_cache, ids, self._reuse_buckets,
+                              max_seq)
 
         self._rng, rng = jax.random.split(self._rng)
         temp = (self.tier.temperature if req.temperature is None
@@ -367,7 +388,8 @@ class ContinuousBatchingEngine:
                 row = self._table_row(owned)
                 tokens = np.full((1, sb), self.tokenizer.pad_id, np.int32)
                 tokens[0, :len(suffix)] = suffix
-                window = self._suffix_window(m + sb)
+                window = next(w for w in self._chunk_windows
+                              if w >= m + sb)
                 with self.phases.phase("prefill"):
                     first, self.pool = self._chunk_prefill_fn(sb, window)(
                         self.params, self.pool, jnp.asarray(tokens),
@@ -655,15 +677,21 @@ class ContinuousBatchingEngine:
         self.generate("warmup", max_new_tokens=2)
         if self.prefix_cache is not None and self._buckets:
             row = self._table_row([])
-            for sb in self._buckets[:2]:
-                window = self._suffix_window(sb + 1)
-                self._rng, rng = jax.random.split(self._rng)
-                first, self.pool = self._chunk_prefill_fn(sb, window)(
-                    self.params, self.pool,
-                    jnp.full((1, sb), self.tokenizer.pad_id, jnp.int32),
-                    jnp.asarray([0], np.int32), jnp.asarray([1], np.int32),
-                    jnp.asarray(row), rng, jnp.float32(0.0))
-                jax.block_until_ready(first)
+            # Every (reuse suffix bucket, chunk window rung) an admit
+            # can hit — the coarse ladders keep this product small enough
+            # to warm completely (no mid-chat admit compiles).
+            for sb in self._reuse_buckets:
+                for window in self._chunk_windows:
+                    if window < sb + 1:
+                        continue
+                    self._rng, rng = jax.random.split(self._rng)
+                    first, self.pool = self._chunk_prefill_fn(sb, window)(
+                        self.params, self.pool,
+                        jnp.full((1, sb), self.tokenizer.pad_id, jnp.int32),
+                        jnp.asarray([0], np.int32),
+                        jnp.asarray([1], np.int32),
+                        jnp.asarray(row), rng, jnp.float32(0.0))
+                    jax.block_until_ready(first)
 
 
 class StreamHandle:

@@ -168,6 +168,13 @@ class InferenceEngine:
         # a long new turn should chunk-stride (O(delta), warmed programs),
         # not pad out to a giant unsharded suffix prefill.
         self._suffix_buckets = list(self._buckets)
+        # Suffix buckets a prompt will REUSE a parked prefix through: the
+        # first three rungs cover typical chat turns, and warmup compiles
+        # every (reuse bucket, cache rung) suffix program — a prefix-hit
+        # turn can never trace mid-chat.  Longer new turns take the
+        # (warmed) chunk-stride path via allow_long_suffix instead of
+        # minting ever more suffix shapes.
+        self._reuse_buckets = self._buckets[:3]
         if (mesh is not None and dict(mesh.shape).get("sp", 1) > 1
                 and self.cfg.num_experts == 1
                 and self._buckets and self._buckets[-1] < self._max_seq):
@@ -533,7 +540,7 @@ class InferenceEngine:
         # instead of O(history) — the reference re-prefills everything
         # through Ollama every turn, SURVEY.md §3.1).
         from .prefix_cache import select_reuse
-        sel = select_reuse(self.prefix_cache, ids, self._suffix_buckets,
+        sel = select_reuse(self.prefix_cache, ids, self._reuse_buckets,
                            self._max_seq, allow_long_suffix=True)
         reused = (sel[0].cache, sel[1], sel[2], sel[3]) if sel else None
 
@@ -799,7 +806,7 @@ class InferenceEngine:
             # the allocated span — so the two typical-chat-turn suffix
             # buckets × the cache rungs such conversations use cover the
             # multi-turn hot path completely (no mid-chat compiles).
-            for sb in self._buckets[:2]:
+            for sb in self._reuse_buckets:
                 # Every rung a conversation with this suffix bucket can
                 # grow into (≤3 on the shipped ladder) — a rung skipped
                 # here is a mid-chat compile stall later.
