@@ -475,11 +475,45 @@ class SpeculativeEngine:
         return float(np.mean(self.accept_history)) / self.gamma
 
     def warmup(self) -> None:
-        # Compile BOTH compiled paths: the fused loop (generate) and the
-        # per-round step (generate_stream) are separate jits — real
-        # traffic prefers streaming (serving/tiers.py process_stream),
-        # and its first request must not pay the round compile.
+        # Compile BOTH compiled paths — the fused loop (generate) and the
+        # per-round step (generate_stream) are separate jits, and real
+        # traffic prefers streaming (serving/tiers.py process_stream) —
+        # at EVERY cache rung a conversation can grow into, so no request
+        # ever pays a mid-serve trace of the speculative graph.
         self.generate("warmup", max_new_tokens=self.gamma + 2)
         for _ in self.generate_stream("warmup", max_new_tokens=self.gamma):
             pass
+        # Every (bucket, cache rung) pair _prepare_and_prefill can pick —
+        # same two-rung-per-bucket coverage as InferenceEngine.warmup —
+        # plus, once per rung, both speculative graphs (the fused loop and
+        # the streaming round retrace per cache shape).  Nothing here
+        # donates, so one prefill's outputs serve both graph warms.
+        def pick(needed):
+            return next(c for c in self._cache_lens
+                        if c >= min(needed, self._max_seq))
+        cap = self.target.max_new_tokens + self.gamma + 2
+        buckets = sorted(set(b for b in self.target.prefill_buckets
+                             if b <= self._max_seq))
+        done_rungs = set()
+        one = jnp.asarray([1], np.int32)
+        for bucket in buckets:
+            tokens = jnp.full((1, bucket), self.tokenizer.pad_id, jnp.int32)
+            for cache_len in {pick(bucket), pick(bucket + cap)}:
+                if cache_len < bucket:       # unreachable by serving
+                    continue
+                first, cache_t, cache_d = self._prefill_fn(
+                    bucket, cache_len)(self.params_t, self.params_d,
+                                       tokens, one)
+                if cache_len in done_rungs:
+                    jax.block_until_ready(first)
+                    continue
+                done_rungs.add(cache_len)
+                out, *_ = self._spec_loop(cache_len)(
+                    self.params_t, self.params_d, cache_t, cache_d, first,
+                    one, jnp.int32(1))
+                jax.block_until_ready(out)
+                out, *_ = self._spec_step()(
+                    self.params_t, self.params_d, cache_t, cache_d,
+                    first, one)
+                jax.block_until_ready(out)
         self.accept_history.clear()   # don't skew acceptance_rate
