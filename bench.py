@@ -340,9 +340,12 @@ def flagship_phase(max_new: int = 48, n_prompts: int = 3) -> dict:
     cluster = flagship_cluster()
     peaks = roofline.chip_peaks(jax.default_backend())
     for tname in ("nano", "orin"):
+        # nano keeps its prefix cache: its long-context leg measures a
+        # prefix-reused follow-up at 8k context.  orin-int8 serves with
+        # reuse off so the 16 GB budget leg stays lean.
         tier = dataclasses.replace(getattr(cluster, tname),
                                    max_new_tokens=max_new,
-                                   enable_prefix_cache=False)
+                                   enable_prefix_cache=(tname == "nano"))
         label = tier.model_preset + ("_int8" if tier.quantize == "int8"
                                      else "")
         print(f"[bench] flagship {label}", file=sys.stderr, flush=True)
@@ -401,6 +404,46 @@ def flagship_phase(max_new: int = 48, n_prompts: int = 3) -> dict:
                 "mfu_prefill": (util.get("prefill") or {}).get("mfu"),
                 "hbm_util_decode": (util.get("decode") or {}).get("hbm_util"),
             })
+            if tname == "nano":
+                # Long context at flagship scale: a near-max_seq (8k)
+                # prompt — cold TTFT, prefill MFU over that call, and a
+                # prefix-reused follow-up (nano_1b only; orin-int8 skips
+                # it to keep the 16 GB chip's leg short).
+                try:
+                    tok = engine.tokenizer
+                    max_seq = engine.cfg.max_seq_len
+                    margin = max_seq // 8 + max_new
+                    filler = ("fact: the quick brown fox jumps over the "
+                              "lazy dog. " * (max_seq // 8))
+                    ids = tok.encode(filler, add_bos=False)
+                    prompt = tok.decode(ids[:max_seq - margin])
+                    hist = [{"role": "user", "content": prompt}]
+                    from distributed_llm_tpu.utils.telemetry import \
+                        PhaseTimer
+                    engine.phases = PhaseTimer()   # isolate this call
+                    cold = engine.generate(hist, max_new_tokens=8)
+                    lw = engine.phases.work_summary().get("prefill", {})
+                    lutil = (roofline.utilization(lw, lw["seconds"], peaks)
+                             if lw.get("seconds") else {})
+                    # Two follow-ups: the first may pay the one-off
+                    # suffix-shape compile (these engines skip the full
+                    # warmup — compiling a 1B model's whole program set
+                    # costs minutes); the second is steady state.
+                    hist += [{"role": "assistant", "content": cold.text},
+                             {"role": "user", "content": "and?"}]
+                    warm = engine.generate(hist, max_new_tokens=8)
+                    hist += [{"role": "assistant", "content": warm.text},
+                             {"role": "user", "content": "and more?"}]
+                    warm2 = engine.generate(hist, max_new_tokens=8)
+                    entry["long_context"] = {
+                        "prompt_tokens": cold.prompt_tokens,
+                        "cold_ttft_ms": round(cold.ttft_ms, 2),
+                        "followup_ttft_ms": [round(warm.ttft_ms, 2),
+                                             round(warm2.ttft_ms, 2)],
+                        "mfu_prefill": lutil.get("mfu"),
+                    }
+                except Exception as exc:
+                    entry["long_context"] = {"error": str(exc)[:160]}
             out[label] = entry
             del engine
         except Exception as exc:          # never lose the headline line
