@@ -388,8 +388,11 @@ def flagship_phase(max_new: int = 48, n_prompts: int = 3) -> dict:
                             max_new_tokens=4)      # compile outside timing
             rates, ttfts = [], []
             for i in range(n_prompts):
+                # Head-varied so the probes can never prefix-match each
+                # other (nano keeps its cache ON for the long-context
+                # leg; these must stay COLD prefills).
                 res = engine.generate(
-                    f"user: flagship probe {i}: explain the chip's memory "
+                    f"{i} flagship probe: explain the chip's memory "
                     "system in a few sentences.", max_new_tokens=max_new)
                 ttfts.append(res.ttft_ms)
                 if res.tokens_per_s:

@@ -164,6 +164,18 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
         record("chunk", s, A.chunk_attention, (q, kc, vc, qpos),
                PA.flash_chunk_attention, (q, kc, vc, qpos), {"chunk": sc})
 
+        # int8-cache chunk: XLA dequant view vs the in-VMEM q8 kernel.
+        kq, ksc = _qkv(kc)
+        vq, vsc = _qkv(vc)
+        record("chunk_q8", s,
+               lambda *a: A.chunk(a[0], a[1], a[2], a[5], impl="xla",
+                                  k_scale=a[3], v_scale=a[4]),
+               (q, kq, vq, ksc.astype(jnp.float32),
+                vsc.astype(jnp.float32), qpos),
+               PA.flash_chunk_attention_q8,
+               (q, kq, vq, ksc.astype(jnp.float32),
+                vsc.astype(jnp.float32), qpos), {"chunk": sc})
+
         # paged decode: pool sized for 8 slots of this length
         bs = 64
         for b in batches[1:]:

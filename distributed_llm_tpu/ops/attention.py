@@ -171,16 +171,21 @@ def chunk(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     the same kernel family on TPU (flash recurrence, per-query frontier);
     the XLA path is the portable/shardable fallback — and the only path
     for int8 caches (scales given)."""
+    # Sublane-unaligned chunk rows (e.g. the speculative verify's γ+1=5)
+    # would hand Mosaic a block shape no hardware run has validated — the
+    # micro A/B measures the chunk kinds at bucket-sized rows only.  Keep
+    # those on XLA until a measured table covers them.
+    aligned = q.shape[1] % 8 == 0
     if k_scale is not None:
+        if (aligned
+                and _choose(impl, "chunk_q8", k_cache.shape[1]) == "pallas"):
+            from .pallas_attention import flash_chunk_attention_q8
+            return flash_chunk_attention_q8(q, k_cache, v_cache, k_scale,
+                                            v_scale, q_positions)
         k_cache, v_cache = _dequant_cache(k_cache, v_cache, k_scale,
                                           v_scale, q.dtype)
         return chunk_attention(q, k_cache, v_cache, q_positions)
-    # Sublane-unaligned chunk rows (e.g. the speculative verify's γ+1=5)
-    # would hand Mosaic a block shape no hardware run has validated — the
-    # micro A/B measures 'chunk' at bucket-sized rows only.  Keep those
-    # on XLA until a measured table covers them.
-    if (q.shape[1] % 8 == 0
-            and _choose(impl, "chunk", k_cache.shape[1]) == "pallas"):
+    if aligned and _choose(impl, "chunk", k_cache.shape[1]) == "pallas":
         from .pallas_attention import flash_chunk_attention
         return flash_chunk_attention(q, k_cache, v_cache, q_positions)
     return chunk_attention(q, k_cache, v_cache, q_positions)

@@ -235,6 +235,107 @@ def flash_chunk_attention(q: jax.Array, k_cache: jax.Array,
     return out.transpose(0, 2, 1, 3)
 
 
+def _chunk_kernel_q8(pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                     acc_ref, m_ref, l_ref, *, bq: int, bk: int,
+                     scale: float):
+    """int8 twin of _chunk_kernel, tiled over the window like
+    _decode_kernel_q8 (grid B × Nq × S_c/bq × W/bk with flash scratch):
+    each step DMAs one int8 [bk, D] K/V tile plus its [bk, 1] scale
+    column and dequantizes in VMEM.  Blocked scales matter: a (w, 1)
+    resident plane would lane-pad ~128× in VMEM and dwarf the bytes the
+    int8 halving saves at long windows."""
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    nb = pl.num_programs(3)
+    start = pos_ref[b, 0]
+    row_pos = start + i * bq + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, 1), 0)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale              # [BQ, D]
+    k = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]       # [BK, D]
+    v = v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bk
+    s = jnp.where(col <= row_pos, s, NEG_INF)
+
+    m_prev, l_prev = m_ref[...], l_ref[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    m_ref[...] = m_new
+    l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+        p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(j == nb - 1)
+    def _done():
+        o_ref[0, 0] = (acc_ref[...] /
+                       jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def flash_chunk_attention_q8(q: jax.Array, k_cache: jax.Array,
+                             v_cache: jax.Array, k_scale: jax.Array,
+                             v_scale: jax.Array,
+                             q_positions: jax.Array) -> jax.Array:
+    """``flash_chunk_attention`` over an int8 contiguous cache
+    (TierConfig.kv_quantize): caches [B,W,Nkv,D] int8, scales [B,W,Nkv]
+    f32.  Same contiguous-positions contract as the bf16 kernel; the XLA
+    fallback dequantizes a full-window view instead."""
+    b, s_c, nq, d = q.shape
+    w, nkv = k_cache.shape[1], k_cache.shape[2]
+    groups = nq // nkv
+    bq = min(s_c, 128)
+    bk = min(w, 128)
+    if s_c % bq or w % bk:
+        raise ValueError(
+            f"flash_chunk_attention_q8: chunk {s_c} / window {w} not "
+            f"multiples of the ({bq}, {bk}) blocks — use power-of-two "
+            "buckets")
+
+    qh = q.transpose(0, 2, 1, 3)                             # [B, Nq, S_c, D]
+    kh = k_cache.transpose(0, 2, 1, 3)                       # [B, Nkv, W, D]
+    vh = v_cache.transpose(0, 2, 1, 3)
+    ksh = k_scale.astype(jnp.float32).transpose(0, 2, 1)[..., None]
+    vsh = v_scale.astype(jnp.float32).transpose(0, 2, 1)[..., None]
+    start32 = q_positions[:, :1].astype(jnp.int32)           # [B, 1] scalars
+
+    kernel = functools.partial(_chunk_kernel_q8, bq=bq, bk=bk,
+                               scale=d ** -0.5)
+    kv_idx = lambda b_, h, i, j: (b_, h // groups, j, 0)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, nq, s_c // bq, w // bk),
+        in_specs=[
+            pl.BlockSpec((b, 1), lambda b_, h, i, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h, i, j: (b_, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d), kv_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d), kv_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, 1), kv_idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, 1), kv_idx, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda b_, h, i, j: (b_, h, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(start32, qh, kh, vh, ksh, vsh)
+    return out.transpose(0, 2, 1, 3)
+
+
 # =============================================================================
 # Paged chunk prefill: suffix queries against table blocks of the KV pool
 # =============================================================================

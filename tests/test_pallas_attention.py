@@ -264,3 +264,32 @@ def test_batched_engine_generates_identically_on_pallas_paged_path(monkeypatch):
         finally:
             eng.stop()
     assert outs["xla"] == outs["pallas"]
+
+
+@pytest.mark.parametrize("b,s_c,w,nq,nkv,d", [
+    (1, 64, 128, 4, 4, 16),
+    (2, 64, 256, 4, 2, 32),
+])
+def test_flash_chunk_q8_matches_xla_dequant(b, s_c, w, nq, nkv, d):
+    """int8-cache chunk kernel == XLA chunk over the dequantized view
+    (the suffix-prefill member of the q8 family)."""
+    from distributed_llm_tpu.ops.pallas_attention import \
+        flash_chunk_attention_q8
+    from distributed_llm_tpu.ops.quant import quantize_kv_rows
+
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = _rand(ks[0], (b, s_c, nq, d))
+    k = _rand(ks[1], (b, w, nkv, d))
+    v = _rand(ks[2], (b, w, nkv, d))
+    kq, ksc = quantize_kv_rows(k)
+    vq, vsc = quantize_kv_rows(v)
+    start = w - s_c - 3
+    pos = jnp.broadcast_to(start + jnp.arange(s_c)[None], (b, s_c))
+    got = flash_chunk_attention_q8(q, kq, vq, ksc.astype(jnp.float32),
+                                   vsc.astype(jnp.float32), pos)
+    want = attention.chunk(q, kq, vq, pos, impl="xla",
+                           k_scale=ksc.astype(jnp.float32),
+                           v_scale=vsc.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               atol=3e-3, rtol=3e-3)
