@@ -675,6 +675,21 @@ class ContinuousBatchingEngine:
         XLA trace.  Runs before serving traffic: the scheduler is idle
         (no active slots), so mutating the pool here doesn't race a tick."""
         self.generate("warmup", max_new_tokens=2)
+        # The batched decode program retraces per gather-window rung; a
+        # mid-serve retrace stalls EVERY active slot for the compile.
+        # The warm request covered the first rung — also compile the
+        # second (typical multi-turn growth); deeper rungs stay lazy
+        # (one compile each over an engine's life).  All slots are free
+        # here (tables point at the trash block), so the extra ticks
+        # write only trash.
+        for w in self._buckets[1:2]:
+            wb = min(w // self.paged.block_size, self.paged.blocks_per_slot)
+            self._rng, rng = jax.random.split(self._rng)
+            toks, self.pool = self._decode_step()(
+                self.params, self.pool, jnp.asarray(self._tables[:, :wb]),
+                jnp.asarray(self._pos), jnp.asarray(self._cur),
+                jnp.asarray(self._temps), rng)
+            jax.block_until_ready(toks)
         if self.prefix_cache is not None and self._buckets:
             row = self._table_row([])
             # Every (reuse suffix bucket, chunk window rung) an admit
