@@ -147,7 +147,8 @@ def start_watchdog(progress: Progress, timeout_s: float) -> threading.Thread:
 
 
 def concurrent_phase(cluster, n_requests: int = 12, n_sequential: int = 4,
-                     slots: int = 4, max_new: int = 32) -> dict:
+                     slots: int = 4, max_new: int = 32,
+                     beat=lambda: None) -> dict:
     """Continuous-batching load test: independent single-turn queries
     submitted concurrently share one batched decode loop.  Reports the
     concurrent rate and its speedup over the same engine serving a sample
@@ -162,7 +163,9 @@ def concurrent_phase(cluster, n_requests: int = 12, n_sequential: int = 4,
                                max_new_tokens=max_new)
     engine = ContinuousBatchingEngine(tier, seed=1)
     try:
+        beat()
         engine.warmup()
+        beat()
         print("[bench] batching engine warm", file=sys.stderr, flush=True)
         queries = [
             f"user: question {i}: summarize fact number {i} about geography"
@@ -172,6 +175,7 @@ def concurrent_phase(cluster, n_requests: int = 12, n_sequential: int = 4,
         for q in queries[:n_sequential]:
             engine.generate(q)
         sequential_rate = n_sequential / (time.perf_counter() - t0)
+        beat()
         print("[bench] sequential sample done", file=sys.stderr, flush=True)
 
         t0 = time.perf_counter()
@@ -202,7 +206,9 @@ def concurrent_phase(cluster, n_requests: int = 12, n_sequential: int = 4,
         q8 = ContinuousBatchingEngine(
             dataclasses.replace(tier, kv_quantize="int8"), seed=1)
         try:
+            beat()
             q8.warmup()
+            beat()
             # Match the bf16 engine's state: its sequential pass already
             # compiled the real query bucket before its timed region.
             for q in queries[:2]:
@@ -235,7 +241,8 @@ def concurrent_phase(cluster, n_requests: int = 12, n_sequential: int = 4,
     }
 
 
-def features_phase(cluster, n_prompts: int = 3, max_new: int = 48) -> dict:
+def features_phase(cluster, n_prompts: int = 3, max_new: int = 48,
+                   beat=lambda: None) -> dict:
     """Measured evidence for speculative decoding and int8 weight-only
     quant (VERDICT r1 #6): acceptance rate + decode tok/s vs plain greedy
     on the same weights, and bf16 vs int8 decode tok/s per tier.  Engines
@@ -253,9 +260,11 @@ def features_phase(cluster, n_prompts: int = 3, max_new: int = 48) -> dict:
 
     def decode_tokps(engine) -> float:
         engine.generate(prompts[0], max_new_tokens=4)       # compile + warm
+        beat()
         rates = []
         for p in prompts:
             res = engine.generate(p, max_new_tokens=max_new)
+            beat()
             if res.tokens_per_s:
                 rates.append(res.tokens_per_s)
         return round(statistics.median(rates), 1) if rates else 0.0
@@ -320,7 +329,8 @@ def features_phase(cluster, n_prompts: int = 3, max_new: int = 48) -> dict:
     return out
 
 
-def flagship_phase(max_new: int = 48, n_prompts: int = 3) -> dict:
+def flagship_phase(max_new: int = 48, n_prompts: int = 3,
+                   beat=lambda: None) -> dict:
     """Serve the north-star presets at real scale (VERDICT r2 #2b):
     nano_1b, and orin_8b-int8 on the single-chip box (flagship_cluster).
     Random weights are fine — the kernels don't care — the numbers that
@@ -384,8 +394,10 @@ def flagship_phase(max_new: int = 48, n_prompts: int = 3) -> dict:
                     lambda: quantize_params(_models.init_params(cfg, 9)))()
             engine = InferenceEngine(tier, seed=9, params=params, mesh=mesh)
             del params
+            beat()
             engine.generate("user: warm the flagship up",
                             max_new_tokens=4)      # compile outside timing
+            beat()
             rates, ttfts = [], []
             for i in range(n_prompts):
                 # Head-varied so the probes can never prefix-match each
@@ -395,6 +407,7 @@ def flagship_phase(max_new: int = 48, n_prompts: int = 3) -> dict:
                     f"{i} flagship probe: explain the chip's memory "
                     "system in a few sentences.", max_new_tokens=max_new)
                 ttfts.append(res.ttft_ms)
+                beat()
                 if res.tokens_per_s:
                     rates.append(res.tokens_per_s)
             work = engine.phases.work_summary()
@@ -425,6 +438,7 @@ def flagship_phase(max_new: int = 48, n_prompts: int = 3) -> dict:
                         PhaseTimer
                     engine.phases = PhaseTimer()   # isolate this call
                     cold = engine.generate(hist, max_new_tokens=8)
+                    beat()
                     lw = engine.phases.work_summary().get("prefill", {})
                     lutil = (roofline.utilization(lw, lw["seconds"], peaks)
                              if lw.get("seconds") else {})
@@ -741,11 +755,12 @@ def run(progress: "Progress" = None) -> dict:
         tier.server_manager.stop_server()
     progress.beat()
     try:
-        batching = concurrent_phase(router.cluster)
+        batching = concurrent_phase(router.cluster,
+                                    beat=progress.beat)
     except Exception as exc:              # never lose the headline line
         batching = {"error": str(exc)[:200]}
     progress.section("continuous_batching", batching)
-    features = features_phase(router.cluster)
+    features = features_phase(router.cluster, beat=progress.beat)
     progress.section("speculative", features.get("speculative"))
     progress.section("quant", features.get("quant"))
 
@@ -754,7 +769,7 @@ def run(progress: "Progress" = None) -> dict:
     # explicitly forced.
     import os
     if backend != "cpu" or os.environ.get("DLLM_BENCH_FLAGSHIP") == "1":
-        flagship = flagship_phase()
+        flagship = flagship_phase(beat=progress.beat)
     else:
         flagship = {"skipped": "cpu fallback backend"}
     progress.section("flagship", flagship)
