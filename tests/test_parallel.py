@@ -341,3 +341,24 @@ def test_sp_decode_budget_scales_context_capacity():
     b4 = tier_hbm_budget(dataclasses.replace(base, sp=4))
     # (reported values round to 3 decimals)
     assert abs(b4["kv_gb_per_chip"] - b1["kv_gb_per_chip"] / 4) < 1e-3
+
+
+def test_sp_tp_2d_decode_matches_unsharded_tokens():
+    """The 2-D tier mesh ('sp','tp'): ring prefill over sp, decode over
+    the sequence-sharded cache with head-sharded q/kv over tp — token
+    parity with the single-device engine across both axes at once."""
+    import dataclasses
+
+    from distributed_llm_tpu.config import tiny_cluster
+    from distributed_llm_tpu.engine.inference import InferenceEngine
+    from distributed_llm_tpu.parallel.mesh import sp_tp_mesh
+
+    tier = dataclasses.replace(tiny_cluster().orin, tp=2, sp=2,
+                               max_new_tokens=8)
+    ref = InferenceEngine(dataclasses.replace(tier, tp=1, sp=1), seed=7)
+    grid = InferenceEngine(tier, seed=7,
+                           mesh=sp_tp_mesh(jax.devices(), sp=2, tp=2))
+    assert grid._sp_shard
+    prompt = ("user: " + "the mesh routes tokens and the compiler fuses "
+              "kernels. " * 6).strip()
+    assert ref.generate(prompt).token_ids == grid.generate(prompt).token_ids
