@@ -109,6 +109,12 @@ class SpeculativeEngine:
         self.draft = draft
         self.cfg_t = upgrade_attention_impl(target.model(), None)
         self.cfg_d = upgrade_attention_impl(draft.model(), None)
+        # InferenceEngine surface parity (the class contract): probes and
+        # telemetry address any engine's .tier/.cfg — for a speculative
+        # pair that means the TARGET (the model whose quality/context the
+        # tier serves).
+        self.tier = target
+        self.cfg = self.cfg_t
         self.gamma = gamma
         self.tokenizer = get_tokenizer(self.cfg_t)
         self._max_seq = min(self.cfg_t.max_seq_len, self.cfg_d.max_seq_len)
