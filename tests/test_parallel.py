@@ -362,3 +362,23 @@ def test_sp_tp_2d_decode_matches_unsharded_tokens():
     prompt = ("user: " + "the mesh routes tokens and the compiler fuses "
               "kernels. " * 6).strip()
     assert ref.generate(prompt).token_ids == grid.generate(prompt).token_ids
+
+
+def test_sp_decode_composes_with_int8_weights():
+    """sp-sharded-cache decode over int8 weights (quantized sharding
+    rules on the 2-D ('sp','tp') mesh): token parity with the unsharded
+    int8 engine."""
+    import dataclasses
+
+    from distributed_llm_tpu.config import tiny_cluster
+    from distributed_llm_tpu.engine.inference import InferenceEngine
+    from distributed_llm_tpu.parallel.mesh import sp_tp_mesh
+
+    tier = dataclasses.replace(tiny_cluster().orin, tp=1, sp=4,
+                               quantize="int8", max_new_tokens=8)
+    ref = InferenceEngine(tier, seed=7)
+    sp = InferenceEngine(tier, seed=7,
+                         mesh=sp_tp_mesh(jax.devices(), sp=4, tp=1))
+    prompt = ("user: " + "the mesh routes tokens and the compiler fuses "
+              "kernels. " * 6).strip()
+    assert ref.generate(prompt).token_ids == sp.generate(prompt).token_ids
