@@ -1,0 +1,140 @@
+"""Derive measured serving defaults from bench artifacts (VERDICT r2 #5).
+
+The repo's standard is defaults-follow-measurement: attention dispatch
+already works that way (`bench/ab_dispatch.json`), but the quant/
+speculative tier defaults were hand-set — and round 2's CPU numbers even
+contradicted them.  This tool closes the loop mechanically::
+
+    python -m distributed_llm_tpu.bench.tune \
+        --headline /tmp/BENCH_tpu.json [--spec /tmp/BENCH_tpu_spec.json] \
+        --write
+
+It reads the headline bench's per-tier quant A/B (``quant.<tier>``) and
+the speculative A/B (``speculative.speedup`` from the spec-enabled run),
+decides each tier's ``quantize`` / ``kv_quantize`` / ``draft`` by which
+leg measured faster, and publishes ``bench/tuning.json`` tagged with the
+backend it was measured on.  ``config.bench_cluster`` overlays the table
+when (and only when) its backend matches the running one — a CPU-derived
+table can never steer the chip, and vice versa.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+TUNING_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tuning.json")
+
+
+def derive(headline: dict, spec: dict = None,
+           min_speedup: float = 1.05) -> dict:
+    """Measured defaults from bench result dicts.  A feature must WIN by
+    ``min_speedup`` to be enabled (ties keep the simpler configuration).
+
+    Guards: a watchdog-aborted headline is not a measurement (raise); a
+    spec artifact that aborted or ran on a DIFFERENT backend (independent
+    probe fell back) is ignored with a note; kv_quantize was measured ON
+    TOP of int8 weights (bench.py's i8kv/i8 ratio), so it is only
+    enabled together with them — never stamped onto an unmeasured
+    bf16-weights combination."""
+    if headline.get("aborted"):
+        raise ValueError("headline bench artifact is a watchdog-aborted "
+                         "partial — refusing to derive defaults from it")
+    out: dict = {"backend": headline.get("backend"), "tiers": {}}
+    quant = headline.get("quant") or {}
+    for tier in ("nano", "orin"):
+        q = quant.get(tier) or {}
+        entry: dict = {}
+        if q.get("speedup"):
+            entry["quantize"] = ("int8" if q["speedup"] >= min_speedup
+                                 else "none")
+        if q.get("kv_int8_speedup"):
+            kv_wins = q["kv_int8_speedup"] >= min_speedup
+            entry["kv_quantize"] = ("int8" if kv_wins
+                                    and entry.get("quantize") == "int8"
+                                    else "none")
+        if entry:
+            entry["evidence"] = {k: q.get(k) for k in ("speedup",
+                                                       "kv_int8_speedup")}
+            out["tiers"][tier] = entry
+    if spec is not None:
+        if spec.get("aborted"):
+            out["spec_note"] = "spec artifact aborted — ignored"
+        elif spec.get("backend") != out["backend"]:
+            out["spec_note"] = (f"spec artifact backend "
+                                f"{spec.get('backend')!r} != headline "
+                                f"{out['backend']!r} — ignored")
+        else:
+            s = spec.get("speculative") or {}
+            if s.get("speedup"):
+                orin = out["tiers"].setdefault("orin", {})
+                orin["speculative"] = bool(s["speedup"] >= min_speedup)
+                orin.setdefault("evidence", {})["spec_speedup"] = \
+                    s["speedup"]
+    return out
+
+
+def load_tuning(backend: str) -> dict:
+    """The committed tuning table's tier overlays, or {} when absent or
+    measured on a different backend."""
+    try:
+        with open(TUNING_PATH) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if data.get("backend") != backend:
+        return {}
+    return data.get("tiers", {})
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--headline", required=True,
+                    help="bench.py output (full first line or partial file)")
+    ap.add_argument("--spec", default=None,
+                    help="DLLM_BENCH_SPEC_ORIN=1 bench output")
+    ap.add_argument("--min-speedup", type=float, default=1.05)
+    ap.add_argument("--write", action="store_true",
+                    help="publish bench/tuning.json")
+    ap.add_argument("--force", action="store_true",
+                    help="overwrite even a hardware-measured table")
+    args = ap.parse_args(argv)
+
+    def read(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("{"):
+                    return json.loads(line)
+        raise ValueError(f"{path}: no JSON line found")
+
+    headline = read(args.headline)
+    spec = read(args.spec) if args.spec else None
+    tuning = derive(headline, spec, args.min_speedup)
+    print(json.dumps(tuning, indent=1))
+    if args.write:
+        prior = None
+        try:
+            with open(TUNING_PATH) as f:
+                prior = json.load(f).get("backend")
+        except (OSError, ValueError):
+            pass
+        # Protect HARDWARE tables from cpu-fallback rounds; a hardware
+        # run may always refresh (incl. replacing a stale cpu table) —
+        # the read side ignores mismatched backends anyway.
+        if (prior not in (None, "cpu", tuning["backend"])
+                and tuning["backend"] == "cpu" and not args.force):
+            print(f"# REFUSING to overwrite {TUNING_PATH}: measured on "
+                  f"{prior!r}, this run is CPU fallback (--force to "
+                  "override)")
+            raise SystemExit(1)
+        with open(TUNING_PATH + ".tmp", "w") as f:
+            json.dump(tuning, f, indent=1)
+        os.replace(TUNING_PATH + ".tmp", TUNING_PATH)
+        print(f"# wrote {TUNING_PATH}")
+
+
+if __name__ == "__main__":
+    main()

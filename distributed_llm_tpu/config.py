@@ -273,13 +273,35 @@ def bench_cluster() -> ClusterConfig:
     import os
     draft = ("nano_bench"
              if os.environ.get("DLLM_BENCH_SPEC_ORIN") == "1" else None)
-    return ClusterConfig(
+    cluster = ClusterConfig(
         nano=TierConfig(name="nano", model_preset="nano_bench", tp=1,
                         max_new_tokens=64, quantize="int8"),
         orin=TierConfig(name="orin", model_preset="orin_bench", tp=1,
                         max_new_tokens=128, quantize="int8",
                         draft_preset=draft),
     )
+    # Defaults follow measurement (same pattern as the attention dispatch
+    # table): a committed bench/tuning.json — written by
+    # `python -m distributed_llm_tpu.bench.tune` from real bench
+    # artifacts, backend-tagged — overlays quantize/kv_quantize/draft per
+    # tier.  The env override above still wins for the explicit spec A/B.
+    try:
+        import jax
+
+        from .bench.tune import load_tuning
+        tiers = load_tuning(jax.default_backend())
+    except Exception:
+        tiers = {}
+    if tiers:
+        def apply(tier: TierConfig) -> TierConfig:
+            t = tiers.get(tier.name) or {}
+            kw = {k: t[k] for k in ("quantize", "kv_quantize") if k in t}
+            if tier.name == "orin" and draft is None and "speculative" in t:
+                kw["draft_preset"] = "nano_bench" if t["speculative"] else None
+            return dataclasses.replace(tier, **kw) if kw else tier
+        cluster = ClusterConfig(nano=apply(cluster.nano),
+                                orin=apply(cluster.orin))
+    return cluster
 
 
 def flagship_cluster(n_devices: Optional[int] = None) -> ClusterConfig:

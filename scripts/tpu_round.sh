@@ -58,6 +58,13 @@ PY
   DLLM_BENCH_SPEC_ORIN=1 python bench.py > /tmp/BENCH_tpu_spec.json \
     2> /tmp/bench_tpu_spec.log || echo "spec bench exited nonzero ($?)"
 
+  # 4b. Measured serving defaults (VERDICT r2 #5): derive the tuning
+  #     table from the two bench artifacts so bench_cluster's
+  #     quant/kv/spec choices cite real chip measurements.
+  python -m distributed_llm_tpu.bench.tune \
+    --headline /tmp/BENCH_tpu.json --spec /tmp/BENCH_tpu_spec.json \
+    --write || echo "tuning derivation failed"
+
   # 5. Reference-CLI harness sweep ON CHIP (bench tiers, trained
   #    checkpoints): the r2/r3 artifact sets were CPU-only.
   mkdir -p bench/results_r3_tpu && ( cd bench/results_r3_tpu && \

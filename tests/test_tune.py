@@ -1,0 +1,83 @@
+"""Measured serving defaults (bench/tune.py, VERDICT r2 #5): the tuning
+table derives from bench artifacts, is backend-guarded like the dispatch
+table, and overlays bench_cluster mechanically."""
+
+import json
+
+from distributed_llm_tpu.bench import tune
+
+
+HEADLINE = {
+    "backend": "tpu",
+    "quant": {"nano": {"speedup": 1.6, "kv_int8_speedup": 0.9},
+              "orin": {"speedup": 1.7, "kv_int8_speedup": 1.2}},
+}
+SPEC = {"backend": "tpu", "speculative": {"speedup": 1.4}}
+
+
+def test_derive_follows_measurement():
+    t = tune.derive(HEADLINE, SPEC)
+    assert t["backend"] == "tpu"
+    assert t["tiers"]["nano"]["quantize"] == "int8"
+    assert t["tiers"]["nano"]["kv_quantize"] == "none"     # 0.9x lost
+    assert t["tiers"]["orin"]["kv_quantize"] == "int8"
+    assert t["tiers"]["orin"]["speculative"] is True
+    # Ties/below-threshold keep the simpler configuration.
+    t2 = tune.derive({"backend": "tpu",
+                      "quant": {"orin": {"speedup": 1.01}}},
+                     {"backend": "tpu", "speculative": {"speedup": 0.9}})
+    assert t2["tiers"]["orin"]["quantize"] == "none"
+    assert t2["tiers"]["orin"]["speculative"] is False
+
+
+def test_derive_guards():
+    import pytest
+    # A watchdog-aborted headline is not a measurement.
+    with pytest.raises(ValueError, match="aborted"):
+        tune.derive({"backend": "tpu", "aborted": "wedged"})
+    # A spec artifact from a different backend (independent probe fell
+    # back) must not stamp its verdict into a hardware table.
+    t = tune.derive(HEADLINE, {"backend": "cpu",
+                               "speculative": {"speedup": 2.0}})
+    assert "speculative" not in t["tiers"]["orin"]
+    assert "ignored" in t["spec_note"]
+    # kv_int8 was measured ON int8 weights: never paired with
+    # quantize='none' (an unmeasured combination).
+    t = tune.derive({"backend": "tpu",
+                     "quant": {"orin": {"speedup": 0.9,
+                                        "kv_int8_speedup": 1.3}}})
+    assert t["tiers"]["orin"] == {
+        "quantize": "none", "kv_quantize": "none",
+        "evidence": {"speedup": 0.9, "kv_int8_speedup": 1.3}}
+
+
+def test_load_tuning_backend_guard(tmp_path, monkeypatch):
+    path = tmp_path / "tuning.json"
+    path.write_text(json.dumps({"backend": "tpu",
+                                "tiers": {"orin": {"quantize": "none"}}}))
+    monkeypatch.setattr(tune, "TUNING_PATH", str(path))
+    assert tune.load_tuning("tpu") == {"orin": {"quantize": "none"}}
+    assert tune.load_tuning("cpu") == {}          # other backend: ignored
+    monkeypatch.setattr(tune, "TUNING_PATH", str(tmp_path / "missing.json"))
+    assert tune.load_tuning("tpu") == {}
+
+
+def test_bench_cluster_applies_matching_table(tmp_path, monkeypatch):
+    import jax
+
+    from distributed_llm_tpu import config as C
+    path = tmp_path / "tuning.json"
+    path.write_text(json.dumps({
+        "backend": jax.default_backend(),
+        "tiers": {"orin": {"quantize": "none", "kv_quantize": "int8",
+                           "speculative": True}}}))
+    monkeypatch.setattr(tune, "TUNING_PATH", str(path))
+    cl = C.bench_cluster()
+    assert cl.orin.quantize == "none"
+    assert cl.orin.kv_quantize == "int8"
+    assert cl.orin.draft_preset == "nano_bench"
+    assert cl.nano.quantize == "int8"             # untouched default
+    # A table from another backend must not steer this one.
+    path.write_text(json.dumps({"backend": "not-this-backend",
+                                "tiers": {"orin": {"quantize": "none"}}}))
+    assert C.bench_cluster().orin.quantize == "int8"
