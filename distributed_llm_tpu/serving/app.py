@@ -256,12 +256,36 @@ def create_app(router: Optional[Router] = None,
             cache_stats = router_.query_router.get_cache_stats()
         except Exception:
             cache_stats = None
+        # Measurement provenance: which measured tables steer serving on
+        # THIS backend (attention dispatch, tier tuning) — "none" means
+        # the corresponding defaults are in effect.
+        import jax as _jax
+        backend = _jax.default_backend()
+        provenance = {"backend": backend}
+        try:
+            import json as _json
+
+            from ..ops import attention as _att
+            with open(_att._DISPATCH_PATH) as f:
+                d = _json.load(f)
+            provenance["dispatch"] = (d.get("backend")
+                                      if d.get("backend") == backend
+                                      else f"ignored ({d.get('backend')})")
+        except Exception:
+            provenance["dispatch"] = "none"
+        try:
+            from ..bench.tune import load_tuning
+            provenance["tuning"] = (backend if load_tuning(backend)
+                                    else "none")
+        except Exception:
+            provenance["tuning"] = "none"
         return jsonify({
             "strategy": strategy,
             "sessions": sessions,
             "cache": cache_stats,
             "tiers": tiers,
             "devices": device_memory_snapshot(),
+            "measured_tables": provenance,
         })
 
     @app.route("/history", methods=["GET"])
