@@ -68,8 +68,28 @@ def test_micro_ab_writes_dispatch(tmp_path, monkeypatch):
     res = ab_kernels.micro_ab("nano", repeat=1, write_dispatch=True)
     assert res["cases"], "no kernel cases measured"
     kinds = {c["kind"] for c in res["cases"]}
-    assert {"prefill", "decode", "chunk", "paged_decode"} <= kinds
+    assert {"prefill", "decode", "chunk", "chunk_q8",
+            "paged_decode"} <= kinds
     data = json.loads(out.read_text())
     assert set(data["dispatch"]) == kinds
     for per_len in data["dispatch"].values():
         assert all(v in ("xla", "pallas") for v in per_len.values())
+
+
+def test_micro_ab_fast_mode_covers_all_kinds(tmp_path, monkeypatch):
+    """The in-bench fast A/B (bench.py's self-measuring path) must still
+    produce a table covering every dispatch kind, with per-kind defaults,
+    and beat its liveness callback per case."""
+    from distributed_llm_tpu.bench import ab_kernels
+    out = tmp_path / "ab_dispatch.json"
+    monkeypatch.setattr(ab_kernels, "DISPATCH_PATH", str(out))
+    beats = []
+    res = ab_kernels.micro_ab("nano", repeat=1, write_dispatch=True,
+                              fast=True, beat=lambda: beats.append(1))
+    kinds = {c["kind"] for c in res["cases"]}
+    assert {"prefill", "decode", "decode_q8", "chunk", "chunk_q8",
+            "paged_decode", "paged_decode_q8"} == kinds
+    assert len(beats) == len(res["cases"]) and beats
+    data = json.loads(out.read_text())
+    for per_len in data["dispatch"].values():
+        assert "default" in per_len
