@@ -178,3 +178,22 @@ def test_fused_loop_matches_streaming_tokens():
             pass
         assert g.token_ids == h.request.result.token_ids, prompt
         assert g.gen_tokens <= mx
+
+
+def test_speculative_with_int8_weights_paths_agree():
+    """Speculative serving under weight-only int8 (the bench_cluster
+    default): the fused loop and the streaming path still emit identical
+    tokens, and the exactness guarantee vs the plain int8 engine holds."""
+    import dataclasses
+
+    target = _tier("orin_test", quantize="int8", temperature=0.0)
+    draft = _tier("nano_test", temperature=0.0)
+    spec = SpeculativeEngine(target, draft, gamma=3, seed=5)
+    ref = InferenceEngine(target, seed=5)
+    prompt = "user: quantized speculation?"
+    g = spec.generate(prompt, max_new_tokens=10)
+    h = spec.generate_stream(prompt, max_new_tokens=10)
+    for _ in h:
+        pass
+    assert g.token_ids == h.request.result.token_ids
+    assert g.token_ids == ref.generate(prompt, max_new_tokens=10).token_ids
