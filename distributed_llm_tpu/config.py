@@ -182,6 +182,13 @@ class TierConfig:
     # KV cache stay sharded on tp only (sequence replicated) — decode is
     # bandwidth-bound on weights, not attention FLOPs.  Dense models only.
     sp: int = 1
+    # Expert-parallel degree for MoE tiers: ep>1 makes the submesh
+    # ('ep','tp') and shards WHOLE experts over it (the serving twin of
+    # the trainer's ep axis — parallel/sharding.py param_specs maps
+    # stacked expert weights [L,E,...] onto 'ep').  GSPMD inserts the
+    # dispatch collectives; attention/caches stay on 'tp'.  Dense models
+    # ignore it.
+    ep: int = 1
     max_new_tokens: int = 256       # decode cap (reference: num_predict, -1=unbounded)
     temperature: float = 0.0        # greedy by default (src/devices/nano_api.py:21)
     prefill_buckets: Tuple[int, ...] = (64, 128, 256, 512, 1024, 2048)

@@ -38,6 +38,19 @@ def sp_tp_mesh(devices: Sequence[jax.Device], sp: int,
     return jax.sharding.Mesh(chosen, ("sp", "tp"))
 
 
+def ep_tp_mesh(devices: Sequence[jax.Device], ep: int,
+               tp: int = 1) -> jax.sharding.Mesh:
+    """('ep','tp') tier submesh: whole experts shard over 'ep' (the
+    serving twin of the trainer's expert axis), attention heads and KV
+    over 'tp'."""
+    devices = list(devices)
+    if len(devices) < ep * tp:
+        raise ValueError(f"ep_tp_mesh: need {ep * tp} devices for "
+                         f"ep={ep}×tp={tp}, have {len(devices)}")
+    return jax.sharding.Mesh(
+        np.asarray(devices[:ep * tp]).reshape(ep, tp), ("ep", "tp"))
+
+
 def carve_tier_meshes(
     cluster: ClusterConfig,
     devices: Optional[Sequence[jax.Device]] = None,
@@ -64,15 +77,34 @@ def carve_tier_meshes(
         if tp == 0:
             # Nothing left — share chips from the front (single-chip box).
             tp = max(_fit_tp(tier, len(devices)), 1)
+            ep = _fit_ep(tier, len(devices), tp)
             sp = _fit_sp(tier, len(devices), tp)
-            meshes[tier.name] = (sp_tp_mesh(devices, sp, tp) if sp > 1
-                                 else tp_mesh(devices, tp))
+            meshes[tier.name] = (
+                ep_tp_mesh(devices, ep, tp) if ep > 1
+                else sp_tp_mesh(devices, sp, tp) if sp > 1
+                else tp_mesh(devices, tp))
             continue
-        sp = _fit_sp(tier, remaining, tp)
-        meshes[tier.name] = (sp_tp_mesh(devices[cursor:], sp, tp) if sp > 1
-                             else tp_mesh(devices[cursor:], tp))
-        cursor += tp * sp
+        ep = _fit_ep(tier, remaining, tp)
+        sp = _fit_sp(tier, remaining, tp) if ep == 1 else 1
+        meshes[tier.name] = (
+            ep_tp_mesh(devices[cursor:], ep, tp) if ep > 1
+            else sp_tp_mesh(devices[cursor:], sp, tp) if sp > 1
+            else tp_mesh(devices[cursor:], tp))
+        cursor += tp * max(sp, ep)
     return meshes
+
+
+def _fit_ep(tier: TierConfig, available: int, tp: int) -> int:
+    """Largest expert-parallel degree ≤ requested that divides the
+    model's expert count and fits the chips alongside tp.  1 for dense
+    tiers (nothing to shard on 'ep')."""
+    experts = tier.model().num_experts
+    if tier.ep <= 1 or experts <= 1:
+        return 1
+    ep = min(tier.ep, max(available // tp, 1), experts)
+    while ep > 1 and experts % ep:
+        ep -= 1
+    return max(ep, 1)
 
 
 def _fit_sp(tier: TierConfig, available: int, tp: int) -> int:

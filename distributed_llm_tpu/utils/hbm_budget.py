@@ -56,7 +56,14 @@ def tier_hbm_budget(tier, devices: Optional[Sequence[jax.Device]] = None,
 
     cfg = tier.model()
     tp = tier.tp
-    chips = tp * max(1, tier.sp)
+    # Budget the degree carve_tier_meshes would actually DEPLOY: ep must
+    # divide the expert count and fit the devices (param_specs silently
+    # replicates a non-dividing axis, which would certify a sharding no
+    # deployment uses).
+    from ..parallel.mesh import _fit_ep
+    n_avail = len(devices) if devices is not None else len(jax.devices())
+    ep = _fit_ep(tier, n_avail, tp)
+    chips = tp * max(1, tier.sp, ep)
 
     # -- params (the serving engines' exact init + quantize pipeline) -----
     quantized = tier.quantize == "int8"
@@ -65,16 +72,18 @@ def tier_hbm_budget(tier, devices: Optional[Sequence[jax.Device]] = None,
             lambda: quantize_params(models.init_params(cfg, 0)))
     else:
         shapes = jax.eval_shape(lambda: models.init_params(cfg, 0))
-    if tp > 1:
-        if devices is None or len(devices) < tp:
+    if tp > 1 or ep > 1:
+        need = tp * ep
+        if devices is None or len(devices) < need:
             devices = jax.devices()
-        if len(devices) < tp:
-            raise ValueError(f"need {tp} devices to evaluate the tp "
-                             f"sharding, have {len(devices)}")
-        from ..parallel.mesh import tp_mesh
+        if len(devices) < need:
+            raise ValueError(f"need {need} devices to evaluate the "
+                             f"tp×ep sharding, have {len(devices)}")
+        from ..parallel.mesh import ep_tp_mesh, tp_mesh
         from ..parallel.sharding import (param_shardings,
                                          quantized_param_shardings)
-        mesh = tp_mesh(list(devices)[:tp], tp)
+        mesh = (ep_tp_mesh(list(devices)[:need], ep, tp) if ep > 1
+                else tp_mesh(list(devices)[:tp], tp))
         shardings = (quantized_param_shardings(cfg, mesh, shapes=shapes)
                      if quantized else param_shardings(cfg, mesh))
         params_gb = _sharded_tree_gb(shapes, shardings)

@@ -184,8 +184,6 @@ def test_speculative_with_int8_weights_paths_agree():
     """Speculative serving under weight-only int8 (the bench_cluster
     default): the fused loop and the streaming path still emit identical
     tokens, and the exactness guarantee vs the plain int8 engine holds."""
-    import dataclasses
-
     target = _tier("orin_test", quantize="int8", temperature=0.0)
     draft = _tier("nano_test", temperature=0.0)
     spec = SpeculativeEngine(target, draft, gamma=3, seed=5)
