@@ -34,6 +34,10 @@ import time
 DISPATCH_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "ab_dispatch.json")
 
+# Every case class micro_ab can measure (--kinds validates against this).
+ALL_KINDS = frozenset({"prefill", "decode", "decode_q8", "chunk",
+                       "chunk_q8", "paged_decode", "paged_decode_q8"})
+
 
 def _time_fn(fn, args, repeat: int) -> float:
     """Median wall ms of a jitted call (2 warmup calls compile + settle)."""
@@ -50,7 +54,7 @@ def _time_fn(fn, args, repeat: int) -> float:
 
 def micro_ab(tier_name: str = "orin", repeat: int = 20,
              write_dispatch: bool = False, fast: bool = False,
-             beat=None) -> dict:
+             beat=None, kinds=None) -> dict:
     """Direct kernel A/B at serving shapes; returns (and optionally
     publishes) the per-(kind, length) winner table.
 
@@ -59,7 +63,10 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
     A/B fits inside the bench run itself — the driver's round-end bench
     can measure its own dispatch table on a freshly healthy chip instead
     of serving un-dispatched.  ``beat`` is called after every case
-    (bench.py's wedge watchdog counts it as liveness)."""
+    (bench.py's wedge watchdog counts it as liveness).  ``kinds`` (an
+    iterable of kind names) restricts the grid — used to isolate or
+    exclude a case class after a mid-A/B chip wedge (r3: the chip
+    wedged on the decode_q8@1024 case mid-grid)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -67,6 +74,12 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
     from ..config import bench_cluster, tiny_cluster
     from ..ops import attention as A
     from ..ops import pallas_attention as PA
+
+    if kinds is not None:
+        unknown = set(kinds) - ALL_KINDS
+        if unknown:
+            raise ValueError(f"unknown kinds {sorted(unknown)}; "
+                             f"valid: {sorted(ALL_KINDS)}")
 
     cluster = (tiny_cluster() if jax.default_backend() == "cpu"
                else bench_cluster())
@@ -84,12 +97,18 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
                      "repeat": repeat, "cases": []}
     wins: dict = {}
 
+    def want(kind: str) -> bool:
+        return kinds is None or kind in kinds
+
     def record(kind, length, fn_xla, args_xla, fn_pallas, args_pallas,
                detail):
         """Time both legs; a leg that RAISES (e.g. a Mosaic compile
         failure on new hardware) loses with ms=None instead of aborting
         the whole A/B — the dispatch table must still be written."""
         import jax as _jax
+
+        if not want(kind):
+            return
 
         def leg(fn, args):
             try:
@@ -121,9 +140,12 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
         else:
             slot.append(ms_pallas <= ms_xla)
 
-    # prefill (one sequence per call, bucket-sized)
+    # prefill (one sequence per call, bucket-sized).  Every block below
+    # checks want() BEFORE building its inputs: excluded kinds must not
+    # pay device work (the whole point of --kinds is dodging a flaky
+    # case class on a wedge-prone chip).
     for s in lengths:
-        if s % 128:
+        if s % 128 or not want("prefill"):
             continue
         q = jax.random.normal(key, (1, s, nq, d), bf16)
         k = jax.random.normal(key, (1, s, nkv, d), bf16)
@@ -135,6 +157,8 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
     from ..ops.quant import quantize_kv_rows as _qkv
     for s in lengths:
         for b in batches:
+            if not (want("decode") or want("decode_q8")):
+                break
             q = jax.random.normal(key, (b, nq, d), bf16)
             kc = jax.random.normal(key, (b, s, nkv, d), bf16)
             vc = jax.random.normal(key, (b, s, nkv, d), bf16)
@@ -143,42 +167,50 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
                    PA.flash_decode_attention, (q, kc, vc, pos),
                    {"batch": b})
 
-            # int8 contiguous cache: XLA dequant view vs in-VMEM kernel.
-            kq, ksc = _qkv(kc)
-            vq, vsc = _qkv(vc)
-            ksc_c = ksc.astype(jnp.float32)
-            vsc_c = vsc.astype(jnp.float32)
-            record("decode_q8", s,
-                   lambda *a: A.decode(a[0], a[1], a[2], a[5], impl="xla",
-                                       k_scale=a[3], v_scale=a[4]),
-                   (q, kq, vq, ksc_c, vsc_c, pos),
-                   PA.flash_decode_attention_q8,
-                   (q, kq, vq, ksc_c, vsc_c, pos), {"batch": b})
+            if want("decode_q8"):
+                # int8 contiguous cache: XLA dequant vs in-VMEM kernel.
+                kq, ksc = _qkv(kc)
+                vq, vsc = _qkv(vc)
+                ksc_c = ksc.astype(jnp.float32)
+                vsc_c = vsc.astype(jnp.float32)
+                record("decode_q8", s,
+                       lambda *a: A.decode(a[0], a[1], a[2], a[5],
+                                           impl="xla",
+                                           k_scale=a[3], v_scale=a[4]),
+                       (q, kq, vq, ksc_c, vsc_c, pos),
+                       PA.flash_decode_attention_q8,
+                       (q, kq, vq, ksc_c, vsc_c, pos), {"batch": b})
 
-        # chunk prefill: one 128-token suffix against the window
-        sc = min(128, s)
-        q = jax.random.normal(key, (1, sc, nq, d), bf16)
-        kc = jax.random.normal(key, (1, s, nkv, d), bf16)
-        vc = jax.random.normal(key, (1, s, nkv, d), bf16)
-        qpos = (jnp.arange(sc, dtype=jnp.int32) + (s - sc))[None]
-        record("chunk", s, A.chunk_attention, (q, kc, vc, qpos),
-               PA.flash_chunk_attention, (q, kc, vc, qpos), {"chunk": sc})
+        if want("chunk") or want("chunk_q8"):
+            # chunk prefill: one 128-token suffix against the window
+            sc = min(128, s)
+            q = jax.random.normal(key, (1, sc, nq, d), bf16)
+            kc = jax.random.normal(key, (1, s, nkv, d), bf16)
+            vc = jax.random.normal(key, (1, s, nkv, d), bf16)
+            qpos = (jnp.arange(sc, dtype=jnp.int32) + (s - sc))[None]
+            record("chunk", s, A.chunk_attention, (q, kc, vc, qpos),
+                   PA.flash_chunk_attention, (q, kc, vc, qpos),
+                   {"chunk": sc})
 
-        # int8-cache chunk: XLA dequant view vs the in-VMEM q8 kernel.
-        kq, ksc = _qkv(kc)
-        vq, vsc = _qkv(vc)
-        record("chunk_q8", s,
-               lambda *a: A.chunk(a[0], a[1], a[2], a[5], impl="xla",
-                                  k_scale=a[3], v_scale=a[4]),
-               (q, kq, vq, ksc.astype(jnp.float32),
-                vsc.astype(jnp.float32), qpos),
-               PA.flash_chunk_attention_q8,
-               (q, kq, vq, ksc.astype(jnp.float32),
-                vsc.astype(jnp.float32), qpos), {"chunk": sc})
+            if want("chunk_q8"):
+                # int8-cache chunk: XLA dequant vs the in-VMEM q8 kernel.
+                kq, ksc = _qkv(kc)
+                vq, vsc = _qkv(vc)
+                record("chunk_q8", s,
+                       lambda *a: A.chunk(a[0], a[1], a[2], a[5],
+                                          impl="xla",
+                                          k_scale=a[3], v_scale=a[4]),
+                       (q, kq, vq, ksc.astype(jnp.float32),
+                        vsc.astype(jnp.float32), qpos),
+                       PA.flash_chunk_attention_q8,
+                       (q, kq, vq, ksc.astype(jnp.float32),
+                        vsc.astype(jnp.float32), qpos), {"chunk": sc})
 
         # paged decode: pool sized for 8 slots of this length
         bs = 64
         for b in batches[1:]:
+            if not (want("paged_decode") or want("paged_decode_q8")):
+                break
             nb = b * (s // bs) + 1
             kp = jax.random.normal(key, (nkv, nb, bs, d), bf16)
             vp = jax.random.normal(key, (nkv, nb, bs, d), bf16)
@@ -191,17 +223,19 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
                    PA.paged_decode_attention, (q, kp, vp, tables, pos),
                    {"batch": b})
 
-            # int8 pool variant: XLA half-byte gather+dequant vs the
-            # in-VMEM dequant kernel.
-            kq, ksc = _qkv(kp)
-            vq, vsc = _qkv(vp)
-            record("paged_decode_q8", s,
-                   lambda *a: A.paged_decode(a[0], a[1], a[2], a[5], a[6],
-                                             impl="xla", k_scale=a[3],
-                                             v_scale=a[4]),
-                   (q, kq, vq, ksc, vsc, tables, pos),
-                   PA.paged_decode_attention_q8,
-                   (q, kq, vq, ksc, vsc, tables, pos), {"batch": b})
+            if want("paged_decode_q8"):
+                # int8 pool variant: XLA half-byte gather+dequant vs the
+                # in-VMEM dequant kernel.
+                kq, ksc = _qkv(kp)
+                vq, vsc = _qkv(vp)
+                record("paged_decode_q8", s,
+                       lambda *a: A.paged_decode(a[0], a[1], a[2], a[5],
+                                                 a[6], impl="xla",
+                                                 k_scale=a[3],
+                                                 v_scale=a[4]),
+                       (q, kq, vq, ksc, vsc, tables, pos),
+                       PA.paged_decode_attention_q8,
+                       (q, kq, vq, ksc, vsc, tables, pos), {"batch": b})
 
     # Dispatch decision: pallas must win (or tie) at EVERY tested batch of
     # a (kind, length) to own it — robust beats optimal.  Each kind also
@@ -220,27 +254,46 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
     results["dispatch"] = dispatch
     print(json.dumps({"dispatch": dispatch}), flush=True)
     if write_dispatch:
-        # A table measured on real hardware is a committed artifact; never
-        # let a CPU run clobber it (ops/attention.py would then ignore the
-        # file entirely and silently drop the TPU measurements — ADVICE r2).
-        prior_backend = None
-        try:
-            with open(DISPATCH_PATH) as f:
-                prior_backend = json.load(f).get("backend")
-        except (OSError, ValueError):
-            pass
-        if prior_backend is not None and prior_backend != results["backend"]:
-            print(f"# REFUSING to overwrite {DISPATCH_PATH}: it was "
-                  f"measured on {prior_backend!r}, this run is "
-                  f"{results['backend']!r} (delete the file to force)",
-                  flush=True)
-        else:
-            with open(DISPATCH_PATH, "w") as f:
-                json.dump({"backend": results["backend"],
-                           "model": results["model"],
-                           "dispatch": dispatch}, f, indent=1)
-            print(f"# wrote {DISPATCH_PATH}", flush=True)
+        publish_dispatch(results["backend"], results["model"], dispatch)
     return results
+
+
+def publish_dispatch(backend: str, model: str, dispatch: dict,
+                     path: str = None) -> bool:
+    """Write the measured dispatch table, enforcing the artifact policy.
+
+    A table measured on real hardware is a committed artifact; a CPU run
+    must never clobber it (ops/attention.py would then ignore the file
+    entirely and silently drop the TPU measurements — ADVICE r2), while
+    a hardware run may always refresh, including replacing a stale cpu
+    table (same policy as bench/tune.py).  A partial (--kinds / fast)
+    run MERGES into a same-backend table — unmeasured kinds keep their
+    prior winners — but a cross-backend refresh starts clean: mixing
+    winners measured on different hardware would make the table
+    meaningless.  Returns True if the table was written."""
+    path = path or DISPATCH_PATH
+    prior = {}
+    try:
+        with open(path) as f:
+            prior = json.load(f)
+    except (OSError, ValueError):
+        pass
+    prior_backend = prior.get("backend")
+    if (prior_backend is not None and prior_backend != backend
+            and backend == "cpu"):
+        print(f"# REFUSING to overwrite {path}: it was measured on "
+              f"{prior_backend!r}, this run is {backend!r} (delete the "
+              "file to force)", flush=True)
+        return False
+    merged = (dict(prior.get("dispatch") or {})
+              if prior_backend == backend else {})
+    merged.update(dispatch)
+    with open(path, "w") as f:
+        json.dump({"backend": backend, "model": model,
+                   "dispatch": merged}, f, indent=1)
+    print(f"# wrote {path} ({len(dispatch)}/{len(merged)} kinds updated)",
+          flush=True)
+    return True
 
 
 def measure(impl: str, tier_name: str, prompt_tokens: int, max_new: int,
@@ -301,6 +354,11 @@ def main(argv=None) -> None:
     ap.add_argument("--repeat", type=int, default=5)
     ap.add_argument("--write-dispatch", action="store_true",
                     help="micro mode: publish bench/ab_dispatch.json")
+    ap.add_argument("--fast", action="store_true",
+                    help="micro mode: trimmed grid (headline shapes only)")
+    ap.add_argument("--kinds", default=None,
+                    help="micro mode: comma-separated kind subset to run "
+                         "(isolate/exclude a case after a chip wedge)")
     ap.add_argument("--platform", default=None,
                     help="pin jax_platforms (e.g. cpu) — the env var alone "
                          "is snapshotted too early under this image's "
@@ -313,7 +371,8 @@ def main(argv=None) -> None:
 
     if args.mode == "micro":
         micro_ab(args.tier, repeat=max(args.repeat, 10),
-                 write_dispatch=args.write_dispatch)
+                 write_dispatch=args.write_dispatch, fast=args.fast,
+                 kinds=(set(args.kinds.split(",")) if args.kinds else None))
         return
 
     results = {}

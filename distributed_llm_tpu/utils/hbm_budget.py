@@ -42,7 +42,8 @@ def _sharded_tree_gb(tree: Any, shardings: Any) -> float:
 
 
 def tier_hbm_budget(tier, devices: Optional[Sequence[jax.Device]] = None,
-                    hbm_per_chip_gb: float = DEFAULT_HBM_PER_CHIP_GB
+                    hbm_per_chip_gb: float = DEFAULT_HBM_PER_CHIP_GB,
+                    mesh: Optional[jax.sharding.Mesh] = None
                     ) -> Dict[str, Any]:
     """Budget ``tier`` against its submesh.
 
@@ -50,20 +51,36 @@ def tier_hbm_budget(tier, devices: Optional[Sequence[jax.Device]] = None,
     chips, hbm_per_chip_gb, fits, headroom_gb}.  ``devices`` backs the
     tp>1 sharding evaluation (any devices do — CPU works); tp=1 tiers
     need none.
+
+    With ``mesh`` (the tier's DEPLOYED submesh, e.g. from
+    ``carve_tier_meshes``) the budget reads tp/sp/ep from the mesh axes
+    instead of re-deriving them from the tier against the full local
+    device count — a cluster's later tiers see only the chips earlier
+    tiers left over, so the standalone derivation can certify a larger
+    (smaller-footprint) sharding than any deployment uses.  Use
+    ``cluster_hbm_budget`` to budget a whole cluster that way.
     """
     from .. import models
     from ..ops.quant import quantize_params
 
     cfg = tier.model()
-    tp = tier.tp
-    # Budget the degree carve_tier_meshes would actually DEPLOY: ep must
-    # divide the expert count and fit the devices (param_specs silently
-    # replicates a non-dividing axis, which would certify a sharding no
-    # deployment uses).
-    from ..parallel.mesh import _fit_ep
-    n_avail = len(devices) if devices is not None else len(jax.devices())
-    ep = _fit_ep(tier, n_avail, tp)
-    chips = tp * max(1, tier.sp, ep)
+    if mesh is not None:
+        tp = mesh.shape.get("tp", 1)
+        ep = mesh.shape.get("ep", 1)
+        sp = mesh.shape.get("sp", 1)
+        devices = list(mesh.devices.flat)
+    else:
+        tp = tier.tp
+        sp = tier.sp
+        # Budget the degree carve_tier_meshes would actually DEPLOY: ep
+        # must divide the expert count and fit the devices (param_specs
+        # silently replicates a non-dividing axis, which would certify a
+        # sharding no deployment uses).
+        from ..parallel.mesh import _fit_ep
+        n_avail = (len(devices) if devices is not None
+                   else len(jax.devices()))
+        ep = _fit_ep(tier, n_avail, tp)
+    chips = tp * max(1, sp, ep)
 
     # -- params (the serving engines' exact init + quantize pipeline) -----
     quantized = tier.quantize == "int8"
@@ -73,17 +90,18 @@ def tier_hbm_budget(tier, devices: Optional[Sequence[jax.Device]] = None,
     else:
         shapes = jax.eval_shape(lambda: models.init_params(cfg, 0))
     if tp > 1 or ep > 1:
-        need = tp * ep
-        if devices is None or len(devices) < need:
-            devices = jax.devices()
-        if len(devices) < need:
-            raise ValueError(f"need {need} devices to evaluate the "
-                             f"tp×ep sharding, have {len(devices)}")
-        from ..parallel.mesh import ep_tp_mesh, tp_mesh
         from ..parallel.sharding import (param_shardings,
                                          quantized_param_shardings)
-        mesh = (ep_tp_mesh(list(devices)[:need], ep, tp) if ep > 1
-                else tp_mesh(list(devices)[:tp], tp))
+        if mesh is None:
+            need = tp * ep
+            if devices is None or len(devices) < need:
+                devices = jax.devices()
+            if len(devices) < need:
+                raise ValueError(f"need {need} devices to evaluate the "
+                                 f"tp×ep sharding, have {len(devices)}")
+            from ..parallel.mesh import ep_tp_mesh, tp_mesh
+            mesh = (ep_tp_mesh(list(devices)[:need], ep, tp) if ep > 1
+                    else tp_mesh(list(devices)[:tp], tp))
         shardings = (quantized_param_shardings(cfg, mesh, shapes=shapes)
                      if quantized else param_shardings(cfg, mesh))
         params_gb = _sharded_tree_gb(shapes, shardings)
@@ -109,7 +127,7 @@ def tier_hbm_budget(tier, devices: Optional[Sequence[jax.Device]] = None,
         # The cache shards its kv-head axis over tp, and — under
         # sequence-parallel decode (dense bf16 caches,
         # parallel/sp_attention.py) — its sequence axis over sp.
-        sp_div = (tier.sp if tier.sp > 1 and cfg.num_experts == 1
+        sp_div = (sp if sp > 1 and cfg.num_experts == 1
                   and kvq == "none" else 1)
         kv_gb = _tree_gb(cache) / tp / sp_div
         # Each parked prefix-cache entry pins one full cache
@@ -134,3 +152,23 @@ def tier_hbm_budget(tier, devices: Optional[Sequence[jax.Device]] = None,
         "fits": total <= hbm_per_chip_gb - 0.75,
         "headroom_gb": round(hbm_per_chip_gb - total, 3),
     }
+
+
+def cluster_hbm_budget(cluster,
+                       devices: Optional[Sequence[jax.Device]] = None,
+                       hbm_per_chip_gb: float = DEFAULT_HBM_PER_CHIP_GB
+                       ) -> Dict[str, Dict[str, Any]]:
+    """Budget every local tier of ``cluster`` against the submesh
+    ``carve_tier_meshes`` actually hands it.
+
+    Tiers claim chips in declaration order, so a later tier's deployed
+    tp/sp/ep can be SMALLER (bigger per-chip footprint) than the tier
+    config asks for — budgeting each tier standalone against the full
+    pod would miss that.  Remote tiers (``endpoint`` set) are skipped:
+    their chips live on another host.
+    """
+    from ..parallel.mesh import carve_tier_meshes
+    meshes = carve_tier_meshes(cluster, devices)
+    return {tier.name: tier_hbm_budget(tier, hbm_per_chip_gb=hbm_per_chip_gb,
+                                       mesh=meshes[tier.name])
+            for tier in cluster.tiers() if not tier.endpoint}

@@ -1,0 +1,75 @@
+#!/bin/bash
+# Resume the round-3 TPU measurement sequence after a mid-run wedge.
+# Skips whatever already completed (pretrained checkpoints are kept on
+# disk; the full-grid micro A/B is OPTIONAL because bench.py measures a
+# fast same-backend dispatch table itself when none exists).
+#
+# Usage: scripts/tpu_round_resume.sh [--skip-ab]
+cd /root/repo
+log=/tmp/tpu_round.log
+{
+  echo "=== tpu_round RESUME $(date -u) @ $(git rev-parse --short HEAD) ==="
+
+  # Health gate: don't stack a new claimant onto a wedged chip.  Same
+  # poll-and-abandon discipline as bench.py's probe.
+  python - <<'PY'
+import subprocess, sys, time
+code = ("import jax, jax.numpy as jnp;"
+        "x = jnp.ones((256, 256));"
+        "jax.jit(lambda a: a @ a)(x).block_until_ready();"
+        "print('HEALTHY')")
+for attempt in range(4):
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            break
+        time.sleep(0.5)
+    if proc.poll() == 0 and "HEALTHY" in (proc.stdout.read() or ""):
+        print(f"probe attempt {attempt+1}: healthy")
+        sys.exit(0)
+    proc.kill()
+    print(f"probe attempt {attempt+1}: wedged/slow; backing off")
+    time.sleep(120)
+sys.exit(1)
+PY
+  if [ $? -ne 0 ]; then
+    echo "chip still wedged — resume aborted $(date -u)"
+    exit 1
+  fi
+
+  if [ "$1" != "--skip-ab" ] && [ ! -f distributed_llm_tpu/bench/ab_dispatch.json ]; then
+    # Fast-grid A/B only (the full grid wedged the chip once already);
+    # covers the shapes the headline serves.
+    python -m distributed_llm_tpu.bench.ab_kernels micro --tier orin \
+      --repeat 8 --fast --write-dispatch > /tmp/ab_micro_tpu_fast.json 2>&1 \
+      || echo "fast micro A/B failed"
+  fi
+
+  python bench.py > /tmp/BENCH_tpu.json 2> /tmp/bench_tpu.log \
+    || echo "bench exited nonzero ($?)"
+
+  DLLM_BENCH_SPEC_ORIN=1 python bench.py > /tmp/BENCH_tpu_spec.json \
+    2> /tmp/bench_tpu_spec.log || echo "spec bench exited nonzero ($?)"
+
+  python -m distributed_llm_tpu.bench.tune \
+    --headline /tmp/BENCH_tpu.json --spec /tmp/BENCH_tpu_spec.json \
+    --write || echo "tuning derivation failed"
+
+  mkdir -p bench/results_r3_tpu && ( cd bench/results_r3_tpu && \
+    python -m distributed_llm_tpu.bench.tester \
+      --query-set general_knowledge \
+      --strategies token semantic heuristic hybrid perf \
+      --cache-modes off on --thresholds 1000 \
+      --output-csv benchmark_results.csv \
+      --output-per-query-csv benchmark_per_query.csv \
+      > tester.log 2>&1 && \
+    python -m distributed_llm_tpu.bench.analysis \
+      --summary-csv benchmark_results.csv \
+      --per-query-csv benchmark_per_query.csv \
+      --output-md REPORT.md --plots-dir plots >> tester.log 2>&1 \
+  ) || echo "tpu tester sweep failed"
+
+  echo "=== tpu_round RESUME done $(date -u) ==="
+} >> "$log" 2>&1

@@ -93,3 +93,62 @@ def test_micro_ab_fast_mode_covers_all_kinds(tmp_path, monkeypatch):
     data = json.loads(out.read_text())
     for per_len in data["dispatch"].values():
         assert "default" in per_len
+
+
+def test_micro_ab_kinds_subset_merges_into_prior_table(tmp_path,
+                                                       monkeypatch):
+    """A --kinds re-run (isolating a case class after a chip wedge) must
+    MERGE into a same-backend table, not erase the other kinds' measured
+    winners (code-review r3), and must reject unknown kind names."""
+    import pytest
+
+    from distributed_llm_tpu.bench import ab_kernels
+    out = tmp_path / "ab_dispatch.json"
+    monkeypatch.setattr(ab_kernels, "DISPATCH_PATH", str(out))
+    ab_kernels.micro_ab("nano", repeat=1, write_dispatch=True, fast=True)
+    before = json.loads(out.read_text())["dispatch"]
+    assert "prefill" in before and "decode_q8" in before
+
+    res = ab_kernels.micro_ab("nano", repeat=1, write_dispatch=True,
+                              fast=True, kinds={"decode"})
+    assert {c["kind"] for c in res["cases"]} == {"decode"}
+    after = json.loads(out.read_text())["dispatch"]
+    assert after["prefill"] == before["prefill"]        # preserved
+    assert after["decode_q8"] == before["decode_q8"]    # preserved
+    assert "decode" in after                            # re-measured
+
+    with pytest.raises(ValueError, match="unknown kinds"):
+        ab_kernels.micro_ab("nano", repeat=1, kinds={"deocde_q8"})
+
+
+def test_dispatch_write_policy_hardware_beats_cpu(tmp_path):
+    """bench/tune.py's backend policy, mirrored: a cpu fallback never
+    clobbers a hardware table, but a hardware run may replace a stale
+    cpu table — and starts CLEAN (no cross-backend winner mixing),
+    while a same-backend partial run merges."""
+    from distributed_llm_tpu.bench.ab_kernels import publish_dispatch
+    out = str(tmp_path / "ab_dispatch.json")
+    tpu_table = {"decode": {"256": "xla", "default": "xla"}}
+
+    assert publish_dispatch("tpu", "m", tpu_table, path=out)
+    # cpu fallback refused against a hardware table.
+    assert not publish_dispatch("cpu", "m", {"prefill": {"default": "xla"}},
+                                path=out)
+    data = json.loads(open(out).read())
+    assert data["backend"] == "tpu" and "prefill" not in data["dispatch"]
+
+    # Same-backend partial run merges, keeping unmeasured kinds.
+    assert publish_dispatch("tpu", "m",
+                            {"prefill": {"default": "pallas"}}, path=out)
+    data = json.loads(open(out).read())
+    assert data["dispatch"]["decode"] == tpu_table["decode"]
+    assert data["dispatch"]["prefill"] == {"default": "pallas"}
+
+    # Hardware refresh over a stale cpu table starts clean.
+    with open(out, "w") as f:
+        json.dump({"backend": "cpu", "model": "m",
+                   "dispatch": {"chunk": {"default": "xla"}}}, f)
+    assert publish_dispatch("tpu", "m", tpu_table, path=out)
+    data = json.loads(open(out).read())
+    assert data["backend"] == "tpu"
+    assert "chunk" not in data["dispatch"], "cross-backend winners mixed"

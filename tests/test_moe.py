@@ -196,3 +196,29 @@ def test_moe_8x1b_fits_its_ep8_submesh():
     tp4 = tier_hbm_budget(TierConfig(name="moe", model_preset="moe_8x1b",
                                      tp=4, max_new_tokens=64))
     assert b["params_gb_per_chip"] < tp4["params_gb_per_chip"], (b, tp4)
+
+
+def test_cluster_budget_uses_deployed_ep_not_full_pod():
+    """A later tier sees only the chips earlier tiers left over:
+    nano(tp=1) + moe(ep=8) on 8 devices deploys ep=4 (7 remain, largest
+    divisor of 8 experts ≤ 7), so the honest per-chip params figure is
+    ~2x the standalone ep=8 certification (code-review r3).  Budgets are
+    eval_shape-only, so the 8x1B flagship runs fine on the CPU suite."""
+    from distributed_llm_tpu.config import ClusterConfig, TierConfig
+    from distributed_llm_tpu.utils.hbm_budget import (cluster_hbm_budget,
+                                                      tier_hbm_budget)
+
+    moe = TierConfig(name="orin", model_preset="moe_8x1b", ep=8,
+                     max_new_tokens=16)
+    cluster = ClusterConfig(
+        nano=TierConfig(name="nano", model_preset="nano_test", tp=1),
+        orin=moe)
+    deployed = cluster_hbm_budget(cluster)
+    standalone = tier_hbm_budget(moe)
+    assert standalone["chips"] == 8, standalone
+    assert deployed["orin"]["chips"] == 4, deployed
+    # Half the ep degree → roughly double the expert bytes per chip.
+    assert (deployed["orin"]["params_gb_per_chip"]
+            > 1.5 * standalone["params_gb_per_chip"]), (deployed, standalone)
+    # The first-declared tier keeps its full claim.
+    assert deployed["nano"]["chips"] == 1
