@@ -493,8 +493,12 @@ def run(progress: "Progress" = None) -> dict:
     # on real hardware and no same-backend dispatch table exists — e.g.
     # the chip recovered only at driver-bench time — measure a fast one
     # first so the headline serves WITH the measured kernel choices
-    # instead of un-dispatched.  scripts/tpu_round.sh's full A/B remains
-    # the thorough path; DLLM_BENCH_NO_AB=1 skips this.
+    # instead of un-dispatched.  When bench.py runs as a script, __main__
+    # already did this OUT OF PROCESS (per-kind subprocesses with
+    # timeouts — the r3 chip wedged mid-A/B on one kernel compile, and an
+    # in-process hang would eat the watchdog and abort the whole
+    # headline) and set DLLM_BENCH_NO_AB=1; this in-process path remains
+    # for programmatic callers.
     import os as _os
     if backend != "cpu" and _os.environ.get("DLLM_BENCH_NO_AB") != "1":
         try:
@@ -799,6 +803,91 @@ def run(progress: "Progress" = None) -> dict:
     }
 
 
+def _measure_dispatch_out_of_process(timeout_per_kind_s: float = 420.0
+                                     ) -> None:
+    """Measure the fast dispatch table via per-kind SUBPROCESSES before
+    this process claims the chip.
+
+    The r3 chip wedged mid-A/B on a single kernel-compile case; done
+    in-process that hang would idle the watchdog out and abort the whole
+    headline.  Out of process, one kind hanging costs its timeout: the
+    child is killed, the kind's dispatch default is pinned to "xla" (a
+    hang is decisive evidence against serving that kernel), the chip is
+    re-probed until the grant clears, and the remaining kinds still get
+    measured.  Partial writes merge (ab_kernels.publish_dispatch), so
+    every completed kind lands in the table even if a later one dies."""
+    import subprocess
+    import sys
+
+    from distributed_llm_tpu.bench import ab_kernels
+
+    # Which backend would a child see?  Probe cheaply via the table the
+    # caller wants: a same-backend table means nothing to do.  The
+    # backend string itself comes from the health probe's platform — on
+    # this box non-cpu means the axon TPU.
+    have = None
+    try:
+        with open(ab_kernels.DISPATCH_PATH) as f:
+            have = json.load(f).get("backend")
+    except (OSError, ValueError):
+        pass
+    if have is not None and have != "cpu":
+        print("[bench] dispatch table already measured on hardware",
+              file=sys.stderr, flush=True)
+        return
+
+    pending = sorted(ab_kernels.ALL_KINDS)
+    for i, kind in enumerate(pending):
+        cmd = [sys.executable, "-m",
+               "distributed_llm_tpu.bench.ab_kernels", "micro",
+               "--tier", "orin", "--repeat", "8", "--fast",
+               "--write-dispatch", "--kinds", kind]
+        print(f"[bench] dispatch A/B {kind} ({i + 1}/{len(pending)})",
+              file=sys.stderr, flush=True)
+        try:
+            ablog = open("/tmp/bench_ab_kinds.log", "ab")
+            proc = subprocess.Popen(cmd, stdout=ablog, stderr=ablog)
+            ablog.close()
+        except OSError:
+            return
+        deadline = time.monotonic() + timeout_per_kind_s
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                break
+            time.sleep(1.0)
+        if proc.poll() is None:
+            proc.kill()          # best effort; do NOT wait on a D-state
+            print(f"[bench] dispatch A/B {kind} TIMED OUT — pinning it "
+                  "to xla and re-probing the chip", file=sys.stderr,
+                  flush=True)
+            # A kernel that can't even finish its A/B must not serve.
+            try:
+                ab_kernels.publish_dispatch(
+                    "tpu", "timeout", {kind: {"default": "xla",
+                                              "timeout_demoted": True}})
+            except OSError:
+                pass
+            # The killed child's chip grant takes a while to expire;
+            # don't stack the next claimant onto it.
+            for backoff in (60.0, 180.0, 300.0):
+                time.sleep(backoff)
+                if _accelerator_healthy():
+                    break
+            else:
+                print("[bench] chip did not recover after A/B timeout — "
+                      "skipping the remaining kinds", file=sys.stderr,
+                      flush=True)
+                for rest in pending[i + 1:]:
+                    try:
+                        ab_kernels.publish_dispatch(
+                            "tpu", "timeout",
+                            {rest: {"default": "xla",
+                                    "timeout_demoted": True}})
+                    except OSError:
+                        pass
+                return
+
+
 def _accelerator_configured() -> bool:
     # Probe unless the run is EXPLICITLY pinned to CPU: with the env var
     # unset jax may auto-detect a TPU, which is exactly the case that can
@@ -864,6 +953,12 @@ if __name__ == "__main__":
         backoffs = [60.0, 180.0, 300.0]
         for attempt in range(attempts):
             if _accelerator_healthy():
+                # Measure the dispatch table out of process BEFORE this
+                # process claims the chip (see the function docstring),
+                # then keep run() from re-measuring in-process.
+                if os.environ.get("DLLM_BENCH_NO_AB") != "1":
+                    _measure_dispatch_out_of_process()
+                    os.environ["DLLM_BENCH_NO_AB"] = "1"
                 break
             print(f"[bench] accelerator probe failed/hung (attempt "
                   f"{attempt + 1}/{attempts})", file=sys.stderr, flush=True)

@@ -106,3 +106,66 @@ time.sleep(30)                       # watchdog must fire long before this
     line = json.loads(proc.stdout.strip().splitlines()[-1])
     assert line["backend"] == "tpu" and line["value"] == 9.9
     assert "aborted" in line and "wedged" in line["aborted"]
+
+
+def test_out_of_process_ab_skips_when_hardware_table_exists(tmp_path,
+                                                            monkeypatch):
+    from distributed_llm_tpu.bench import ab_kernels
+    table = tmp_path / "ab_dispatch.json"
+    table.write_text(json.dumps({"backend": "tpu", "model": "m",
+                                 "dispatch": {}}))
+    monkeypatch.setattr(ab_kernels, "DISPATCH_PATH", str(table))
+    calls = []
+    monkeypatch.setattr(bench, "_accelerator_healthy",
+                        lambda *a, **k: calls.append("probe") or True)
+    import subprocess as sp
+    monkeypatch.setattr(sp, "Popen",
+                        lambda *a, **k: calls.append("spawn"))
+    bench._measure_dispatch_out_of_process()
+    assert calls == [], "hardware table present: nothing should run"
+
+
+def test_out_of_process_ab_timeout_pins_kind_to_xla(tmp_path, monkeypatch):
+    """A hanging per-kind A/B child is killed, its kind is demoted to
+    xla (timeout_demoted), the chip is re-probed, and later kinds still
+    run — one wedged kernel compile must not cost the headline."""
+    from distributed_llm_tpu.bench import ab_kernels
+    table = tmp_path / "ab_dispatch.json"
+    monkeypatch.setattr(ab_kernels, "DISPATCH_PATH", str(table))
+    monkeypatch.setattr(bench, "_accelerator_healthy", lambda *a, **k: True)
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+
+    spawned = []
+
+    class FakeProc:
+        def __init__(self, kind, hang):
+            self.kind, self.hang, self.killed = kind, hang, False
+
+        def poll(self):
+            if self.hang and not self.killed:
+                return None
+            # A completing child writes its kind via the real merge path.
+            ab_kernels.publish_dispatch(
+                "tpu", "m", {self.kind: {"default": "pallas"}},
+                path=str(table))
+            return 0
+
+        def kill(self):
+            self.killed = True
+
+    def fake_popen(cmd, **kw):
+        kind = cmd[cmd.index("--kinds") + 1]
+        spawned.append(kind)
+        return FakeProc(kind, hang=(kind == "decode_q8"))
+
+    import subprocess as sp
+    monkeypatch.setattr(sp, "Popen", fake_popen)
+    bench._measure_dispatch_out_of_process(timeout_per_kind_s=0.1)
+
+    assert spawned == sorted(ab_kernels.ALL_KINDS)
+    data = json.loads(table.read_text())
+    assert data["backend"] == "tpu"
+    assert data["dispatch"]["decode_q8"] == {"default": "xla",
+                                             "timeout_demoted": True}
+    for kind in sorted(ab_kernels.ALL_KINDS - {"decode_q8"}):
+        assert data["dispatch"][kind] == {"default": "pallas"}, kind
