@@ -1,11 +1,47 @@
 #!/bin/bash
 # Full TPU measurement sequence for a freshly healthy chip (round 3).
-# Run exactly ONE instance; every step is a separate sequential claimant.
-# Never kill these processes mid-run — a killed claimant wedges the chip.
+# Run exactly ONE instance.  Every chip-claiming step is timeout-wrapped
+# and health-gated: the r3 chip wedged mid-A/B and an unwrapped step
+# hangs forever (the claimant sleeps in the claim/response path).  A
+# timed-out claimant is killed (SIGTERM exits it cleanly; its grant
+# expires server-side in minutes) and the gate re-probes before the
+# next step.  Safe to re-run: completed checkpoints are kept, the
+# dispatch table merge-writes, and the tester sweep is cheap.
 cd /root/repo
 log=/tmp/tpu_round.log
+
+probe_until_healthy() {   # $1 = attempts (default 6)
+  local attempts=${1:-6}
+  python - "$attempts" <<'PY'
+import subprocess, sys, time
+attempts = int(sys.argv[1])
+code = ("import jax, jax.numpy as jnp;"
+        "x = jnp.ones((256, 256));"
+        "jax.jit(lambda a: a @ a)(x).block_until_ready();"
+        "print('HEALTHY')")
+for attempt in range(attempts):
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    deadline = time.monotonic() + 150
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            break
+        time.sleep(0.5)
+    if proc.poll() == 0 and "HEALTHY" in (proc.stdout.read() or ""):
+        print(f"probe {attempt + 1}/{attempts}: healthy")
+        sys.exit(0)
+    proc.kill()          # best effort; do NOT wait on a D-state child
+    print(f"probe {attempt + 1}/{attempts}: wedged; backing off")
+    if attempt < attempts - 1:
+        time.sleep(180)
+sys.exit(1)
+PY
+}
+
 {
   echo "=== tpu_round start $(date -u) @ $(git rev-parse --short HEAD) ==="
+  probe_until_healthy || { echo "chip wedged — aborting"; exit 1; }
 
   # 0. Bench-tier checkpoints from an older vocabulary are unloadable
   #    (round 3 moved the engine to the 4096-id subword BPE): clear any
@@ -22,41 +58,58 @@ for preset in ("nano_bench", "orin_bench"):
         shutil.rmtree(path, ignore_errors=True)
 PY
 
-  # 1. Bench-tier pretrained checkpoints (VERDICT r2 #8).  Minutes on a
-  #    v5e; --save-every leaves a resumable 'latest' if the chip dies
-  #    mid-run.  Local-only artifacts (gitignored by size).
+  # 1. Bench-tier pretrained checkpoints (VERDICT r2 #8).  ~15 min each
+  #    on a v5e incl. the tunnel-bound checkpoint saves; --save-every
+  #    leaves a resumable 'latest' if the chip dies mid-run.  Local-only
+  #    artifacts (gitignored by size).
   if [ ! -L checkpoints/nano_bench/latest ]; then
-    python -m distributed_llm_tpu.training.pretrain --preset nano_bench \
-      --out checkpoints/nano_bench --batch-size 16 --seq-len 256 \
-      --max-steps 800 --save-every 100 \
-      || echo "nano_bench pretrain FAILED — bench will serve random init"
+    timeout 2700 python -m distributed_llm_tpu.training.pretrain \
+      --preset nano_bench --out checkpoints/nano_bench --batch-size 16 \
+      --seq-len 256 --max-steps 800 --save-every 100 \
+      || echo "nano_bench pretrain failed/timed out ($?)"
+    probe_until_healthy || { echo "chip wedged — aborting"; exit 1; }
   fi
   if [ ! -L checkpoints/orin_bench/latest ]; then
-    python -m distributed_llm_tpu.training.pretrain --preset orin_bench \
-      --out checkpoints/orin_bench --batch-size 4 --seq-len 256 \
-      --max-steps 500 --save-every 100 \
-      || echo "orin_bench pretrain FAILED (HBM?) — continuing without it"
+    timeout 2700 python -m distributed_llm_tpu.training.pretrain \
+      --preset orin_bench --out checkpoints/orin_bench --batch-size 4 \
+      --seq-len 256 --max-steps 500 --save-every 100 \
+      || echo "orin_bench pretrain failed/timed out ($?)"
+    probe_until_healthy || { echo "chip wedged — aborting"; exit 1; }
   fi
 
-  # 2. Per-kernel micro A/B on quiet hardware; publish the dispatch table
-  #    (VERDICT r2 #4).  The writer refuses to clobber a table measured
-  #    on a different backend and emits per-kind "default" winners.
-  python -m distributed_llm_tpu.bench.ab_kernels micro --tier orin \
-    --repeat 20 --write-dispatch > /tmp/ab_micro_tpu.json 2>&1 \
-    || echo "micro A/B failed"
+  # 2. Per-kernel micro A/B on quiet hardware, ONE KIND PER PROCESS with
+  #    a timeout (VERDICT r2 #4; the r3 chip wedged mid-grid on the
+  #    decode_q8@1024 compile, taking the whole table with it).  Partial
+  #    results merge into bench/ab_dispatch.json; a timed-out kind keeps
+  #    whatever the committed table already says about it (the bench.py
+  #    pre-measure additionally pins hang-prone kinds to xla).
+  for kind in prefill decode decode_q8 chunk chunk_q8 paged_decode \
+              paged_decode_q8; do
+    timeout 600 python -m distributed_llm_tpu.bench.ab_kernels micro \
+      --tier orin --repeat 20 --write-dispatch --kinds "$kind" \
+      >> /tmp/ab_micro_tpu.json 2>&1
+    rc=$?
+    if [ $rc -ne 0 ]; then
+      echo "micro A/B kind=$kind failed/timed out ($rc)"
+      probe_until_healthy || { echo "chip wedged — aborting"; exit 1; }
+    fi
+  done
 
   # 3. Headline TPU bench (VERDICT r2 #1): prints full detail first and a
   #    compact driver-parseable FINAL line; partials checkpoint to
-  #    BENCH_partial.json; the watchdog aborts with partials on a wedge.
-  #    Includes the flagship nano_1b / orin_8b-int8 phase and the orin
-  #    prefix-reuse pass (VERDICT r2 #2/#6).
-  python bench.py > /tmp/BENCH_tpu.json 2> /tmp/bench_tpu.log \
-    || echo "bench exited nonzero ($?)"
+  #    BENCH_partial.json; its own watchdog aborts with partials on a
+  #    wedge.  Includes the flagship nano_1b / orin_8b-int8 phase and the
+  #    orin prefix-reuse pass (VERDICT r2 #2/#6).
+  timeout 5400 python bench.py > /tmp/BENCH_tpu.json 2> /tmp/bench_tpu.log \
+    || echo "bench exited nonzero/timed out ($?)"
+  probe_until_healthy || { echo "chip wedged — aborting"; exit 1; }
 
   # 4. Speculative-orin headline A/B (draft = nano model, greedy-exact):
   #    decides whether the spec default flips (VERDICT r2 #5).
-  DLLM_BENCH_SPEC_ORIN=1 python bench.py > /tmp/BENCH_tpu_spec.json \
-    2> /tmp/bench_tpu_spec.log || echo "spec bench exited nonzero ($?)"
+  DLLM_BENCH_SPEC_ORIN=1 timeout 5400 python bench.py \
+    > /tmp/BENCH_tpu_spec.json 2> /tmp/bench_tpu_spec.log \
+    || echo "spec bench exited nonzero/timed out ($?)"
+  probe_until_healthy || { echo "chip wedged — aborting"; exit 1; }
 
   # 4b. Measured serving defaults (VERDICT r2 #5): derive the tuning
   #     table from the two bench artifacts so bench_cluster's
@@ -68,7 +121,7 @@ PY
   # 5. Reference-CLI harness sweep ON CHIP (bench tiers, trained
   #    checkpoints): the r2/r3 artifact sets were CPU-only.
   mkdir -p bench/results_r3_tpu && ( cd bench/results_r3_tpu && \
-    python -m distributed_llm_tpu.bench.tester \
+    timeout 3600 python -m distributed_llm_tpu.bench.tester \
       --query-set general_knowledge \
       --strategies token semantic heuristic hybrid perf \
       --cache-modes off on --thresholds 1000 \
@@ -79,7 +132,7 @@ PY
       --summary-csv benchmark_results.csv \
       --per-query-csv benchmark_per_query.csv \
       --output-md REPORT.md --plots-dir plots >> tester.log 2>&1 \
-  ) || echo "tpu tester sweep failed"
+  ) || echo "tpu tester sweep failed/timed out"
 
   echo "=== tpu_round done $(date -u) ==="
 } >> "$log" 2>&1
