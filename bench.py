@@ -164,7 +164,7 @@ def concurrent_phase(cluster, n_requests: int = 12, n_sequential: int = 4,
     engine = ContinuousBatchingEngine(tier, seed=1)
     try:
         beat()
-        engine.warmup()
+        engine.warmup(beat=beat)
         beat()
         print("[bench] batching engine warm", file=sys.stderr, flush=True)
         queries = [
@@ -207,7 +207,7 @@ def concurrent_phase(cluster, n_requests: int = 12, n_sequential: int = 4,
             dataclasses.replace(tier, kv_quantize="int8"), seed=1)
         try:
             beat()
-            q8.warmup()
+            q8.warmup(beat=beat)
             beat()
             # Match the bf16 engine's state: its sequential pass already
             # compiled the real query bucket before its timed region.
@@ -533,9 +533,11 @@ def run(progress: "Progress" = None) -> dict:
     gen_tokens = 0
 
     router = Router(strategy=STRATEGIES[0], benchmark_mode=True)
-    # Compile/warm both tier engines before the timed region.
+    # Compile/warm both tier engines before the timed region.  The beat
+    # callback keeps the wedge watchdog fed through warmup — dozens of
+    # 20-40 s compiles per tier on chip, well past the 900 s window.
     for tier in router.tiers.values():
-        tier.server_manager.start_server()
+        tier.server_manager.start_server(beat=progress.beat)
         progress.beat()
 
     for strategy in STRATEGIES:

@@ -668,13 +668,17 @@ class ContinuousBatchingEngine:
 
         return StreamHandle(deltas(), req)
 
-    def warmup(self) -> None:
+    def warmup(self, beat=None) -> None:
         """Compile the decode tick + smallest cold-prefill bucket (via one
         real request), then the chunk-prefill programs for the two smallest
         suffix buckets so the first prefix-reuse admission doesn't pay an
         XLA trace.  Runs before serving traffic: the scheduler is idle
-        (no active slots), so mutating the pool here doesn't race a tick."""
+        (no active slots), so mutating the pool here doesn't race a tick.
+        ``beat`` fires after each compiled program (liveness for bench.py's
+        wedge watchdog through multi-minute on-chip warmups)."""
+        beat = beat or (lambda: None)
         self.generate("warmup", max_new_tokens=2)
+        beat()
         # The batched decode program retraces per gather-window rung; a
         # mid-serve retrace stalls EVERY active slot for the compile.
         # The warm request covered the first rung — also compile the
@@ -690,6 +694,7 @@ class ContinuousBatchingEngine:
                 jnp.asarray(self._pos), jnp.asarray(self._cur),
                 jnp.asarray(self._temps), rng)
             jax.block_until_ready(toks)
+            beat()
         if self.prefix_cache is not None and self._buckets:
             row = self._table_row([])
             # Every (reuse suffix bucket, chunk window rung) an admit
@@ -707,6 +712,7 @@ class ContinuousBatchingEngine:
                         jnp.asarray([1], np.int32),
                         jnp.asarray(row), rng, jnp.float32(0.0))
                     jax.block_until_ready(first)
+                    beat()
 
 
 class StreamHandle:

@@ -764,16 +764,22 @@ class InferenceEngine:
 
         return StreamHandle(deltas(), req)
 
-    def warmup(self) -> None:
+    def warmup(self, beat=None) -> None:
         """Compile EVERY prefill bucket + the decode loop, and (when prefix
         reuse is on) the suffix-prefill programs for the two smallest
         buckets — typical chat turns land there.  Compiling everything at
         startup keeps every request's TTFT free of XLA traces: lazy
         per-bucket compiles otherwise land inside whichever strategy run
         first crosses each prompt-length bucket (visible as a TTFT spike on
-        the benchmark's first strategy)."""
+        the benchmark's first strategy).
+
+        ``beat`` (liveness callback) fires after every compiled program:
+        a full warmup is dozens of 20-40 s compiles on chip — far past
+        bench.py's 900 s wedge watchdog if warmup were silent."""
+        beat = beat or (lambda: None)
         from ..utils.telemetry import PhaseTimer
         self.generate("warmup", max_new_tokens=1)
+        beat()
         cap = self.tier.max_new_tokens
         # generate() sizes caches as pick(max(n + cap, bucket)) with
         # prev_bucket < n <= bucket, so each bucket can land on the ladder
@@ -800,6 +806,7 @@ class InferenceEngine:
                     jax.block_until_ready(out)
                 else:
                     jax.block_until_ready(first)
+                beat()
                 warm_caches.setdefault(cache_len, cache)
         if self.prefix_cache is not None:
             # Suffix programs are keyed (sb, cache_len) — window is always
@@ -844,6 +851,7 @@ class InferenceEngine:
                         jax.random.PRNGKey(0), jnp.float32(0.0))
                     warm_caches[cache_len] = cache
                     jax.block_until_ready(first)
+                    beat()
         # Free the pinned rung caches before the chunked-long block
         # allocates its own max-rung cache (transient-HBM headroom).
         warm_caches.clear()
@@ -864,6 +872,8 @@ class InferenceEngine:
                     jnp.full((1, cb), self.tokenizer.pad_id, jnp.int32),
                     jnp.asarray([0], jnp.int32), jnp.asarray([1], jnp.int32),
                     jax.random.PRNGKey(0), jnp.float32(0.0))
+                jax.block_until_ready(first)
+                beat()
             if cache_len not in self._decode_fns:
                 out, _, _ = self._decode_loop(cache_len)(
                     self.params, cache, jnp.asarray([0], np.int32),

@@ -46,8 +46,11 @@ class EngineManager:
 
     # -- lifecycle (ServerManager surface) ---------------------------------
 
-    def start_server(self) -> None:
-        """Idempotent: build the engine and compile/warm the hot paths."""
+    def start_server(self, beat=None) -> None:
+        """Idempotent: build the engine and compile/warm the hot paths.
+        ``beat`` (optional liveness callback) is forwarded to the
+        engine's warmup — on chip a full warmup is many multi-10s
+        compiles, longer than bench.py's wedge watchdog window."""
         with self._lock:
             if self._engine is not None:
                 return
@@ -58,6 +61,8 @@ class EngineManager:
                 params = load_params_for_tier(
                     self.tier.checkpoint_path, self.tier.model(),
                     mesh=self.mesh, devices=self.devices)
+                if beat is not None:
+                    beat()
             use_speculative = bool(self.tier.draft_preset)
             if use_speculative and (self.mesh is not None
                                     or self.tier.decode_batch > 1
@@ -93,7 +98,7 @@ class EngineManager:
                     self.tier, seed=self.seed, mesh=self.mesh,
                     devices=self.devices, params=params)
             if self.warmup_on_start:
-                engine.warmup()
+                engine.warmup(beat=beat)
             self._engine = engine
             self._started_at = time.time()
             logger.info("tier %s up in %.1fs (model=%s, devices=%s)",

@@ -480,15 +480,19 @@ class SpeculativeEngine:
             return 0.0
         return float(np.mean(self.accept_history)) / self.gamma
 
-    def warmup(self) -> None:
+    def warmup(self, beat=None) -> None:
         # Compile BOTH compiled paths — the fused loop (generate) and the
         # per-round step (generate_stream) are separate jits, and real
         # traffic prefers streaming (serving/tiers.py process_stream) —
         # at EVERY cache rung a conversation can grow into, so no request
-        # ever pays a mid-serve trace of the speculative graph.
+        # ever pays a mid-serve trace of the speculative graph.  ``beat``
+        # fires per compiled program (bench.py watchdog liveness).
+        beat = beat or (lambda: None)
         self.generate("warmup", max_new_tokens=self.gamma + 2)
+        beat()
         for _ in self.generate_stream("warmup", max_new_tokens=self.gamma):
             pass
+        beat()
         # Every (bucket, cache rung) pair _prepare_and_prefill can pick —
         # same two-rung-per-bucket coverage as InferenceEngine.warmup —
         # plus, once per rung, both speculative graphs (the fused loop and
@@ -512,6 +516,7 @@ class SpeculativeEngine:
                                        tokens, one)
                 if cache_len in done_rungs:
                     jax.block_until_ready(first)
+                    beat()
                     continue
                 done_rungs.add(cache_len)
                 out, *_ = self._spec_loop(cache_len)(
@@ -522,4 +527,5 @@ class SpeculativeEngine:
                     self.params_t, self.params_d, cache_t, cache_d,
                     first, one)
                 jax.block_until_ready(out)
+                beat()
         self.accept_history.clear()   # don't skew acceptance_rate

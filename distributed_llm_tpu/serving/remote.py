@@ -90,9 +90,11 @@ class RemoteServerManager:
         return _http_json(f"{self.base_url}/health",
                           timeout=self.connect_timeout)
 
-    def start_server(self) -> None:
+    def start_server(self, beat=None) -> None:
         """Wait for the remote tier to be ready (reference readiness
-        protocol: /health poll 15×1 s, server_manager.py:122-134)."""
+        protocol: /health poll 15×1 s, server_manager.py:122-134).
+        ``beat`` is accepted for EngineManager signature parity (callers
+        feed a liveness watchdog); the wait loop is already bounded."""
         for attempt in range(HEALTH_POLL_ATTEMPTS):
             if self.is_server_running():
                 return

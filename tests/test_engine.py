@@ -230,3 +230,23 @@ def test_long_suffix_reuse_chunks_from_matched_prefix():
     r2c = cold.generate(follow)
     assert r2.token_ids == r2c.token_ids
     assert r2.prompt_tokens == r2c.prompt_tokens > 64
+
+
+def test_warmup_feeds_liveness_beats():
+    """Every engine warmup fires its beat callback per compiled program
+    (and EngineManager forwards it): on chip a full warmup is dozens of
+    20-40 s compiles — silent, it would idle out bench.py's 900 s wedge
+    watchdog and abort the headline before serving starts."""
+    from distributed_llm_tpu.config import tiny_cluster
+    from distributed_llm_tpu.engine.manager import EngineManager
+
+    beats = []
+    mgr = EngineManager(tiny_cluster().nano, seed=0)
+    mgr.start_server(beat=lambda: beats.append(1))
+    try:
+        # One beat per compiled program: at minimum the cold generate
+        # plus each (bucket, rung) warm — the exact count tracks the
+        # ladder, so pin only the floor.
+        assert len(beats) >= 3, beats
+    finally:
+        mgr.stop_server()
