@@ -365,6 +365,8 @@ def main(argv=None) -> None:
                          "sitecustomize")
     args = ap.parse_args(argv)
 
+    from ..utils.compile_cache import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
     if args.platform:
         import jax
         jax.config.update("jax_platforms", args.platform)

@@ -431,6 +431,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
 
 def main(argv: Optional[List[str]] = None) -> None:
     args = parse_args(argv)
+    # Persistent compile cache: the sweep builds a FRESH Router (fresh
+    # jit closures) per config — on chip, without the cache, every
+    # config re-pays the full warmup compile bill.
+    from ..utils.compile_cache import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
     if args.platform:
         import jax
         jax.config.update("jax_platforms", args.platform)

@@ -113,6 +113,8 @@ def main(argv=None) -> None:
     ap.add_argument("--cpu", action="store_true",
                     help="pin jax to host CPU (safe on a wedged-chip box)")
     args = ap.parse_args(argv)
+    from ..utils.compile_cache import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
     pretrain(args.preset, args.out, batch_size=args.batch_size,

@@ -1,0 +1,50 @@
+"""Persistent XLA compilation cache for the chip entry points.
+
+Every bench/measurement process on this box recompiles the same
+programs: the tester builds a fresh Router per config (reference
+semantics — routing_chatbot_tester.py:368-376), tpu_round.sh runs each
+step as a separate claimant process, and the driver's round-end bench
+is yet another process.  On chip each compile is 20-40 s, so the sweep
+cost is compile-dominated.  JAX's persistent cache keys serialized
+executables by HLO hash on disk — fresh processes (and fresh jit
+closures inside one process) deserialize instead of recompiling.
+
+The test suite wires the same thing in tests/conftest.py; this helper
+is for the runtime entry points (bench.py, bench.tester, ab_kernels,
+training.pretrain).  Call before the first device computation; the
+cache dir is env-overridable (JAX_COMPILATION_CACHE_DIR wins if set).
+"""
+
+from __future__ import annotations
+
+import os
+
+DEFAULT_CACHE_DIR = "/tmp/dllm_jax_cache"
+
+
+def enable_persistent_compile_cache(path: str = None) -> str:
+    """Point jax at a persistent compilation cache; returns the dir.
+
+    Also exports the env vars so child processes (bench.py's per-kind
+    A/B subprocesses, subprocess-driven tests) inherit the same cache.
+    Safe to call any time before (or even after) backend init; a
+    backend that can't serialize executables just logs and skips —
+    never an error.
+    """
+    path = (path or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+            or DEFAULT_CACHE_DIR)
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = path
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0.5")
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", int(
+            os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"]))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(os.environ[
+                              "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+    except Exception:      # older jax without a knob: env vars still apply
+        pass
+    return path
