@@ -241,6 +241,18 @@ class TierConfig:
     # device endpoints, src/models/nano.py:4-8).  When set, no local
     # engine/submesh is built for this tier; requests POST /query there.
     endpoint: Optional[str] = None
+    # Per-request wall-clock cap, mirroring the reference clients' HTTP
+    # read timeout (requests.post(..., timeout=(5, 180)),
+    # src/models/nano.py:28): a device call that exceeds it returns the
+    # reference error-dict shape so the router can fail over and the
+    # perf strategy records the failure — an in-process engine on a
+    # wedged chip would otherwise hang the serving thread forever and
+    # no failure machinery could fire.  None disables the cap.  The
+    # abandoned call keeps its worker thread until the device returns
+    # (in-process calls can't be cancelled), matching the reference's
+    # semantics where the Jetson keeps crunching after the client
+    # times out.
+    request_timeout_s: Optional[float] = 180.0
 
     def model(self) -> ModelConfig:
         return MODEL_PRESETS[self.model_preset]

@@ -92,6 +92,11 @@ class ContinuousBatchingEngine:
     shards its kv-head axis (kv_pool_specs), so many concurrent requests
     share one batched decode loop across the tier's chips."""
 
+    # generate() is designed for concurrent callers (the scheduler owns
+    # slot admission); TierClient reads this to skip its serialization
+    # lock — sequential engines without it assume serialized callers.
+    concurrent_safe = True
+
     def __init__(self, tier: TierConfig, seed: int = 0,
                  params: Optional[Dict[str, Any]] = None,
                  mesh: Optional[jax.sharding.Mesh] = None,

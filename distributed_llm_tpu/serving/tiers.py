@@ -15,6 +15,7 @@ to fail naturally.
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
@@ -42,19 +43,63 @@ class TierClient:
         self.server_manager = manager          # name matches reference surface
         self.faults = fault_injector
         self.last_result: Optional[GenerationResult] = None
+        # Serializes the sequential engines once request timeouts can
+        # abandon a still-running worker thread (engines without
+        # ``concurrent_safe`` assume serialized callers); the batched
+        # engine opts out via that attribute.
+        self._engine_lock = threading.Lock()
 
     def process(self, history: History) -> Dict[str, Any]:
-        """Run inference; error dicts mirror the reference client shapes."""
+        """Run inference; error dicts mirror the reference client shapes.
+
+        ``tier.request_timeout_s`` mirrors the reference clients' HTTP
+        read timeout (src/models/nano.py:28, timeout=(5, 180)): the
+        engine call runs in a worker thread, and past the cap this
+        returns the reference error-dict shape — so Router failover and
+        the perf strategy's failure penalty fire even though an
+        in-process call on a wedged chip can never be cancelled.  The
+        abandoned worker finishes (or hangs) in the background, exactly
+        like the reference's Jetson finishing a response nobody waits
+        for; ``last_result`` may later reflect that stale completion
+        (only observable when timeouts are already firing)."""
         if self.faults is not None:
             fault = self.faults.intercept(self.name)
             if fault is not None:
                 return fault
 
+        timeout = self.tier.request_timeout_s
+        if timeout is None:
+            return self._process_body(history)
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def work():
+            try:
+                box["out"] = self._process_body(history)
+            finally:
+                done.set()
+
+        threading.Thread(target=work, daemon=True,
+                         name=f"{self.name}-request").start()
+        if not done.wait(timeout):
+            logger.warning("tier %s request exceeded %.0fs — abandoning "
+                           "the device call and reporting failure",
+                           self.name, timeout)
+            return {"error": f"Request failed: {self.name} timed out "
+                             f"after {timeout:.0f}s"}
+        return box.get("out", {"error": "Request failed: worker died"})
+
+    def _process_body(self, history: History) -> Dict[str, Any]:
         try:
             if not self.server_manager.is_server_running():
                 logger.info("No running %s engine found, starting...", self.name)
                 self.server_manager.start_server()
-            result = self.server_manager.engine().generate(history)
+            engine = self.server_manager.engine()
+            if getattr(engine, "concurrent_safe", False):
+                result = engine.generate(history)
+            else:
+                with self._engine_lock:
+                    result = engine.generate(history)
         except Exception as exc:   # engine failure → reference error shape
             return {"error": f"Request failed: {exc}"}
 
@@ -68,7 +113,12 @@ class TierClient:
         PRIMED (first token pulled, i.e. prefill has run) before this
         returns — engine errors are lazy, surfacing at first iteration,
         so priming is what makes setup-time failover able to catch real
-        engine failures, not just injected ones."""
+        engine failures, not just injected ones.
+
+        No request timeout here (unlike ``process``): a stream is
+        consumed incrementally by the caller, so there is no single
+        bounded wait to cap — a wedged chip stalls the SSE consumer,
+        which owns its own disconnect policy."""
         if self.faults is not None:
             fault = self.faults.intercept(self.name)
             if fault is not None:

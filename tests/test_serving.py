@@ -332,3 +332,126 @@ def test_ui_routes_served_with_content_types(cluster):
     css = c.get("/ui/style.css")
     assert css.status_code == 200
     assert "text/css" in css.content_type
+
+
+# -- request timeouts (reference parity: src/models/nano.py:28 (5,180)) -----
+
+class _StubManager:
+    """EngineManager stand-in whose engine the test controls."""
+
+    def __init__(self, engine):
+        self._engine = engine
+
+    def is_server_running(self):
+        return True
+
+    def engine(self):
+        return self._engine
+
+
+def _timeout_tier(timeout):
+    import dataclasses
+    return dataclasses.replace(tiny_cluster().nano,
+                               request_timeout_s=timeout)
+
+
+def test_request_timeout_returns_reference_error_shape():
+    """A device call past tier.request_timeout_s returns the reference
+    error-dict shape instead of hanging the serving thread — on a wedged
+    chip this is the ONLY way failover/perf-penalty machinery can fire."""
+    import time as _t
+
+    from distributed_llm_tpu.serving.tiers import TierClient
+
+    class HangingEngine:
+        def generate(self, history, **kw):
+            _t.sleep(30)
+
+    client = TierClient(_timeout_tier(0.2), _StubManager(HangingEngine()))
+    t0 = _t.monotonic()
+    out = client.process("hi")
+    assert _t.monotonic() - t0 < 5
+    assert "error" in out and "timed out after" in out["error"]
+
+
+def test_request_timeout_none_disables_cap():
+    from distributed_llm_tpu.serving.tiers import TierClient
+
+    class EchoEngine:
+        def generate(self, history, **kw):
+            class R:
+                text = "ok"
+            return R()
+
+    client = TierClient(_timeout_tier(None), _StubManager(EchoEngine()))
+    assert client.process("hi") == {"response": "ok"}
+
+
+def test_sequential_engine_calls_stay_serialized():
+    """Timeout-abandoned workers must not overlap a later call on a
+    sequential engine (no internal locks): the tier lock serializes
+    them; the batched engine (concurrent_safe) skips the lock."""
+    import threading as _th
+    import time as _t
+
+    from distributed_llm_tpu.serving.tiers import TierClient
+
+    class RecordingEngine:
+        def __init__(self):
+            self.active = 0
+            self.max_active = 0
+            self._m = _th.Lock()
+
+        def generate(self, history, **kw):
+            with self._m:
+                self.active += 1
+                self.max_active = max(self.max_active, self.active)
+            _t.sleep(0.1)
+            with self._m:
+                self.active -= 1
+
+            class R:
+                text = "ok"
+            return R()
+
+    eng = RecordingEngine()
+    client = TierClient(_timeout_tier(0.02), _StubManager(eng))
+    outs = [client.process("a"), client.process("b"), client.process("c")]
+    assert all("timed out" in o["error"] for o in outs)
+    _t.sleep(0.5)                      # let the abandoned workers drain
+    assert eng.max_active == 1, "sequential engine saw overlapping calls"
+
+    class ConcurrentEngine(RecordingEngine):
+        concurrent_safe = True
+
+    eng2 = ConcurrentEngine()
+    client2 = TierClient(_timeout_tier(0.02), _StubManager(eng2))
+    for q in ("a", "b", "c"):
+        client2.process(q)
+    _t.sleep(0.5)
+    assert eng2.max_active > 1, "batched engine should not be serialized"
+
+
+def test_router_fails_over_on_tier_timeout(cluster):
+    """End-to-end: nano hangs past its cap, the router serves the query
+    on orin (reference failover semantics, src/router.py:277-282)."""
+    import dataclasses
+    import time as _t
+
+    r = make_router(cluster, strategy="heuristic", benchmark_mode=True)
+    nano = r.tiers["nano"]
+    nano.server_manager.start_server()
+    real_engine = nano.server_manager.engine()
+
+    class Hanging:
+        def generate(self, history, **kw):
+            _t.sleep(30)
+
+    nano.tier = dataclasses.replace(nano.tier, request_timeout_s=0.2)
+    nano.server_manager._engine = Hanging()
+    try:
+        resp, _, device = r.route_query(
+            [{"role": "user", "content": "What is the capital of France"}])
+        assert device == "orin" and resp["ok"] is True
+    finally:
+        nano.server_manager._engine = real_engine
