@@ -51,16 +51,25 @@ class HealthMonitor:
         max_consecutive_failures: int = 3,
         mesh=None,                           # jax Mesh for the allgather
         auto_restart: bool = True,
+        restart_timeout_s: float = 900.0,
     ):
         self.router = router
         self.interval_s = interval_s
         self.max_failures = max_consecutive_failures
         self.mesh = mesh
         self.auto_restart = auto_restart
+        # Bounded wait for an engine restart: a rebuild compiles for
+        # minutes on chip (legitimate), but a restart against a WEDGED
+        # chip never returns — unbounded, it would hang the monitor loop
+        # and end all probing (incl. of the healthy tier).  Past the cap
+        # the worker is abandoned (it keeps the manager lock; no second
+        # restart stacks while it lives) and probing continues.
+        self.restart_timeout_s = restart_timeout_s
         self._fail_counts: Dict[str, int] = {}
         self._seen_running: Dict[str, bool] = {}
         self._last: Dict[str, Dict[str, Any]] = {}
         self._restarts: Dict[str, int] = {}
+        self._restarting: Dict[str, threading.Thread] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
@@ -112,17 +121,39 @@ class HealthMonitor:
             snapshot[name] = entry
 
         for name, mgr in to_restart:
+            prev = self._restarting.get(name)
+            if prev is not None and prev.is_alive():
+                logger.warning("tier %s restart still in flight — not "
+                               "stacking another", name)
+                continue
             logger.warning("tier %s unhealthy after %d probes — restarting",
                            name, self.max_failures)
-            try:
-                mgr.stop_server()
-                mgr.start_server()
-                with self._lock:
-                    self._restarts[name] = self._restarts.get(name, 0) + 1
-                    self._fail_counts[name] = 0
-                    self._last[name]["restarts"] = self._restarts[name]
-            except Exception as exc:
-                logger.error("tier %s restart failed: %s", name, exc)
+
+            def _restart(name=name, mgr=mgr):
+                try:
+                    mgr.stop_server()
+                    mgr.start_server()
+                    with self._lock:
+                        self._restarts[name] = self._restarts.get(name, 0) + 1
+                        self._fail_counts[name] = 0
+                        if name in self._last:
+                            self._last[name]["restarts"] = \
+                                self._restarts[name]
+                except Exception as exc:
+                    logger.error("tier %s restart failed: %s", name, exc)
+
+            worker = threading.Thread(target=_restart, daemon=True,
+                                      name=f"restart-{name}")
+            self._restarting[name] = worker
+            worker.start()
+            # Synchronous in the healthy case (tests and the dryrun rely
+            # on probe_once returning with the restart done); bounded so
+            # a wedged-chip rebuild can't end all probing.
+            worker.join(self.restart_timeout_s)
+            if worker.is_alive():
+                logger.error("tier %s restart exceeded %.0fs — abandoning "
+                             "the worker and continuing to probe",
+                             name, self.restart_timeout_s)
         return snapshot
 
     # -- cross-host perf exchange ------------------------------------------

@@ -114,3 +114,54 @@ def test_monitor_lifecycle(router):
     mon.stop()
     assert mon._thread is None
     assert mon.snapshot()                  # at least one pass recorded
+
+
+def test_monitor_survives_hung_restart():
+    """A restart against a wedged chip never returns; the monitor must
+    abandon it past restart_timeout_s, keep probing (incl. the healthy
+    tier), and not stack a second restart while the first lives."""
+    import threading
+    import time
+
+    from distributed_llm_tpu.config import tiny_cluster
+    from distributed_llm_tpu.serving.health import HealthMonitor
+    from distributed_llm_tpu.serving.router import Router
+
+    r = Router(strategy="heuristic", benchmark_mode=True,
+               cluster=tiny_cluster())
+    mon = HealthMonitor(r, interval_s=0.05, max_consecutive_failures=1,
+                        restart_timeout_s=0.2)
+    nano_mgr = r.tiers["nano"].server_manager
+    nano_mgr.start_server()
+    r.tiers["orin"].server_manager.start_server()
+    mon.probe_once()                      # both seen running
+
+    hang = threading.Event()
+
+    class WedgedManager:
+        def is_server_running(self):
+            return True
+
+        def health(self):
+            return {"ok": False, "error": "wedged"}
+
+        def stop_server(self):
+            pass
+
+        def start_server(self, beat=None):
+            hang.wait(30)                 # never returns within the test
+
+    r.tiers["nano"].server_manager = WedgedManager()
+    t0 = time.monotonic()
+    snap = mon.probe_once()               # triggers the bounded restart
+    assert time.monotonic() - t0 < 5, "probe_once hung on the restart"
+    assert snap["nano"]["state"] == "failed"
+    assert snap["orin"]["state"] == "running"
+
+    # Next probe: restart still in flight — not stacked, probing continues.
+    snap2 = mon.probe_once()
+    assert snap2["orin"]["state"] == "running"
+    assert len([t for t in threading.enumerate()
+                if t.name == "restart-nano"]) == 1
+    hang.set()                            # release the abandoned worker
+    r.tiers["nano"].server_manager = nano_mgr
