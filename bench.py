@@ -88,7 +88,7 @@ def compact(result: dict) -> dict:
     keep = ("metric", "value", "unit", "vs_baseline", "p50_ttft_ms",
             "p50_latency_ms", "routing_accuracy", "decode_tok_per_s",
             "backend", "queries", "mfu_prefill", "hbm_util_decode",
-            "per_strategy", "aborted")
+            "per_strategy", "aborted", "hw_dispatch")
     out = {k: result[k] for k in keep if result.get(k) is not None}
     util = result.get("utilization") or {}
     for key, ph, field in (("mfu_prefill", "prefill", "mfu"),
@@ -489,6 +489,26 @@ def run(progress: "Progress" = None) -> dict:
     backend = jax.default_backend()
     progress.section("backend", backend)
 
+    # Hardware-evidence trail: even when THIS run fell back to CPU (the
+    # chip wedges for hours at a time), the committed dispatch table
+    # carries real measured-on-chip kernel data — record its provenance
+    # so the driver artifact shows what hardware evidence exists.
+    hw_dispatch = None
+    try:
+        from distributed_llm_tpu.bench import ab_kernels
+        with open(ab_kernels.DISPATCH_PATH) as f:
+            _table = json.load(f)
+        if _table.get("backend") and _table["backend"] != "cpu":
+            hw_dispatch = {
+                "backend": _table["backend"],
+                "pallas_kinds": sorted(
+                    k for k, v in (_table.get("dispatch") or {}).items()
+                    if isinstance(v, dict) and v.get("default") == "pallas"),
+            }
+            progress.section("hw_dispatch", hw_dispatch)
+    except (OSError, ValueError):
+        pass
+
     # Self-contained dispatch measurement (VERDICT r2 #4): if this run is
     # on real hardware and no same-backend dispatch table exists — e.g.
     # the chip recovered only at driver-bench time — measure a fast one
@@ -801,6 +821,7 @@ def run(progress: "Progress" = None) -> dict:
         "long_context": long_context,
         "orin_prefix": orin_prefix,
         "flagship": flagship,
+        "hw_dispatch": hw_dispatch,
         "tiers": phases,
     }
 
