@@ -647,17 +647,27 @@ def paged_decode_attention_q8(q: jax.Array, k_pool: jax.Array,
 # =============================================================================
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
-                   l_ref, *, bk: int, scale: float):
-    """Tiled flash recurrence over the KV length (grid B × Nkv × S/bk, the
-    KV-block index j innermost).  Each slot's iterations past its own
-    length frontier are index-map-clamped onto the frontier block (the
-    repeated index elides the DMA) and compute-skipped — so a sequence at
-    position p streams ceil((p+1)/bk) blocks, not S_max.  This is the
-    round-1 fix for the untiled kernel that loaded the whole [S_max, D]
-    slice per program and lost to XLA at B=8/S=2048 (BENCHMARKS.md r1)."""
+                   l_ref, *, bk: int, nkv: int, d: int, scale: float):
+    """Tiled flash recurrence over the KV length (grid B × S/bk), reading
+    the cache in its SERVING layout.
+
+    KV blocks arrive as [bk, Nkv·D] slabs of the engine's own
+    [B, S, Nkv, D] cache (a free reshape — the trailing dims are
+    contiguous), and heads are lane-sliced inside VMEM at 128-multiple
+    offsets.  The first-generation kernel instead transposed the cache
+    to head-major outside the pallas_call; a pallas operand must be
+    materialized in the requested layout, so every decode step paid a
+    full cache copy before the kernel read it — the r3 chip A/B measured
+    that kernel LOSING to XLA by ~10% at every decode shape while the
+    transpose-amortized prefill kernel won 4.4×.
+
+    Each sequence's iterations past its own length frontier are
+    index-map-clamped onto the frontier block (the repeated index elides
+    the DMA) and compute-skipped — so a sequence at position p streams
+    ceil((p+1)/bk) blocks, not S_max."""
     b = pl.program_id(0)
-    j = pl.program_id(2)
-    nb = pl.num_programs(2)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
 
     @pl.when(j == 0)
     def _init():
@@ -667,14 +677,21 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
 
     @pl.when(j * bk <= pos_ref[b])
     def _accumulate():
-        q = q_ref[0, 0].astype(jnp.float32) * scale           # [G, D]
-        k = k_ref[0, 0]                                       # [bk, D]
-        v = v_ref[0, 0]
+        q = q_ref[0].astype(jnp.float32) * scale             # [Nq, D]
+        kv_k = k_ref[0]                                      # [bk, Nkv·D]
+        kv_v = v_ref[0]
+        groups = q.shape[0] // nkv
 
-        s = jnp.dot(q, k.T.astype(jnp.float32),
-                    preferred_element_type=jnp.float32)       # [G, bk]
+        # Per-head scores, stacked back to [Nq, bk] (row r ↔ head r//G).
+        s = jnp.concatenate([
+            jax.lax.dot_general(
+                q[h * groups:(h + 1) * groups],
+                kv_k[:, h * d:(h + 1) * d].astype(jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [G, bk]
+            for h in range(nkv)], axis=0)
         col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bk
-        s = jnp.where(col <= pos_ref[b], s, NEG_INF)          # ragged mask
+        s = jnp.where(col <= pos_ref[b], s, NEG_INF)         # ragged mask
 
         m_prev, l_prev = m_ref[...], l_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -682,13 +699,17 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
         alpha = jnp.exp(m_prev - m_new)
         m_ref[...] = m_new
         l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        pv = jnp.concatenate([
+            jnp.dot(p[h * groups:(h + 1) * groups].astype(kv_v.dtype),
+                    kv_v[:, h * d:(h + 1) * d],
+                    preferred_element_type=jnp.float32)      # [G, D]
+            for h in range(nkv)], axis=0)
+        acc_ref[...] = acc_ref[...] * alpha + pv
 
     @pl.when(j == nb - 1)
     def _done():
-        o_ref[0, 0] = (acc_ref[...] /
-                       jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
 
 
 def flash_decode_attention(q: jax.Array, k_cache: jax.Array,
@@ -697,59 +718,60 @@ def flash_decode_attention(q: jax.Array, k_cache: jax.Array,
     caches [B,S_max,Nkv,D], pos [B] -> [B,Nq,D]) with a KV-length-tiled
     flash recurrence: HBM traffic scales with each sequence's OWN length
     (frontier-clamped block streaming), unlike the XLA path, which reads
-    the whole allocated cache every step."""
+    the whole allocated cache every step.  Reads the cache in place —
+    no head-major transpose/copy (see _decode_kernel)."""
     b, nq, d = q.shape
     s_max, nkv = k_cache.shape[1], k_cache.shape[2]
-    groups = nq // nkv
-    # 256-wide KV tiles amortize grid/DMA overhead while staying tiny in
-    # VMEM (256·D·2B ≈ 64 KiB at D=128); cache-length ladder rungs
-    # (256/1024/max_seq, engine/inference.py) are all multiples.
+    # 256-wide KV tiles amortize grid/DMA overhead while staying small in
+    # VMEM (256·Nkv·D·2B ≈ 512 KiB at Nkv=8, D=128); cache-length ladder
+    # rungs (256/1024/max_seq, engine/inference.py) are all multiples.
     bk = next((t for t in (256, 128) if s_max % t == 0), s_max)
 
-    qh = q.reshape(b, nkv, groups, d)                        # group-major
-    kh = k_cache.transpose(0, 2, 1, 3)                       # [B, Nkv, S, D]
-    vh = v_cache.transpose(0, 2, 1, 3)
+    # Free reshapes: [B,S,Nkv,D] is contiguous in (Nkv,D).
+    kf = k_cache.reshape(b, s_max, nkv * d)
+    vf = v_cache.reshape(b, s_max, nkv * d)
     pos32 = pos.astype(jnp.int32)
 
-    kernel = functools.partial(_decode_kernel, bk=bk, scale=d ** -0.5)
+    kernel = functools.partial(_decode_kernel, bk=bk, nkv=nkv, d=d,
+                               scale=d ** -0.5)
 
-    def kv_index(b_, h, j, p):
+    def kv_index(b_, j, p):
         # Clamp past-frontier iterations onto the frontier block: the
         # repeated index skips the DMA, pl.when skips the compute.
-        return (b_, h, jnp.minimum(j, p[b_] // bk), 0)
+        return (b_, jnp.minimum(j, p[b_] // bk), 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b, nkv, s_max // bk),
+        grid=(b, s_max // bk),
         in_specs=[
-            pl.BlockSpec((1, 1, groups, d), lambda b_, h, j, p: (b_, h, 0, 0)),
-            pl.BlockSpec((1, 1, bk, d), kv_index),
-            pl.BlockSpec((1, 1, bk, d), kv_index),
+            pl.BlockSpec((1, nq, d), lambda b_, j, p: (b_, 0, 0)),
+            pl.BlockSpec((1, bk, nkv * d), kv_index),
+            pl.BlockSpec((1, bk, nkv * d), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, 1, groups, d),
-                               lambda b_, h, j, p: (b_, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, nq, d), lambda b_, j, p: (b_, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((groups, d), jnp.float32),
-            pltpu.VMEM((groups, 1), jnp.float32),
-            pltpu.VMEM((groups, 1), jnp.float32),
+            pltpu.VMEM((nq, d), jnp.float32),
+            pltpu.VMEM((nq, 1), jnp.float32),
+            pltpu.VMEM((nq, 1), jnp.float32),
         ],
     )
-    out = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=_interpret(),
-    )(pos32, qh, kh, vh)
-    return out.reshape(b, nq, d)
+    )(pos32, q, kf, vf)
 
 
 def _decode_kernel_q8(pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
-                      acc_ref, m_ref, l_ref, *, bk: int, scale: float):
-    """int8 twin of _decode_kernel: KV tiles arrive int8 with per-row f32
-    scales; dequantization happens in VMEM after the half-width DMA."""
+                      acc_ref, m_ref, l_ref, *, bk: int, nkv: int, d: int,
+                      scale: float):
+    """int8 twin of _decode_kernel: KV slabs arrive int8 in the serving
+    layout ([bk, Nkv·D], half-width DMA) with per-(row, head) f32 scales
+    as [Nkv, bk] planes; dequantization happens in VMEM."""
     b = pl.program_id(0)
-    j = pl.program_id(2)
-    nb = pl.num_programs(2)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
 
     @pl.when(j == 0)
     def _init():
@@ -759,13 +781,25 @@ def _decode_kernel_q8(pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
 
     @pl.when(j * bk <= pos_ref[b])
     def _accumulate():
-        q = q_ref[0, 0].astype(jnp.float32) * scale           # [G, D]
-        k = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]    # [bk, D]
-        v = v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0]
+        q = q_ref[0].astype(jnp.float32) * scale             # [Nq, D]
+        kv_k = k_ref[0]                                      # [bk, Nkv·D] i8
+        kv_v = v_ref[0]
+        ks = ks_ref[0]                                       # [Nkv, bk] f32
+        vs = vs_ref[0]
+        groups = q.shape[0] // nkv
 
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        def dq(slab, scales, h):
+            return (slab[:, h * d:(h + 1) * d].astype(jnp.float32)
+                    * scales[h][:, None])                    # [bk, D]
+
+        s = jnp.concatenate([
+            jax.lax.dot_general(
+                q[h * groups:(h + 1) * groups], dq(kv_k, ks, h),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [G, bk]
+            for h in range(nkv)], axis=0)
         col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bk
-        s = jnp.where(col <= pos_ref[b], s, NEG_INF)          # ragged mask
+        s = jnp.where(col <= pos_ref[b], s, NEG_INF)         # ragged mask
 
         m_prev, l_prev = m_ref[...], l_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -773,13 +807,16 @@ def _decode_kernel_q8(pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
         alpha = jnp.exp(m_prev - m_new)
         m_ref[...] = m_new
         l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+        pv = jnp.concatenate([
+            jnp.dot(p[h * groups:(h + 1) * groups], dq(kv_v, vs, h),
+                    preferred_element_type=jnp.float32)      # [G, D]
+            for h in range(nkv)], axis=0)
+        acc_ref[...] = acc_ref[...] * alpha + pv
 
     @pl.when(j == nb - 1)
     def _done():
-        o_ref[0, 0] = (acc_ref[...] /
-                       jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
 
 
 def flash_decode_attention_q8(q: jax.Array, k_cache: jax.Array,
@@ -788,50 +825,52 @@ def flash_decode_attention_q8(q: jax.Array, k_cache: jax.Array,
                               pos: jax.Array) -> jax.Array:
     """``flash_decode_attention`` over an int8 contiguous cache
     (TierConfig.kv_quantize): caches [B,S_max,Nkv,D] int8, scales
-    [B,S_max,Nkv] f32.  Streams half the KV bytes of the bf16 kernel with
-    the same frontier-clamped tiling; the XLA fallback dequantizes a
-    gathered view instead."""
+    [B,S_max,Nkv] f32.  Streams half the KV bytes of the bf16 kernel
+    with the same frontier-clamped tiling and the same in-place cache
+    reads (only the TINY scale planes are transposed — S·Nkv·4 B, vs
+    the S·Nkv·D·2 B cache copy the first-generation kernel paid); the
+    XLA fallback dequantizes a gathered view instead."""
     b, nq, d = q.shape
     s_max, nkv = k_cache.shape[1], k_cache.shape[2]
-    groups = nq // nkv
     bk = next((t for t in (256, 128) if s_max % t == 0), s_max)
 
-    qh = q.reshape(b, nkv, groups, d)                        # group-major
-    kh = k_cache.transpose(0, 2, 1, 3)                       # [B, Nkv, S, D]
-    vh = v_cache.transpose(0, 2, 1, 3)
-    # Scales [B, S, Nkv] -> [B, Nkv, S, 1]: the trailing singleton keeps
-    # Mosaic on its (sublane, lane) tiling for the per-row plane.
-    ks = k_scale.transpose(0, 2, 1)[..., None].astype(jnp.float32)
-    vs = v_scale.transpose(0, 2, 1)[..., None].astype(jnp.float32)
+    kf = k_cache.reshape(b, s_max, nkv * d)      # free: contiguous dims
+    vf = v_cache.reshape(b, s_max, nkv * d)
+    # Scales to [B, Nkv, S]: (Nkv, bk) blocks tile cleanly (f32 sublane
+    # = 8 = typical Nkv); per-head rows broadcast over D in-kernel.
+    ks = k_scale.transpose(0, 2, 1).astype(jnp.float32)
+    vs = v_scale.transpose(0, 2, 1).astype(jnp.float32)
     pos32 = pos.astype(jnp.int32)
 
-    kernel = functools.partial(_decode_kernel_q8, bk=bk, scale=d ** -0.5)
+    kernel = functools.partial(_decode_kernel_q8, bk=bk, nkv=nkv, d=d,
+                               scale=d ** -0.5)
 
-    def kv_index(b_, h, j, p):
-        return (b_, h, jnp.minimum(j, p[b_] // bk), 0)
+    def kv_index(b_, j, p):
+        return (b_, jnp.minimum(j, p[b_] // bk), 0)
+
+    def scale_index(b_, j, p):
+        return (b_, 0, jnp.minimum(j, p[b_] // bk))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b, nkv, s_max // bk),
+        grid=(b, s_max // bk),
         in_specs=[
-            pl.BlockSpec((1, 1, groups, d), lambda b_, h, j, p: (b_, h, 0, 0)),
-            pl.BlockSpec((1, 1, bk, d), kv_index),
-            pl.BlockSpec((1, 1, bk, d), kv_index),
-            pl.BlockSpec((1, 1, bk, 1), kv_index),
-            pl.BlockSpec((1, 1, bk, 1), kv_index),
+            pl.BlockSpec((1, nq, d), lambda b_, j, p: (b_, 0, 0)),
+            pl.BlockSpec((1, bk, nkv * d), kv_index),
+            pl.BlockSpec((1, bk, nkv * d), kv_index),
+            pl.BlockSpec((1, nkv, bk), scale_index),
+            pl.BlockSpec((1, nkv, bk), scale_index),
         ],
-        out_specs=pl.BlockSpec((1, 1, groups, d),
-                               lambda b_, h, j, p: (b_, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, nq, d), lambda b_, j, p: (b_, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((groups, d), jnp.float32),
-            pltpu.VMEM((groups, 1), jnp.float32),
-            pltpu.VMEM((groups, 1), jnp.float32),
+            pltpu.VMEM((nq, d), jnp.float32),
+            pltpu.VMEM((nq, 1), jnp.float32),
+            pltpu.VMEM((nq, 1), jnp.float32),
         ],
     )
-    out = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=_interpret(),
-    )(pos32, qh, kh, vh, ks, vs)
-    return out.reshape(b, nq, d)
+    )(pos32, q, kf, vf, ks, vs)
