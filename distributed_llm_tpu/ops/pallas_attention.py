@@ -143,6 +143,70 @@ flash_causal_attention.defvjp(_flash_fwd, _flash_bwd)
 # Chunked prefill: a block of suffix queries against the cache window
 # =============================================================================
 
+def _chunk_kernel_native(pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
+                         m_ref, l_ref, *, bq: int, bk: int, nq: int,
+                         nkv: int, d: int, scale: float):
+    """In-place small-chunk kernel: grid (B, S_c/bq, W/bk), KV slabs in
+    the serving layout ([bk, Nkv·D] — no head-major transpose/copy, see
+    _decode_kernel), heads looped in VMEM with per-head flash stats
+    lane-sliced out of (bq, Nq) scratch planes.  Query row r attends
+    cache cols ≤ start + r; window blocks entirely past this query
+    block's frontier are index-clamped (DMA elided) and skipped — an
+    upgrade over the wide kernel, which masks but still streams them.
+    Used for the latency-critical suffix sizes (S_c ≤ 256), where the
+    window read is the whole cost and the wide kernel's cache transpose
+    tripled it."""
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+    start = pos_ref[b]
+    groups = nq // nkv
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * bk <= start + (i + 1) * bq - 1)
+    def _accumulate():
+        row_pos = start + i * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, 1), 0)
+        kv_k = k_ref[0]                                      # [bk, Nkv·D]
+        kv_v = v_ref[0]
+        col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+        mask = col <= row_pos
+        for h in range(nq):
+            hk = h // groups
+            qh = q_ref[0][:, h * d:(h + 1) * d].astype(jnp.float32) * scale
+            s = jax.lax.dot_general(
+                qh, kv_k[:, hk * d:(hk + 1) * d].astype(jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [bq, bk]
+            s = jnp.where(mask, s, NEG_INF)
+            m_prev = m_ref[:, h:h + 1]
+            l_prev = l_ref[:, h:h + 1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            m_ref[:, h:h + 1] = m_new
+            l_ref[:, h:h + 1] = l_prev * alpha + jnp.sum(
+                p, axis=-1, keepdims=True)
+            acc_ref[:, h * d:(h + 1) * d] = (
+                acc_ref[:, h * d:(h + 1) * d] * alpha
+                + jnp.dot(p.astype(kv_v.dtype),
+                          kv_v[:, hk * d:(hk + 1) * d],
+                          preferred_element_type=jnp.float32))
+
+    @pl.when(j == nb - 1)
+    def _done():
+        for h in range(nq):
+            o_ref[0, :, h * d:(h + 1) * d] = (
+                acc_ref[:, h * d:(h + 1) * d]
+                / jnp.maximum(l_ref[:, h:h + 1], 1e-30)).astype(o_ref.dtype)
+
+
 def _chunk_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, bq: int, bk: int,
                   head_dim: int, scale: float, w: int):
     """Flash recurrence over the cache window with a PER-QUERY frontier:
@@ -151,7 +215,10 @@ def _chunk_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, bq: int, bk: int,
     — the suffix-prefill twin of _flash_kernel's block-causal mask.
     Positions are reconstructed from the per-sequence scalar start (SMEM
     allows only scalar loads on TPU); the public wrapper enforces the
-    contiguity this assumes."""
+    contiguity this assumes.  This WIDE variant (head-major transpose
+    outside, whole-window blocks with DMA elision across heads) serves
+    LARGE chunks, where attention compute amortizes the transpose;
+    small suffix chunks take _chunk_kernel_native instead."""
     i = pl.program_id(2)
     # Whole [B, 1] array in SMEM; scalar-load this sequence's start.
     start = pos_ref[pl.program_id(0), 0]
@@ -196,7 +263,14 @@ def flash_chunk_attention(q: jax.Array, k_cache: jax.Array,
     them from the scalar start, since TPU SMEM only loads scalars.  This
     holds for every chunked-prefill caller; rows whose clamped position in
     chunk_prefill differs (right padding past true_len) get a wider
-    frontier here, which only affects their never-read outputs."""
+    frontier here, which only affects their never-read outputs.
+
+    Two regimes: suffix-sized chunks (S_c ≤ 256 — the multi-turn
+    prefix-reuse hot path) are pure window-bandwidth and run the
+    in-place native-layout kernel (no cache transpose); larger chunks
+    (chunked long prefill) amortize the transpose over O(S_c·W) compute
+    and keep the wide whole-window kernel, whose per-head window DMA is
+    elided across heads."""
     b, s_c, nq, d = q.shape
     w, nkv = k_cache.shape[1], k_cache.shape[2]
     groups = nq // nkv
@@ -206,6 +280,45 @@ def flash_chunk_attention(q: jax.Array, k_cache: jax.Array,
         raise ValueError(
             f"flash_chunk_attention: chunk {s_c} / window {w} not multiples "
             f"of the ({bq}, {bk}) blocks — use power-of-two buckets")
+
+    if s_c <= 256:
+        kf = k_cache.reshape(b, w, nkv * d)      # free: contiguous dims
+        vf = v_cache.reshape(b, w, nkv * d)
+        qf = q.reshape(b, s_c, nq * d)
+        starts = q_positions[:, 0].astype(jnp.int32)         # [B]
+        kernel = functools.partial(_chunk_kernel_native, bq=bq, bk=bk,
+                                   nq=nq, nkv=nkv, d=d, scale=d ** -0.5)
+
+        def kv_index(b_, i, j, p):
+            # Clamp past-frontier window blocks onto this query block's
+            # frontier: repeated index elides the DMA, pl.when skips
+            # the compute.
+            return (b_, jnp.minimum(j, (p[b_] + (i + 1) * bq - 1) // bk), 0)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, s_c // bq, w // bk),
+            in_specs=[
+                pl.BlockSpec((1, bq, nq * d),
+                             lambda b_, i, j, p: (b_, i, 0)),
+                pl.BlockSpec((1, bk, nkv * d), kv_index),
+                pl.BlockSpec((1, bk, nkv * d), kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, bq, nq * d),
+                                   lambda b_, i, j, p: (b_, i, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((bq, nq * d), jnp.float32),
+                pltpu.VMEM((bq, nq), jnp.float32),
+                pltpu.VMEM((bq, nq), jnp.float32),
+            ],
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+            interpret=_interpret(),
+        )(starts, qf, kf, vf)
+        return out.reshape(b, s_c, nq, d)
 
     qh = q.transpose(0, 2, 1, 3)                             # [B, Nq, S_c, D]
     kh = k_cache.transpose(0, 2, 1, 3)                       # [B, Nkv, W, D]
@@ -233,6 +346,69 @@ def flash_chunk_attention(q: jax.Array, k_cache: jax.Array,
         interpret=_interpret(),
     )(start32, qh, kh, vh)
     return out.transpose(0, 2, 1, 3)
+
+
+def _chunk_kernel_native_q8(pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                            o_ref, acc_ref, m_ref, l_ref, *, bq: int,
+                            bk: int, nq: int, nkv: int, d: int,
+                            scale: float):
+    """int8 twin of _chunk_kernel_native: serving-layout int8 KV slabs
+    ([bk, Nkv·D], half-width DMA) with [Nkv, bk] scale planes,
+    dequantized in VMEM per head."""
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+    start = pos_ref[b]
+    groups = nq // nkv
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * bk <= start + (i + 1) * bq - 1)
+    def _accumulate():
+        row_pos = start + i * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, 1), 0)
+        kv_k = k_ref[0]                                      # [bk, Nkv·D] i8
+        kv_v = v_ref[0]
+        ks = ks_ref[0]                                       # [Nkv, bk] f32
+        vs = vs_ref[0]
+        col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+        mask = col <= row_pos
+
+        def dq(slab, scales, hk):
+            return (slab[:, hk * d:(hk + 1) * d].astype(jnp.float32)
+                    * scales[hk][:, None])                   # [bk, D]
+
+        for h in range(nq):
+            hk = h // groups
+            qh = q_ref[0][:, h * d:(h + 1) * d].astype(jnp.float32) * scale
+            s = jax.lax.dot_general(
+                qh, dq(kv_k, ks, hk), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [bq, bk]
+            s = jnp.where(mask, s, NEG_INF)
+            m_prev = m_ref[:, h:h + 1]
+            l_prev = l_ref[:, h:h + 1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            m_ref[:, h:h + 1] = m_new
+            l_ref[:, h:h + 1] = l_prev * alpha + jnp.sum(
+                p, axis=-1, keepdims=True)
+            acc_ref[:, h * d:(h + 1) * d] = (
+                acc_ref[:, h * d:(h + 1) * d] * alpha
+                + jnp.dot(p, dq(kv_v, vs, hk),
+                          preferred_element_type=jnp.float32))
+
+    @pl.when(j == nb - 1)
+    def _done():
+        for h in range(nq):
+            o_ref[0, :, h * d:(h + 1) * d] = (
+                acc_ref[:, h * d:(h + 1) * d]
+                / jnp.maximum(l_ref[:, h:h + 1], 1e-30)).astype(o_ref.dtype)
 
 
 def _chunk_kernel_q8(pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
@@ -287,7 +463,9 @@ def flash_chunk_attention_q8(q: jax.Array, k_cache: jax.Array,
     """``flash_chunk_attention`` over an int8 contiguous cache
     (TierConfig.kv_quantize): caches [B,W,Nkv,D] int8, scales [B,W,Nkv]
     f32.  Same contiguous-positions contract as the bf16 kernel; the XLA
-    fallback dequantizes a full-window view instead."""
+    fallback dequantizes a full-window view instead.  Same two regimes
+    as the bf16 wrapper: suffix-sized chunks run the in-place
+    native-layout kernel, large chunks the wide transpose kernel."""
     b, s_c, nq, d = q.shape
     w, nkv = k_cache.shape[1], k_cache.shape[2]
     groups = nq // nkv
@@ -298,6 +476,49 @@ def flash_chunk_attention_q8(q: jax.Array, k_cache: jax.Array,
             f"flash_chunk_attention_q8: chunk {s_c} / window {w} not "
             f"multiples of the ({bq}, {bk}) blocks — use power-of-two "
             "buckets")
+
+    if s_c <= 256:
+        kf = k_cache.reshape(b, w, nkv * d)      # free: contiguous dims
+        vf = v_cache.reshape(b, w, nkv * d)
+        qf = q.reshape(b, s_c, nq * d)
+        ks = k_scale.transpose(0, 2, 1).astype(jnp.float32)  # [B, Nkv, W]
+        vs = v_scale.transpose(0, 2, 1).astype(jnp.float32)
+        starts = q_positions[:, 0].astype(jnp.int32)         # [B]
+        kernel = functools.partial(_chunk_kernel_native_q8, bq=bq, bk=bk,
+                                   nq=nq, nkv=nkv, d=d, scale=d ** -0.5)
+
+        def kv_index(b_, i, j, p):
+            return (b_, jnp.minimum(j, (p[b_] + (i + 1) * bq - 1) // bk), 0)
+
+        def scale_index(b_, i, j, p):
+            return (b_, 0, jnp.minimum(j, (p[b_] + (i + 1) * bq - 1) // bk))
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, s_c // bq, w // bk),
+            in_specs=[
+                pl.BlockSpec((1, bq, nq * d),
+                             lambda b_, i, j, p: (b_, i, 0)),
+                pl.BlockSpec((1, bk, nkv * d), kv_index),
+                pl.BlockSpec((1, bk, nkv * d), kv_index),
+                pl.BlockSpec((1, nkv, bk), scale_index),
+                pl.BlockSpec((1, nkv, bk), scale_index),
+            ],
+            out_specs=pl.BlockSpec((1, bq, nq * d),
+                                   lambda b_, i, j, p: (b_, i, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((bq, nq * d), jnp.float32),
+                pltpu.VMEM((bq, nq), jnp.float32),
+                pltpu.VMEM((bq, nq), jnp.float32),
+            ],
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+            interpret=_interpret(),
+        )(starts, qf, kf, vf, ks, vs)
+        return out.reshape(b, s_c, nq, d)
 
     qh = q.transpose(0, 2, 1, 3)                             # [B, Nq, S_c, D]
     kh = k_cache.transpose(0, 2, 1, 3)                       # [B, Nkv, W, D]

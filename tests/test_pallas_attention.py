@@ -168,6 +168,7 @@ def test_resolve_impl(monkeypatch):
     (2, 64, 256, 4, 2, 32),     # GQA, multiple kv blocks
     (1, 128, 256, 8, 2, 16),    # multiple q blocks too
     (1, 5, 256, 4, 2, 16),      # γ+1-row verify chunk (speculative.py)
+    (1, 512, 512, 4, 2, 16),    # LARGE chunk: the wide transpose kernel
 ])
 def test_flash_chunk_matches_xla(b, s_c, w, nq, nkv, d):
     ks = jax.random.split(jax.random.PRNGKey(3), 3)
@@ -269,6 +270,7 @@ def test_batched_engine_generates_identically_on_pallas_paged_path(monkeypatch):
 @pytest.mark.parametrize("b,s_c,w,nq,nkv,d", [
     (1, 64, 128, 4, 4, 16),
     (2, 64, 256, 4, 2, 32),
+    (1, 512, 512, 4, 2, 16),    # LARGE chunk: the wide transpose kernel
 ])
 def test_flash_chunk_q8_matches_xla_dequant(b, s_c, w, nq, nkv, d):
     """int8-cache chunk kernel == XLA chunk over the dequantized view
