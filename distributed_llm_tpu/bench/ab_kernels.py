@@ -39,17 +39,22 @@ ALL_KINDS = frozenset({"prefill", "decode", "decode_q8", "chunk",
                        "chunk_q8", "paged_decode", "paged_decode_q8"})
 
 
-def _time_fn(fn, args, repeat: int) -> float:
-    """Median wall ms of a jitted call (2 warmup calls compile + settle)."""
+def _time_fn(fn, args, repeat: int):
+    """(median wall ms, output) of a jitted call (2 warmup calls compile
+    + settle).  The output feeds the numerics gate — timing alone would
+    let a kernel that miscompiles on real Mosaic (interpreter-mode tests
+    can't see that) win the table and serve wrong results."""
     import jax
+    out = None
     for _ in range(2):
-        jax.block_until_ready(fn(*args))
+        out = fn(*args)
+        jax.block_until_ready(out)
     times = []
     for _ in range(repeat):
         t0 = time.perf_counter()
         jax.block_until_ready(fn(*args))
         times.append((time.perf_counter() - t0) * 1000.0)
-    return statistics.median(times)
+    return statistics.median(times), out
 
 
 def micro_ab(tier_name: str = "orin", repeat: int = 20,
@@ -112,12 +117,13 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
 
         def leg(fn, args):
             try:
-                return _time_fn(_jax.jit(fn), args, repeat), None
+                ms, out = _time_fn(_jax.jit(fn), args, repeat)
+                return ms, out, None
             except Exception as exc:
-                return None, str(exc)[:160]
+                return None, None, str(exc)[:160]
 
-        ms_xla, err_x = leg(fn_xla, args_xla)
-        ms_pallas, err_p = leg(fn_pallas, args_pallas)
+        ms_xla, out_x, err_x = leg(fn_xla, args_xla)
+        ms_pallas, out_p, err_p = leg(fn_pallas, args_pallas)
         case = {"kind": kind, "length": length,
                 "xla_ms": round(ms_xla, 3) if ms_xla is not None else None,
                 "pallas_ms": (round(ms_pallas, 3)
@@ -126,14 +132,28 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
             case["xla_error"] = err_x
         if err_p:
             case["pallas_error"] = err_p
+        # Numerics gate on the REAL backend: both legs ran — compare.
+        # bf16 flash reorders reductions, so the bar is loose (5% of the
+        # output scale); an actual Mosaic miscompile is orders beyond it.
+        mismatch = False
+        if out_x is not None and out_p is not None:
+            ox = np.asarray(out_x, dtype=np.float32)
+            op = np.asarray(out_p, dtype=np.float32)
+            denom = float(np.max(np.abs(ox))) or 1.0
+            rel = float(np.max(np.abs(ox - op))) / denom
+            case["rel_err"] = round(rel, 5)
+            if not np.isfinite(rel) or rel > 0.05:
+                mismatch = True
+                case["numerics_mismatch"] = True
         results["cases"].append(case)
         print(json.dumps(case), flush=True)
         if beat is not None:
             beat()
         slot = wins.setdefault(kind, {}).setdefault(str(length), [])
-        # Pallas wins only if it ran AND beat a working XLA leg; a broken
-        # XLA leg with working pallas also counts (something must run).
-        if ms_pallas is None:
+        # Pallas wins only if it ran, MATCHED the XLA numerics, and beat
+        # a working XLA leg; a broken XLA leg with working pallas also
+        # counts (something must run).
+        if ms_pallas is None or mismatch:
             slot.append(False)
         elif ms_xla is None:
             slot.append(True)

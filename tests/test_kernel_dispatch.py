@@ -152,3 +152,35 @@ def test_dispatch_write_policy_hardware_beats_cpu(tmp_path):
     data = json.loads(open(out).read())
     assert data["backend"] == "tpu"
     assert "chunk" not in data["dispatch"], "cross-backend winners mixed"
+
+
+def test_micro_ab_numerics_gate_demotes_mismatch(tmp_path, monkeypatch):
+    """A pallas leg whose outputs diverge from XLA on the measured
+    backend must lose the dispatch slot even if it times faster — the
+    interpreter-mode parity suite can't see a real-Mosaic miscompile."""
+    from distributed_llm_tpu.bench import ab_kernels
+    from distributed_llm_tpu.ops import pallas_attention as PA
+    out = tmp_path / "ab_dispatch.json"
+    monkeypatch.setattr(ab_kernels, "DISPATCH_PATH", str(out))
+
+    orig = PA.flash_decode_attention
+
+    def corrupted(q, k, v, pos):
+        return orig(q, k, v, pos) * 3.0
+
+    monkeypatch.setattr(PA, "flash_decode_attention", corrupted)
+    res = ab_kernels.micro_ab("nano", repeat=1, write_dispatch=True,
+                              fast=True, kinds={"decode"})
+    assert all(c.get("numerics_mismatch") for c in res["cases"]), res["cases"]
+    table = json.loads(out.read_text())["dispatch"]["decode"]
+    assert set(table.values()) == {"xla"}, table
+
+
+def test_micro_ab_records_rel_err(tmp_path, monkeypatch):
+    from distributed_llm_tpu.bench import ab_kernels
+    out = tmp_path / "ab_dispatch.json"
+    monkeypatch.setattr(ab_kernels, "DISPATCH_PATH", str(out))
+    res = ab_kernels.micro_ab("nano", repeat=1, fast=True,
+                              kinds={"prefill"})
+    for c in res["cases"]:
+        assert c.get("rel_err") is not None and c["rel_err"] <= 0.05, c
