@@ -844,19 +844,23 @@ def _measure_dispatch_out_of_process(timeout_per_kind_s: float = 420.0
 
     from distributed_llm_tpu.bench import ab_kernels
 
-    # Which backend would a child see?  Probe cheaply via the table the
-    # caller wants: a same-backend table means nothing to do.  The
-    # backend string itself comes from the health probe's platform — on
-    # this box non-cpu means the axon TPU.
-    have = None
+    # A hardware table measured against the CURRENT kernel generation
+    # means nothing to do; a stale-gen table (kernel implementations
+    # changed since it was measured) gets re-measured.  The backend
+    # string itself comes from the health probe's platform — on this box
+    # non-cpu means the axon TPU.
+    from distributed_llm_tpu.ops.pallas_attention import KERNEL_GEN
+    table = {}
     try:
         with open(ab_kernels.DISPATCH_PATH) as f:
-            have = json.load(f).get("backend")
+            table = json.load(f)
     except (OSError, ValueError):
         pass
-    if have is not None and have != "cpu":
-        print("[bench] dispatch table already measured on hardware",
-              file=sys.stderr, flush=True)
+    have = table.get("backend")
+    if (have is not None and have != "cpu"
+            and table.get("kernel_gen") == KERNEL_GEN):
+        print("[bench] dispatch table already measured on hardware at the "
+              "current kernel generation", file=sys.stderr, flush=True)
         return
 
     pending = sorted(ab_kernels.ALL_KINDS)
@@ -887,7 +891,8 @@ def _measure_dispatch_out_of_process(timeout_per_kind_s: float = 420.0
             try:
                 ab_kernels.publish_dispatch(
                     "tpu", "timeout", {kind: {"default": "xla",
-                                              "timeout_demoted": True}})
+                                              "timeout_demoted": True}},
+                    kernel_gen=KERNEL_GEN)
             except OSError:
                 pass
             # The killed child's chip grant takes a while to expire;
@@ -905,7 +910,8 @@ def _measure_dispatch_out_of_process(timeout_per_kind_s: float = 420.0
                         ab_kernels.publish_dispatch(
                             "tpu", "timeout",
                             {rest: {"default": "xla",
-                                    "timeout_demoted": True}})
+                                    "timeout_demoted": True}},
+                            kernel_gen=KERNEL_GEN)
                     except OSError:
                         pass
                 return

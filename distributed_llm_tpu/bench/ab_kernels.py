@@ -274,12 +274,13 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
     results["dispatch"] = dispatch
     print(json.dumps({"dispatch": dispatch}), flush=True)
     if write_dispatch:
-        publish_dispatch(results["backend"], results["model"], dispatch)
+        publish_dispatch(results["backend"], results["model"], dispatch,
+                         kernel_gen=PA.KERNEL_GEN)
     return results
 
 
 def publish_dispatch(backend: str, model: str, dispatch: dict,
-                     path: str = None) -> bool:
+                     path: str = None, kernel_gen: int = None) -> bool:
     """Write the measured dispatch table, enforcing the artifact policy.
 
     A table measured on real hardware is a committed artifact; a CPU run
@@ -305,12 +306,19 @@ def publish_dispatch(backend: str, model: str, dispatch: dict,
               f"{prior_backend!r}, this run is {backend!r} (delete the "
               "file to force)", flush=True)
         return False
+    # Merge only into a same-backend, same-kernel-generation table:
+    # winners measured on different hardware OR against older kernel
+    # implementations must not mix with fresh ones.
+    same_gen = (kernel_gen is None
+                or prior.get("kernel_gen") == kernel_gen)
     merged = (dict(prior.get("dispatch") or {})
-              if prior_backend == backend else {})
+              if prior_backend == backend and same_gen else {})
     merged.update(dispatch)
+    out = {"backend": backend, "model": model, "dispatch": merged}
+    if kernel_gen is not None:
+        out["kernel_gen"] = kernel_gen
     with open(path, "w") as f:
-        json.dump({"backend": backend, "model": model,
-                   "dispatch": merged}, f, indent=1)
+        json.dump(out, f, indent=1)
     print(f"# wrote {path} ({len(dispatch)}/{len(merged)} kinds updated)",
           flush=True)
     return True

@@ -111,8 +111,10 @@ time.sleep(30)                       # watchdog must fire long before this
 def test_out_of_process_ab_skips_when_hardware_table_exists(tmp_path,
                                                             monkeypatch):
     from distributed_llm_tpu.bench import ab_kernels
+    from distributed_llm_tpu.ops.pallas_attention import KERNEL_GEN
     table = tmp_path / "ab_dispatch.json"
     table.write_text(json.dumps({"backend": "tpu", "model": "m",
+                                 "kernel_gen": KERNEL_GEN,
                                  "dispatch": {}}))
     monkeypatch.setattr(ab_kernels, "DISPATCH_PATH", str(table))
     calls = []
@@ -122,7 +124,25 @@ def test_out_of_process_ab_skips_when_hardware_table_exists(tmp_path,
     monkeypatch.setattr(sp, "Popen",
                         lambda *a, **k: calls.append("spawn"))
     bench._measure_dispatch_out_of_process()
-    assert calls == [], "hardware table present: nothing should run"
+    assert calls == [], "current-gen hardware table: nothing should run"
+
+    # A STALE-generation hardware table must trigger re-measurement: the
+    # kernels it judged no longer exist.
+    table.write_text(json.dumps({"backend": "tpu", "model": "m",
+                                 "kernel_gen": KERNEL_GEN - 1,
+                                 "dispatch": {}}))
+
+    class Done:
+        def poll(self):
+            return 0
+
+        def kill(self):
+            pass
+
+    monkeypatch.setattr(sp, "Popen",
+                        lambda *a, **k: calls.append("spawn") or Done())
+    bench._measure_dispatch_out_of_process()
+    assert calls, "stale-gen table should re-measure"
 
 
 def test_out_of_process_ab_timeout_pins_kind_to_xla(tmp_path, monkeypatch):
@@ -144,10 +164,12 @@ def test_out_of_process_ab_timeout_pins_kind_to_xla(tmp_path, monkeypatch):
         def poll(self):
             if self.hang and not self.killed:
                 return None
-            # A completing child writes its kind via the real merge path.
+            # A completing child writes its kind via the real merge path
+            # (real children stamp the current kernel generation).
+            from distributed_llm_tpu.ops.pallas_attention import KERNEL_GEN
             ab_kernels.publish_dispatch(
                 "tpu", "m", {self.kind: {"default": "pallas"}},
-                path=str(table))
+                path=str(table), kernel_gen=KERNEL_GEN)
             return 0
 
         def kill(self):

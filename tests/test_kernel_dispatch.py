@@ -184,3 +184,25 @@ def test_micro_ab_records_rel_err(tmp_path, monkeypatch):
                               kinds={"prefill"})
     for c in res["cases"]:
         assert c.get("rel_err") is not None and c["rel_err"] <= 0.05, c
+
+
+def test_stale_kernel_gen_starts_clean(tmp_path):
+    """A table measured against an older kernel generation must not mix
+    with fresh measurements (publish starts clean on gen mismatch)."""
+    from distributed_llm_tpu.bench.ab_kernels import publish_dispatch
+    out = str(tmp_path / "ab_dispatch.json")
+    assert publish_dispatch("tpu", "m",
+                            {"decode": {"default": "xla"}}, path=out,
+                            kernel_gen=1)
+    assert publish_dispatch("tpu", "m",
+                            {"prefill": {"default": "pallas"}}, path=out,
+                            kernel_gen=2)
+    data = json.loads(open(out).read())
+    assert data["kernel_gen"] == 2
+    assert "decode" not in data["dispatch"], "stale-gen winners mixed"
+    # Same gen merges as usual.
+    assert publish_dispatch("tpu", "m",
+                            {"chunk": {"default": "pallas"}}, path=out,
+                            kernel_gen=2)
+    data = json.loads(open(out).read())
+    assert set(data["dispatch"]) == {"prefill", "chunk"}
