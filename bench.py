@@ -792,9 +792,15 @@ def run(progress: "Progress" = None) -> dict:
 
     # North-star-scale serving (VERDICT r2 #2b).  Skipped on the CPU
     # fallback (a 1B model on one host core is not a measurement) unless
-    # explicitly forced.
+    # explicitly forced, and in the spec-A/B run (DLLM_BENCH_SPEC_ORIN
+    # changes only the orin tier's draft — the flagship cluster is
+    # identical, so re-measuring it would double the costliest phase's
+    # chip time for the same numbers).
     import os
-    if backend != "cpu" or os.environ.get("DLLM_BENCH_FLAGSHIP") == "1":
+    if os.environ.get("DLLM_BENCH_SPEC_ORIN") == "1":
+        flagship = {"skipped": "spec A/B run — flagship identical to the "
+                               "headline run's"}
+    elif backend != "cpu" or os.environ.get("DLLM_BENCH_FLAGSHIP") == "1":
         flagship = flagship_phase(beat=progress.beat)
     else:
         flagship = {"skipped": "cpu fallback backend"}
