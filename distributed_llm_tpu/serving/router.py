@@ -246,6 +246,17 @@ class Router:
         if self.enable_failover and self._is_error(raw):
             other = "orin" if which == "nano" else "nano"
             logger.warning("%s failed — failing over to %s", which, other)
+            # Record the PRIMARY's failure before switching: the
+            # reference feeds perf only for the device that ultimately
+            # served (router.py:292-295), so failover masked every
+            # failure from the perf strategy — yet its fail_penalty
+            # exists precisely to steer traffic off flaky devices.
+            # Divergence documented in PARITY.md; especially load-bearing
+            # for request timeouts (a wedged tier must lose traffic).
+            try:
+                self.query_router.update_perf(which, lat_ms, 0, ok=False)
+            except Exception:
+                pass
             raw2, which2, lat2 = self._run_device(other, history)
             if not self._is_error(raw2):
                 raw, which, lat_ms = raw2, which2, lat2
@@ -305,6 +316,13 @@ class Router:
             other = "orin" if which == "nano" else "nano"
             logger.warning("%s stream setup failed — failing over to %s",
                            which, other)
+            # Same as the sync path: the primary's failure must reach
+            # the perf strategy even though failover will serve.
+            try:
+                self.query_router.update_perf(
+                    which, (time.perf_counter() - t0) * 1000.0, 0, ok=False)
+            except Exception:
+                pass
             alt = self.tiers[other].process_stream(history)
             if not self._is_error(alt):
                 handle, which = alt, other

@@ -455,3 +455,23 @@ def test_router_fails_over_on_tier_timeout(cluster):
         assert device == "orin" and resp["ok"] is True
     finally:
         nano.server_manager._engine = real_engine
+
+
+def test_failover_records_primary_failure_in_perf(cluster):
+    """The reference feeds perf only for the device that ultimately
+    served (router.py:292-295), so failover masked every failure from
+    the perf strategy.  We diverge (PARITY.md): the primary's failure is
+    recorded too — fail_penalty exists to steer traffic off flaky
+    tiers, which matters most when request timeouts mark a wedged one."""
+    fi = FaultInjector()
+    r = make_router(cluster, strategy="perf", benchmark_mode=True,
+                    fault_injector=fi)
+    fi.fail_next("nano", "boom")
+    resp, _, device = r.route_query(
+        [{"role": "user", "content": "hello there"}])   # perf default: nano
+    assert device == "orin" and resp["ok"] is True
+    strategy = r.query_router.router
+    nano_samples = list(strategy.samples["nano"])
+    assert nano_samples and nano_samples[-1][2] is False, nano_samples
+    orin_samples = list(strategy.samples["orin"])
+    assert orin_samples and orin_samples[-1][2] is True, orin_samples
