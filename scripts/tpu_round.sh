@@ -45,8 +45,10 @@ PY
 
   # 0. Bench-tier checkpoints from an older vocabulary are unloadable
   #    (round 3 moved the engine to the 4096-id subword BPE): clear any
-  #    stale ones so step 1 retrains at the current vocab.
-  python - <<'PY'
+  #    stale ones so step 1 retrains at the current vocab.  Timeout:
+  #    orbax metadata reads touch jax.devices(), which blocks forever on
+  #    a wedge (observed live).
+  timeout 300 python - <<'PY'
 import shutil
 from distributed_llm_tpu.config import MODEL_PRESETS
 from distributed_llm_tpu.utils.checkpoint import peek_vocab_size
