@@ -253,3 +253,26 @@ def test_decode_work_accounts_int8_kv():
     assert q8["hbm_bytes"] * (2 * d) == pytest.approx(
         full["hbm_bytes"] * (d + 4))
     assert q8["flops"] == full["flops"]
+
+
+def test_flash_decode_q8_serving_geometry_multiblock():
+    """The q8 decode kernel at the bench tiers' head geometry (16q/8kv)
+    with a multi-block cache and ragged positions — the exact shape
+    class whose compile wedged the r3 chip mid-A/B."""
+    from distributed_llm_tpu.ops import attention as A
+    from distributed_llm_tpu.ops.pallas_attention import \
+        flash_decode_attention_q8
+    b, s, nkv, d, nq = 2, 512, 8, 64, 16
+    kq, ks = quantize_kv_rows(
+        jax.random.normal(jax.random.PRNGKey(20), (b, s, nkv, d),
+                          jnp.bfloat16))
+    vq, vs = quantize_kv_rows(
+        jax.random.normal(jax.random.PRNGKey(21), (b, s, nkv, d),
+                          jnp.bfloat16))
+    q = jax.random.normal(jax.random.PRNGKey(22), (b, nq, d), jnp.bfloat16)
+    pos = jnp.asarray([300, 511], jnp.int32)
+    want = A.decode(q, kq, vq, pos, impl="xla", k_scale=ks, v_scale=vs)
+    got = flash_decode_attention_q8(q, kq, vq, ks, vs, pos)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               atol=2e-2, rtol=2e-2)

@@ -71,6 +71,7 @@ def test_flash_causal_grad_matches_xla():
 @pytest.mark.parametrize("b,nq,nkv,d,s_max", [
     (1, 4, 4, 16, 64),
     (3, 8, 2, 32, 128),
+    (2, 16, 8, 64, 512),      # bench-tier serving geometry, 2 KV blocks
 ])
 def test_flash_decode_matches_xla(b, nq, nkv, d, s_max):
     ks = jax.random.split(jax.random.PRNGKey(3), 4)
@@ -169,6 +170,7 @@ def test_resolve_impl(monkeypatch):
     (1, 128, 256, 8, 2, 16),    # multiple q blocks too
     (1, 5, 256, 4, 2, 16),      # γ+1-row verify chunk (speculative.py)
     (1, 512, 512, 4, 2, 16),    # LARGE chunk: the wide transpose kernel
+    (1, 128, 512, 16, 8, 64),   # bench-tier serving geometry (native)
 ])
 def test_flash_chunk_matches_xla(b, s_c, w, nq, nkv, d):
     ks = jax.random.split(jax.random.PRNGKey(3), 3)
@@ -271,6 +273,7 @@ def test_batched_engine_generates_identically_on_pallas_paged_path(monkeypatch):
     (1, 64, 128, 4, 4, 16),
     (2, 64, 256, 4, 2, 32),
     (1, 512, 512, 4, 2, 16),    # LARGE chunk: the wide transpose kernel
+    (1, 128, 512, 16, 8, 64),   # bench-tier serving geometry (native)
 ])
 def test_flash_chunk_q8_matches_xla_dequant(b, s_c, w, nq, nkv, d):
     """int8-cache chunk kernel == XLA chunk over the dequantized view
