@@ -54,3 +54,29 @@ def test_device_memory_snapshot_shape():
     snap = telemetry.device_memory_snapshot()
     assert len(snap) == 8            # virtual CPU mesh from conftest
     assert {"device", "platform", "bytes_in_use"} <= set(snap[0])
+
+
+def test_enable_persistent_compile_cache_exports_env(tmp_path, monkeypatch):
+    """The helper must point jax at the cache dir AND export the env vars
+    so subprocess children (per-kind A/B, subprocess tests) inherit the
+    same cache; an explicit JAX_COMPILATION_CACHE_DIR wins."""
+    import jax
+
+    from distributed_llm_tpu.utils.compile_cache import \
+        enable_persistent_compile_cache
+
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path / "env"))
+    assert enable_persistent_compile_cache() == str(tmp_path / "env")
+
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR")
+    prior = jax.config.jax_compilation_cache_dir
+    try:
+        got = enable_persistent_compile_cache(str(tmp_path / "explicit"))
+        assert got == str(tmp_path / "explicit")
+        import os
+        assert os.environ["JAX_COMPILATION_CACHE_DIR"] == got
+        assert jax.config.jax_compilation_cache_dir == got
+    finally:
+        # Restore the suite-wide cache dir (conftest set it): this config
+        # is process-global and later tests should keep their warm cache.
+        jax.config.update("jax_compilation_cache_dir", prior)
