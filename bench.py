@@ -832,6 +832,21 @@ def run(progress: "Progress" = None) -> dict:
     }
 
 
+def _poll_or_abandon(proc, timeout_s: float,
+                     interval_s: float = 0.5) -> bool:
+    """True iff the child exits within the timeout; otherwise kill it
+    (best effort — never wait: a child stuck in an uninterruptible
+    device ioctl survives SIGKILL until the syscall returns) and report
+    failure.  The shared discipline for every chip-touching subprocess."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return True
+        time.sleep(interval_s)
+    proc.kill()
+    return False
+
+
 def _measure_dispatch_out_of_process(timeout_per_kind_s: float = 420.0
                                      ) -> None:
     """Measure the fast dispatch table via per-kind SUBPROCESSES before
@@ -869,6 +884,17 @@ def _measure_dispatch_out_of_process(timeout_per_kind_s: float = 420.0
               "current kernel generation", file=sys.stderr, flush=True)
         return
 
+    def demote(kinds):
+        # A kernel that can't even finish its A/B must not serve.
+        try:
+            ab_kernels.publish_dispatch(
+                "tpu", "timeout",
+                {k: {"default": "xla", "timeout_demoted": True}
+                 for k in kinds},
+                kernel_gen=KERNEL_GEN)
+        except OSError:
+            pass
+
     pending = sorted(ab_kernels.ALL_KINDS)
     for i, kind in enumerate(pending):
         cmd = [sys.executable, "-m",
@@ -883,24 +909,11 @@ def _measure_dispatch_out_of_process(timeout_per_kind_s: float = 420.0
             ablog.close()
         except OSError:
             return
-        deadline = time.monotonic() + timeout_per_kind_s
-        while time.monotonic() < deadline:
-            if proc.poll() is not None:
-                break
-            time.sleep(1.0)
-        if proc.poll() is None:
-            proc.kill()          # best effort; do NOT wait on a D-state
+        if not _poll_or_abandon(proc, timeout_per_kind_s):
             print(f"[bench] dispatch A/B {kind} TIMED OUT — pinning it "
                   "to xla and re-probing the chip", file=sys.stderr,
                   flush=True)
-            # A kernel that can't even finish its A/B must not serve.
-            try:
-                ab_kernels.publish_dispatch(
-                    "tpu", "timeout", {kind: {"default": "xla",
-                                              "timeout_demoted": True}},
-                    kernel_gen=KERNEL_GEN)
-            except OSError:
-                pass
+            demote([kind])
             # The killed child's chip grant takes a while to expire;
             # don't stack the next claimant onto it.
             for backoff in (60.0, 180.0, 300.0):
@@ -911,15 +924,7 @@ def _measure_dispatch_out_of_process(timeout_per_kind_s: float = 420.0
                 print("[bench] chip did not recover after A/B timeout — "
                       "skipping the remaining kinds", file=sys.stderr,
                       flush=True)
-                for rest in pending[i + 1:]:
-                    try:
-                        ab_kernels.publish_dispatch(
-                            "tpu", "timeout",
-                            {rest: {"default": "xla",
-                                    "timeout_demoted": True}},
-                            kernel_gen=KERNEL_GEN)
-                    except OSError:
-                        pass
+                demote(pending[i + 1:])
                 return
 
 
@@ -953,15 +958,10 @@ def _accelerator_healthy(timeout_s: int = 180) -> bool:
                                 stderr=subprocess.DEVNULL, text=True)
     except OSError:
         return False
-    deadline = time.monotonic() + timeout_s
-    while time.monotonic() < deadline:
-        ret = proc.poll()
-        if ret is not None:
-            out = proc.stdout.read() if proc.stdout else ""
-            return ret == 0 and "HEALTHY" in out
-        time.sleep(0.5)
-    proc.kill()          # best effort; do NOT wait — abandon a D-state child
-    return False
+    if not _poll_or_abandon(proc, timeout_s):
+        return False
+    out = proc.stdout.read() if proc.stdout else ""
+    return proc.returncode == 0 and "HEALTHY" in out
 
 
 if __name__ == "__main__":
