@@ -118,7 +118,11 @@ class TierClient:
         No request timeout here (unlike ``process``): a stream is
         consumed incrementally by the caller, so there is no single
         bounded wait to cap — a wedged chip stalls the SSE consumer,
-        which owns its own disconnect policy."""
+        which owns its own disconnect policy.  Sequential engines DO
+        take the tier lock for the stream's whole life (released on
+        exhaustion, close, or GC): a timeout-abandoned sync worker must
+        not interleave with a stream on an engine that assumes
+        serialized callers."""
         if self.faults is not None:
             fault = self.faults.intercept(self.name)
             if fault is not None:
@@ -131,16 +135,30 @@ class TierClient:
             if not hasattr(engine, "generate_stream"):
                 return {"error": "Request failed: engine does not support "
                                  "token streaming"}
-            return _PrimedStream(engine.generate_stream(history))
+            if getattr(engine, "concurrent_safe", False):
+                return _PrimedStream(engine.generate_stream(history))
+            self._engine_lock.acquire()
+            try:
+                return _PrimedStream(engine.generate_stream(history),
+                                     release=self._engine_lock.release)
+            except BaseException:
+                self._engine_lock.release()
+                raise
         except Exception as exc:
             return {"error": f"Request failed: {exc}"}
 
 
 class _PrimedStream:
     """A stream handle whose first delta has already been pulled (raising
-    setup/prefill errors eagerly); iteration replays it then continues."""
+    setup/prefill errors eagerly); iteration replays it then continues.
 
-    def __init__(self, handle):
+    ``release`` (the tier's engine-lock release) is invoked exactly once
+    when the stream finishes — normal exhaustion, generator close (an
+    SSE client disconnect closes the response generator chain), or GC of
+    an unconsumed handle."""
+
+    def __init__(self, handle, release=None):
+        self._release_fn = release
         self._handle = handle
         self._it = iter(handle)
         self._first: Optional[str] = None
@@ -149,12 +167,29 @@ class _PrimedStream:
             self._first = next(self._it)
         except StopIteration:
             self._exhausted = True
+        except BaseException:
+            # Setup failure: the CALLER still holds (and releases) the
+            # lock — neutralize ours so __del__ of this half-built
+            # object can't double-release.
+            self._release_fn = None
+            raise
+
+    def _release_once(self) -> None:
+        fn, self._release_fn = self._release_fn, None
+        if fn is not None:
+            fn()
 
     def __iter__(self):
-        if self._first is not None:
-            yield self._first
-        if not self._exhausted:
-            yield from self._it
+        try:
+            if self._first is not None:
+                yield self._first
+            if not self._exhausted:
+                yield from self._it
+        finally:
+            self._release_once()
+
+    def __del__(self):
+        self._release_once()
 
     @property
     def result(self):

@@ -475,3 +475,64 @@ def test_failover_records_primary_failure_in_perf(cluster):
     assert nano_samples and nano_samples[-1][2] is False, nano_samples
     orin_samples = list(strategy.samples["orin"])
     assert orin_samples and orin_samples[-1][2] is True, orin_samples
+
+
+def test_stream_holds_sequential_engine_lock_until_done():
+    """A live stream on a sequential engine must exclude sync calls
+    (which would interleave with an engine that assumes serialized
+    callers); exhaustion releases the lock.  Setup failure and
+    unconsumed-handle GC release it too."""
+    import gc
+
+    from distributed_llm_tpu.serving.tiers import TierClient
+
+    class FakeHandle:
+        result = None
+
+        def __init__(self, deltas):
+            self._deltas = deltas
+
+        def __iter__(self):
+            yield from self._deltas
+
+    class StreamEngine:
+        def generate_stream(self, history, **kw):
+            return FakeHandle(["a", "b"])
+
+        def generate(self, history, **kw):
+            class R:
+                text = "sync"
+            return R()
+
+    client = TierClient(_timeout_tier(0.2), _StubManager(StreamEngine()))
+    handle = client.process_stream("hi")
+    assert not isinstance(handle, dict), handle
+    # Lock held: a sync request times out instead of interleaving.
+    out = client.process("also hi")
+    assert "timed out" in out.get("error", ""), out
+    assert list(handle) == ["a", "b"]       # exhaustion releases
+    assert client.process("again") == {"response": "sync"}
+
+    # Unconsumed handle: GC releases.
+    handle2 = client.process_stream("hi")
+    assert not isinstance(handle2, dict)
+    del handle2
+    gc.collect()
+    assert client.process("after gc") == {"response": "sync"}
+
+    # Setup failure (priming raises): the lock is released once.
+    class FailingHandle(FakeHandle):
+        def __iter__(self):
+            raise RuntimeError("prefill exploded")
+            yield  # pragma: no cover
+
+    class FailingStreamEngine(StreamEngine):
+        def generate_stream(self, history, **kw):
+            return FailingHandle([])
+
+    client2 = TierClient(_timeout_tier(0.2),
+                         _StubManager(FailingStreamEngine()))
+    err = client2.process_stream("hi")
+    assert "prefill exploded" in err["error"]
+    assert client2.process_stream("hi")["error"]  # lock free: fails again,
+    gc.collect()                                  # not deadlocks
