@@ -64,6 +64,15 @@ PRODUCTION_CFG: Dict[str, Any] = {
     "use_semantic_cache": True,
     "prediction_confidence_threshold": 0.70,
     "enable_response_cache": True,
+    # Prefix-affinity routing (beyond-reference, serving/router.py):
+    # steer LOW-confidence decisions to the tier already holding this
+    # conversation's parked KV prefix — a cold re-prefill elsewhere
+    # throws away an O(history) cache.  Production only (absent from
+    # BENCHMARK_CFG): labeled-accuracy benchmarks keep reference routing
+    # semantics.
+    "enable_prefix_affinity": True,
+    "prefix_affinity_min_confidence": 0.75,
+    "prefix_affinity_min_tokens": 32,
 }
 
 

@@ -673,6 +673,17 @@ class ContinuousBatchingEngine:
 
         return StreamHandle(deltas(), req)
 
+    def prefix_affinity(self, history) -> int:
+        """Longest parked-prefix token match in the paged pool for
+        ``history`` (non-destructive; see InferenceEngine.prefix_affinity)."""
+        if self.prefix_cache is None:
+            return 0
+        ids, _ = prepare_prompt(self.tokenizer, history,
+                                self.tier.prefill_buckets,
+                                self.cfg.max_seq_len,
+                                self.tier.max_new_tokens)
+        return self.prefix_cache.peek(ids)
+
     def warmup(self, beat=None) -> None:
         """Compile the decode tick + smallest cold-prefill bucket (via one
         real request), then the chunk-prefill programs for the two smallest

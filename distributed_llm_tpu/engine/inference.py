@@ -764,6 +764,19 @@ class InferenceEngine:
 
         return StreamHandle(deltas(), req)
 
+    def prefix_affinity(self, history) -> int:
+        """Longest parked-prefix token match this engine could reuse for
+        ``history`` — a NON-destructive probe for prefix-affinity routing
+        (serving/router.py): the router prefers the tier already holding
+        a conversation's KV over re-prefilling it cold elsewhere.  0 when
+        reuse is off or nothing matches."""
+        if self.prefix_cache is None:
+            return 0
+        ids, _ = prepare_prompt(self.tokenizer, history, self._buckets,
+                                self._max_seq, self.tier.max_new_tokens,
+                                allow_long=True)
+        return self.prefix_cache.peek(ids)
+
     def warmup(self, beat=None) -> None:
         """Compile EVERY prefill bucket + the decode loop, and (when prefix
         reuse is on) the suffix-prefill programs for the two smallest

@@ -143,6 +143,30 @@ class PrefixCache:
             self.tokens_saved += best_len
             return entry, best_len
 
+    def peek(self, ids: Sequence[int]) -> int:
+        """Longest reusable common-prefix length a take() would find —
+        with NO removal and NO hit/miss accounting.  Prefix-affinity
+        routing probes (serving/router.py) must not perturb the cache,
+        its LRU order, or its stats."""
+        ids = tuple(ids)
+        cap = len(ids) - 1
+        best = 0
+        with self._lock:
+            for e in self._entries:
+                bound = min(len(e.ids), cap)
+                if bound < max(self.min_prefix, best + 1):
+                    continue
+                if e.ids[:bound] == ids[:bound]:
+                    m = bound
+                else:
+                    m = 0
+                    for x, y in zip(e.ids[:bound], ids[:bound]):
+                        if x != y:
+                            break
+                        m += 1
+                best = max(best, m)
+        return best if best >= self.min_prefix else 0
+
     def untake(self, entry: PrefixEntry, matched_len: int) -> None:
         """Undo a take(): the caller found it could not use the reclaimed
         cache (e.g. no suffix bucket fits) and its buffers were NOT donated.

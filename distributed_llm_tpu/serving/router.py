@@ -81,6 +81,19 @@ class Router:
             and bool(self.config.get("enable_response_cache", False)))
         self.cache_last_k = int(self.config.get("cache_last_k", 6))
         self.enable_failover = bool(self.config.get("enable_failover", True))
+        # Prefix-affinity routing (production only, beyond-reference): a
+        # low-confidence decision is steered to the tier that already
+        # holds this conversation's parked KV prefix — a cold re-prefill
+        # elsewhere throws away an O(history) cache the engines worked
+        # to keep.  Labeled-accuracy benchmarks keep reference semantics
+        # (off in benchmark_mode and in BENCHMARK_CFG).
+        self.enable_prefix_affinity = (
+            not benchmark_mode
+            and bool(self.config.get("enable_prefix_affinity", False)))
+        self.prefix_affinity_min_confidence = float(
+            self.config.get("prefix_affinity_min_confidence", 0.75))
+        self.prefix_affinity_min_tokens = int(
+            self.config.get("prefix_affinity_min_tokens", 32))
         self._response_store: Dict[str, Dict[str, Any]] = {}
 
         # Continuous liveness probing + ICI health exchange (serving/
@@ -100,6 +113,44 @@ class Router:
         self.threshold_fallback = threshold
 
     # -- helpers -----------------------------------------------------------
+
+    def _apply_prefix_affinity(self, device: str, confidence: float,
+                               method: str, reasoning: str, history
+                               ) -> Tuple[str, str, str]:
+        """Steer a LOW-confidence decision to the tier already holding
+        this conversation's parked KV prefix (cache-locality-aware
+        routing — beyond the reference, production only).
+
+        Probes are non-destructive (PrefixCache.peek through
+        engine.prefix_affinity), touch only ALREADY-RUNNING local
+        engines (never starts one, never crosses hosts), and only
+        override when the other tier's match beats the chosen tier's by
+        at least ``prefix_affinity_min_tokens`` — a confident routing
+        decision or a trivial prefix never flips."""
+        if (not self.enable_prefix_affinity
+                or confidence >= self.prefix_affinity_min_confidence):
+            return device, method, reasoning
+        scores: Dict[str, int] = {}
+        for name, tier in self.tiers.items():
+            engine = getattr(tier.server_manager, "_engine", None)
+            probe = getattr(engine, "prefix_affinity", None)
+            if callable(probe):
+                try:
+                    scores[name] = int(probe(history))
+                except Exception:
+                    scores[name] = 0
+            else:
+                scores[name] = 0
+        best = max(scores, key=scores.get) if scores else device
+        if (best != device
+                and scores[best] >= scores.get(device, 0)
+                + self.prefix_affinity_min_tokens):
+            reasoning = (f"prefix affinity: {best} holds a "
+                         f"{scores[best]}-token parked prefix of this "
+                         f"conversation (decision was {device} at "
+                         f"confidence {confidence:.2f}); {reasoning}")
+            return best, f"{method}+prefix_affinity", reasoning
+        return device, method, reasoning
 
     @staticmethod
     def _extract_text(response: Any) -> Optional[str]:
@@ -240,6 +291,8 @@ class Router:
         (device, method, confidence, reasoning,
          cache_hit, overhead_ms) = self._decide(query, context, ctx_hash,
                                                 history)
+        device, method, reasoning = self._apply_prefix_affinity(
+            device, confidence, method, reasoning, history)
 
         # 2) inference + failover
         raw, which, lat_ms = self._run_device(device, history)
@@ -307,6 +360,8 @@ class Router:
         (device, method, confidence, reasoning,
          cache_hit, overhead_ms) = self._decide(query, context, ctx_hash,
                                                 history)
+        device, method, reasoning = self._apply_prefix_affinity(
+            device, confidence, method, reasoning, history)
 
         t0 = time.perf_counter()
         tier = self.tiers.get(device, self.nano)

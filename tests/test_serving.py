@@ -536,3 +536,65 @@ def test_stream_holds_sequential_engine_lock_until_done():
     assert "prefill exploded" in err["error"]
     assert client2.process_stream("hi")["error"]  # lock free: fails again,
     gc.collect()                                  # not deadlocks
+
+
+# -- prefix-affinity routing (beyond-reference, production only) ------------
+
+def test_prefix_affinity_override_logic(cluster):
+    """Low-confidence decisions flip to the tier holding a meaningful
+    parked prefix; confident decisions and trivial prefixes never do."""
+    r = make_router(cluster, strategy="heuristic", config=PRODUCTION_CFG)
+    assert r.enable_prefix_affinity
+
+    class FakeEngine:
+        def __init__(self, n):
+            self.n = n
+
+        def prefix_affinity(self, history):
+            return self.n
+
+    r.tiers["nano"].server_manager._engine = FakeEngine(0)
+    r.tiers["orin"].server_manager._engine = FakeEngine(200)
+
+    hist = [{"role": "user", "content": "and another thing?"}]
+    dev, method, why = r._apply_prefix_affinity("nano", 0.5, "heuristic",
+                                                "base", hist)
+    assert dev == "orin" and method.endswith("+prefix_affinity")
+    assert "200-token parked prefix" in why
+
+    # Confident decision: no probe, no flip.
+    dev, method, _ = r._apply_prefix_affinity("nano", 0.9, "heuristic",
+                                              "base", hist)
+    assert dev == "nano" and method == "heuristic"
+
+    # Margin below min_tokens: no flip.
+    r.tiers["orin"].server_manager._engine = FakeEngine(10)
+    dev, _, _ = r._apply_prefix_affinity("nano", 0.5, "heuristic",
+                                         "base", hist)
+    assert dev == "nano"
+
+    # Benchmark mode keeps reference semantics entirely.
+    rb = make_router(cluster, strategy="heuristic", benchmark_mode=True,
+                     config=PRODUCTION_CFG)
+    assert not rb.enable_prefix_affinity
+
+
+def test_prefix_affinity_end_to_end_with_real_engines(cluster):
+    """After a conversation serves on orin, a low-confidence follow-up
+    probes the REAL engines' parked prefixes and sticks to orin."""
+    r = make_router(cluster, strategy="heuristic", config=PRODUCTION_CFG)
+    hist = [{"role": "user", "content":
+             "Please implement a merge of two sorted lists and explain "
+             "the complexity tradeoffs in detail for me now, covering "
+             "stability, allocation strategy, asymptotic and practical "
+             "costs, and how you would regression test the function "
+             "against adversarial inputs and fuzzed list shapes."}]
+    _, _, dev = r.route_query(hist)
+    assert dev == "orin"                      # complex → big tier
+    res = r.tiers["orin"].last_result
+    hist.append({"role": "assistant", "content": res.text})
+    hist.append({"role": "user", "content": "and?"})
+    dev2, method2, why2 = r._apply_prefix_affinity(
+        "nano", 0.5, "heuristic", "base", hist)
+    assert dev2 == "orin", (method2, why2)
+    assert "+prefix_affinity" in method2
