@@ -286,6 +286,8 @@ def create_app(router: Optional[Router] = None,
             "tiers": tiers,
             "devices": device_memory_snapshot(),
             "measured_tables": provenance,
+            "prefix_affinity_overrides": getattr(
+                router_, "prefix_affinity_overrides", 0),
         })
 
     @app.route("/history", methods=["GET"])

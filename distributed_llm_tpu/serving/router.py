@@ -94,6 +94,7 @@ class Router:
             self.config.get("prefix_affinity_min_confidence", 0.75))
         self.prefix_affinity_min_tokens = int(
             self.config.get("prefix_affinity_min_tokens", 32))
+        self.prefix_affinity_overrides = 0
         self._response_store: Dict[str, Dict[str, Any]] = {}
 
         # Continuous liveness probing + ICI health exchange (serving/
@@ -149,6 +150,7 @@ class Router:
                          f"{scores[best]}-token parked prefix of this "
                          f"conversation (decision was {device} at "
                          f"confidence {confidence:.2f}); {reasoning}")
+            self.prefix_affinity_overrides += 1
             return best, f"{method}+prefix_affinity", reasoning
         return device, method, reasoning
 
