@@ -127,12 +127,26 @@ class Router:
         engines (never starts one, never crosses hosts), and only
         override when the other tier's match beats the chosen tier's by
         at least ``prefix_affinity_min_tokens`` — a confident routing
-        decision or a trivial prefix never flips."""
+        decision or a trivial prefix never flips.
+
+        UPGRADE-ONLY: affinity may steer toward a STRONGER tier (later
+        in the cluster's declaration order — the reference's nano<orin
+        topology), never downgrade.  Locality must not cost capability:
+        a complex follow-up whose early small-talk parked the
+        conversation on nano still belongs on orin (measured: the
+        symmetric rule dragged orin-labeled queries to nano and cost
+        the semantic/hybrid cache-on legs ~0.17 accuracy; the reference
+        resolves every such tie toward orin too — threshold fallback,
+        heavy-context override)."""
         if (not self.enable_prefix_affinity
                 or confidence >= self.prefix_affinity_min_confidence):
             return device, method, reasoning
+        order = [t.name for t in self.cluster.tiers()]
         scores: Dict[str, int] = {}
         for name, tier in self.tiers.items():
+            if (name not in order or device not in order
+                    or order.index(name) <= order.index(device)):
+                continue                 # upgrade-only: skip weaker tiers
             engine = getattr(tier.server_manager, "_engine", None)
             probe = getattr(engine, "prefix_affinity", None)
             if callable(probe):
@@ -140,12 +154,21 @@ class Router:
                     scores[name] = int(probe(history))
                 except Exception:
                     scores[name] = 0
-            else:
-                scores[name] = 0
-        best = max(scores, key=scores.get) if scores else device
+        if not scores:
+            return device, method, reasoning
+        # The chosen tier's own match sets the bar the upgrade must beat.
+        own_engine = getattr(self.tiers[device].server_manager, "_engine",
+                             None)
+        own_probe = getattr(own_engine, "prefix_affinity", None)
+        own = 0
+        if callable(own_probe):
+            try:
+                own = int(own_probe(history))
+            except Exception:
+                own = 0
+        best = max(scores, key=scores.get)
         if (best != device
-                and scores[best] >= scores.get(device, 0)
-                + self.prefix_affinity_min_tokens):
+                and scores[best] >= own + self.prefix_affinity_min_tokens):
             reasoning = (f"prefix affinity: {best} holds a "
                          f"{scores[best]}-token parked prefix of this "
                          f"conversation (decision was {device} at "

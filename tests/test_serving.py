@@ -573,6 +573,15 @@ def test_prefix_affinity_override_logic(cluster):
                                          "base", hist)
     assert dev == "nano"
 
+    # UPGRADE-ONLY: a parked prefix on the weaker tier never downgrades
+    # an orin decision — locality must not cost capability (measured:
+    # the symmetric rule dragged orin-labeled queries to nano).
+    r.tiers["nano"].server_manager._engine = FakeEngine(500)
+    r.tiers["orin"].server_manager._engine = FakeEngine(0)
+    dev, method, _ = r._apply_prefix_affinity("orin", 0.2, "semantic",
+                                              "base", hist)
+    assert dev == "orin" and method == "semantic"
+
     # Benchmark mode keeps reference semantics entirely.
     rb = make_router(cluster, strategy="heuristic", benchmark_mode=True,
                      config=PRODUCTION_CFG)
