@@ -40,7 +40,7 @@ from .inference import (GenerationResult, prepare_prompt, trim_at_eos,
 from .paged_kv import (BlockAllocator, PagedConfig, TRASH_BLOCK,
                        chunk_prefill_paged, decode_step_paged, init_pool,
                        write_prefill_blocks)
-from .tokenizer import ByteTokenizer, get_tokenizer
+from .tokenizer import get_tokenizer
 
 History = Union[str, Sequence[Dict[str, Any]]]
 
