@@ -885,10 +885,18 @@ def _measure_dispatch_out_of_process(timeout_per_kind_s: float = 420.0
         return
 
     def demote(kinds):
-        # A kernel that can't even finish its A/B must not serve.
+        # A kernel that can't even finish its A/B must not serve.  The
+        # backend stamp must match what the per-kind children write
+        # (jax.default_backend() in THEIR process) or publish_dispatch
+        # treats the two sets as cross-backend and discards one during
+        # the merge — the probe's platform string IS what the children
+        # will stamp (same env, same call); a prior table's string can be
+        # stale across a plugin rename.
+        backend = (_PROBED_BACKEND
+                   or (have if have not in (None, "cpu") else "tpu"))
         try:
             ab_kernels.publish_dispatch(
-                "tpu", "timeout",
+                backend, "timeout",
                 {k: {"default": "xla", "timeout_demoted": True}
                  for k in kinds},
                 kernel_gen=KERNEL_GEN)
@@ -936,11 +944,18 @@ def _accelerator_configured() -> bool:
     return os.environ.get("JAX_PLATFORMS", "").lower() != "cpu"
 
 
+_PROBED_BACKEND: "str | None" = None
+
+
 def _accelerator_healthy(timeout_s: int = 180) -> bool:
     """Probe the default backend in a subprocess: a wedged chip/tunnel
     hangs device ops indefinitely, which would eat the whole bench window.
     The probe claims and releases the chip; on timeout/failure the bench
     falls back to CPU so the driver still records a result.
+
+    A healthy probe also records the child's jax.default_backend()
+    string in ``_PROBED_BACKEND`` — the exact stamp the per-kind A/B
+    children write, so parent-side dispatch demotions merge with theirs.
 
     Poll-and-abandon, NOT subprocess.run: a child stuck in an
     uninterruptible device ioctl survives SIGKILL until the syscall
@@ -948,10 +963,11 @@ def _accelerator_healthy(timeout_s: int = 180) -> bool:
     forever — the exact hang this probe exists to dodge."""
     import subprocess
     import sys
+    global _PROBED_BACKEND
     code = ("import jax, jax.numpy as jnp;"
             "x = jnp.ones((128, 128));"
             "jax.jit(lambda a: a @ a)(x).block_until_ready();"
-            "print('HEALTHY')")
+            "print('HEALTHY', jax.default_backend())")
     try:
         proc = subprocess.Popen([sys.executable, "-c", code],
                                 stdout=subprocess.PIPE,
@@ -961,7 +977,12 @@ def _accelerator_healthy(timeout_s: int = 180) -> bool:
     if not _poll_or_abandon(proc, timeout_s):
         return False
     out = proc.stdout.read() if proc.stdout else ""
-    return proc.returncode == 0 and "HEALTHY" in out
+    if proc.returncode == 0 and "HEALTHY" in out:
+        for line in out.splitlines():
+            if line.startswith("HEALTHY") and len(line.split()) > 1:
+                _PROBED_BACKEND = line.split()[1]
+        return True
+    return False
 
 
 if __name__ == "__main__":

@@ -682,7 +682,12 @@ class ContinuousBatchingEngine:
                                 self.tier.prefill_buckets,
                                 self.cfg.max_seq_len,
                                 self.tier.max_new_tokens)
-        return self.prefix_cache.peek(ids)
+        if not self._reuse_buckets:
+            return 0
+        # Same headroom cap as select_reuse's take() — the affinity score
+        # must not promise tokens a real reclaim could not use.
+        return self.prefix_cache.peek(
+            ids, max_len=self.cfg.max_seq_len - self._reuse_buckets[0])
 
     def warmup(self, beat=None) -> None:
         """Compile the decode tick + smallest cold-prefill bucket (via one

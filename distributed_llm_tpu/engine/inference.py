@@ -775,7 +775,12 @@ class InferenceEngine:
         ids, _ = prepare_prompt(self.tokenizer, history, self._buckets,
                                 self._max_seq, self.tier.max_new_tokens,
                                 allow_long=True)
-        return self.prefix_cache.peek(ids)
+        if not self._reuse_buckets:
+            return 0
+        # Same headroom cap as select_reuse's take() — the affinity score
+        # must not promise tokens a real reclaim could not use.
+        return self.prefix_cache.peek(
+            ids, max_len=self._max_seq - self._reuse_buckets[0])
 
     def warmup(self, beat=None) -> None:
         """Compile EVERY prefill bucket + the decode loop, and (when prefix

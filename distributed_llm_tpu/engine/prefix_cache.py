@@ -143,13 +143,18 @@ class PrefixCache:
             self.tokens_saved += best_len
             return entry, best_len
 
-    def peek(self, ids: Sequence[int]) -> int:
+    def peek(self, ids: Sequence[int],
+             max_len: Optional[int] = None) -> int:
         """Longest reusable common-prefix length a take() would find —
         with NO removal and NO hit/miss accounting.  Prefix-affinity
         routing probes (serving/router.py) must not perturb the cache,
-        its LRU order, or its stats."""
+        its LRU order, or its stats.  ``max_len`` mirrors take()'s cap
+        (the engine's suffix-bucket headroom) so affinity scores never
+        overstate what a subsequent take() could actually reuse."""
         ids = tuple(ids)
         cap = len(ids) - 1
+        if max_len is not None:
+            cap = min(cap, max_len)
         best = 0
         with self._lock:
             for e in self._entries:

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 
@@ -48,6 +48,13 @@ class TierClient:
         # ``concurrent_safe`` assume serialized callers); the batched
         # engine opts out via that attribute.
         self._engine_lock = threading.Lock()
+        # Abandoned-worker accounting: while a timed-out worker is still
+        # running (wedged chip), new sync requests on a serialized engine
+        # would only queue behind it — fail them fast instead of growing
+        # an unbounded daemon-thread backlog that drains serially on
+        # recovery, each running a generation nobody reads.
+        self._abandoned_lock = threading.Lock()
+        self._abandoned = 0
 
     def process(self, history: History) -> Dict[str, Any]:
         """Run inference; error dicts mirror the reference client shapes.
@@ -60,8 +67,10 @@ class TierClient:
         in-process call on a wedged chip can never be cancelled.  The
         abandoned worker finishes (or hangs) in the background, exactly
         like the reference's Jetson finishing a response nobody waits
-        for; ``last_result`` may later reflect that stale completion
-        (only observable when timeouts are already firing)."""
+        for; its stale completion never overwrites ``last_result``.
+        While an abandoned call is still outstanding on a serialized
+        engine, new requests fail fast instead of spawning workers that
+        would only queue behind the wedged call."""
         if self.faults is not None:
             fault = self.faults.intercept(self.name)
             if fault is not None:
@@ -69,27 +78,67 @@ class TierClient:
 
         timeout = self.tier.request_timeout_s
         if timeout is None:
-            return self._process_body(history)
+            resp, result = self._process_body(history)
+            if result is not None:
+                self.last_result = result
+            return resp
+        if self._abandoned and not self._engine_concurrent_safe():
+            logger.warning("tier %s has an abandoned timed-out call "
+                           "outstanding — failing fast", self.name)
+            return {"error": f"Request failed: {self.name} is busy with "
+                             f"an abandoned timed-out request"}
         box: Dict[str, Any] = {}
         done = threading.Event()
 
         def work():
+            resp: Dict[str, Any] = {"error": "Request failed: worker died"}
+            result = None
             try:
-                box["out"] = self._process_body(history)
+                resp, result = self._process_body(history)
             finally:
-                done.set()
+                # Atomic with the caller's abandon decision: either
+                # done is set HERE first (caller sees the result) or the
+                # caller marked abandoned first (stale completion never
+                # touches last_result).
+                with self._abandoned_lock:
+                    box["out"] = resp
+                    done.set()
+                    if box.get("abandoned"):
+                        self._abandoned -= 1
+                    elif result is not None:
+                        self.last_result = result
 
         threading.Thread(target=work, daemon=True,
                          name=f"{self.name}-request").start()
         if not done.wait(timeout):
-            logger.warning("tier %s request exceeded %.0fs — abandoning "
-                           "the device call and reporting failure",
-                           self.name, timeout)
-            return {"error": f"Request failed: {self.name} timed out "
-                             f"after {timeout:.0f}s"}
+            with self._abandoned_lock:
+                if not done.is_set():
+                    box["abandoned"] = True
+                    self._abandoned += 1
+            if box.get("abandoned"):
+                logger.warning("tier %s request exceeded %.0fs — abandoning "
+                               "the device call and reporting failure",
+                               self.name, timeout)
+                return {"error": f"Request failed: {self.name} timed out "
+                                 f"after {timeout:.0f}s"}
         return box.get("out", {"error": "Request failed: worker died"})
 
-    def _process_body(self, history: History) -> Dict[str, Any]:
+    def _engine_concurrent_safe(self) -> bool:
+        """Best-effort concurrent_safe probe: abandoned workers only
+        serialize engines that assume serialized callers."""
+        try:
+            if self.server_manager.is_server_running():
+                return getattr(self.server_manager.engine(),
+                               "concurrent_safe", False)
+        except Exception:
+            pass
+        return False
+
+    def _process_body(self, history: History
+                      ) -> Tuple[Dict[str, Any], Optional[GenerationResult]]:
+        """Returns (response dict, result or None).  The CALLER owns the
+        last_result update — on the timeout path it must be atomic with
+        the abandon decision, so it cannot live here."""
         try:
             if not self.server_manager.is_server_running():
                 logger.info("No running %s engine found, starting...", self.name)
@@ -101,10 +150,15 @@ class TierClient:
                 with self._engine_lock:
                     result = engine.generate(history)
         except Exception as exc:   # engine failure → reference error shape
-            return {"error": f"Request failed: {exc}"}
+            return {"error": f"Request failed: {exc}"}, None
 
-        self.last_result = result
-        return {"response": result.text}
+        if result is None:
+            # A stopped/abandoned request can complete with neither a
+            # result nor an error (engine shut down mid-flight) — report
+            # the reference error shape instead of crashing the worker.
+            return {"error": f"Request failed: {self.name} engine "
+                             f"returned no result"}, None
+        return {"response": result.text}, result
 
     def process_stream(self, history: History):
         """Streaming twin of ``process``: returns a primed stream handle,
@@ -115,14 +169,19 @@ class TierClient:
         so priming is what makes setup-time failover able to catch real
         engine failures, not just injected ones.
 
-        No request timeout here (unlike ``process``): a stream is
+        No per-token timeout here (unlike ``process``): a stream is
         consumed incrementally by the caller, so there is no single
         bounded wait to cap — a wedged chip stalls the SSE consumer,
         which owns its own disconnect policy.  Sequential engines DO
         take the tier lock for the stream's whole life (released on
         exhaustion, close, or GC): a timeout-abandoned sync worker must
         not interleave with a stream on an engine that assumes
-        serialized callers."""
+        serialized callers.  The lock ACQUIRE is bounded by
+        ``request_timeout_s`` though: if an abandoned worker (wedged
+        chip) or a stalled live stream holds it, this returns the
+        reference error shape so Router stream failover and the perf
+        failure penalty fire instead of the serving thread hanging
+        forever before priming."""
         if self.faults is not None:
             fault = self.faults.intercept(self.name)
             if fault is not None:
@@ -137,7 +196,16 @@ class TierClient:
                                  "token streaming"}
             if getattr(engine, "concurrent_safe", False):
                 return _PrimedStream(engine.generate_stream(history))
-            self._engine_lock.acquire()
+            timeout = self.tier.request_timeout_s
+            acquired = (self._engine_lock.acquire(timeout=timeout)
+                        if timeout is not None
+                        else self._engine_lock.acquire())
+            if not acquired:
+                logger.warning("tier %s stream setup could not take the "
+                               "engine lock within %.0fs — failing over",
+                               self.name, timeout)
+                return {"error": f"Request failed: {self.name} engine busy "
+                                 f"after {timeout:.0f}s"}
             try:
                 return _PrimedStream(engine.generate_stream(history),
                                      release=self._engine_lock.release)
