@@ -563,6 +563,17 @@ def run(progress: "Progress" = None) -> dict:
     for strategy in STRATEGIES:
         import sys
         print(f"[bench] strategy {strategy}", file=sys.stderr, flush=True)
+        if strategy == "perf":
+            # The perf leg runs with PRODUCTION exploration semantics
+            # through the config path (PARITY.md documents the
+            # divergence; per_strategy records it as "explore"): without
+            # probes, both passes are all-nano by construction and
+            # warming cannot change anything.
+            from distributed_llm_tpu.config import PRODUCTION_CFG
+            router.query_router.config["perf_explore"] = \
+                bool(PRODUCTION_CFG.get("perf_explore", False))
+            router.query_router.config["perf_explore_interval"] = int(
+                PRODUCTION_CFG.get("perf_explore_interval", 16))
         router.query_router.change_strategy(strategy)
         cold_correct = None
         if strategy == "perf":
@@ -583,7 +594,7 @@ def run(progress: "Progress" = None) -> dict:
                 if dev == item["expected_device"]:
                     cold_correct += 1
         history = []
-        s_lat, s_ttft, s_correct = [], [], 0
+        s_lat, s_ttft, s_correct, s_orin = [], [], 0, 0
         t_strat = time.perf_counter()
         for item in queries:
             history.append({"role": "user", "content": item["query"]})
@@ -601,6 +612,8 @@ def run(progress: "Progress" = None) -> dict:
             s_lat.append(dt * 1000.0)
             if device == item["expected_device"]:
                 s_correct += 1
+            if device == "orin":
+                s_orin += 1
         elapsed = time.perf_counter() - t_strat
         total_s += elapsed
         n_queries += len(queries)
@@ -611,12 +624,15 @@ def run(progress: "Progress" = None) -> dict:
             "req_per_s": round(len(queries) / elapsed, 4),
             "p50_ttft_ms": round(statistics.median(s_ttft), 2) if s_ttft else None,
             "routing_accuracy": round(s_correct / len(queries), 3),
+            "orin_queries": s_orin,
         }
         if cold_correct is not None:
             per_strategy[strategy]["cold_start_accuracy"] = round(
                 cold_correct / len(queries), 3)
             per_strategy[strategy]["warmed_accuracy"] = \
                 per_strategy[strategy]["routing_accuracy"]
+            per_strategy[strategy]["explore"] = bool(
+                getattr(router.query_router.router, "explore", False))
         progress.section("per_strategy", dict(per_strategy))
 
     # Per-tier phase attribution (tokenize/prefill/decode/detok), roofline

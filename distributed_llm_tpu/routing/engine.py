@@ -105,13 +105,14 @@ class QueryRouter:
                         else f"low prediction confidence={hit.predicted_confidence:.2f}"
                     )
                     decision = self.router.route(query, context)
-                    self._cache.insert(
-                        query, ctx_key,
-                        device=decision.device,
-                        confidence=decision.confidence,
-                        method=decision.method,
-                        q_emb=q_emb,
-                    )
+                    if not decision.transient:
+                        self._cache.insert(
+                            query, ctx_key,
+                            device=decision.device,
+                            confidence=decision.confidence,
+                            method=decision.method,
+                            q_emb=q_emb,
+                        )
                     decision.reasoning = (
                         f"cache hit (hybrid re-route: {reason}) | " + decision.reasoning)
                     decision.cache_hit = True
@@ -134,7 +135,9 @@ class QueryRouter:
 
         decision = self.router.route(query, context)
 
-        if self.cache_enabled:
+        # Transient decisions (perf exploration probes) never seed the
+        # cache — see RoutingDecision.transient.
+        if self.cache_enabled and not decision.transient:
             self._cache.insert(
                 query, ctx_key,
                 device=decision.device,

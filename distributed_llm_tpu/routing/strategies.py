@@ -372,10 +372,19 @@ class PerfStrategy(BaseStrategy):
             "nano": deque(maxlen=self.window),
             "orin": deque(maxlen=self.window),
         }
+        # Production-only exploration (PRODUCTION_CFG sets perf_explore;
+        # benchmark mode keeps the reference's never-explore scoring —
+        # see config.py for the rationale and PARITY.md for the
+        # documented divergence).
+        self.explore = bool(config.get("perf_explore", False))
+        self.explore_interval = int(config.get("perf_explore_interval", 16))
+        self._route_count = 0
+        self._last_seen: Dict[str, int] = {}
 
     def update(self, device: str, latency_ms: float, tokens: int, ok: bool = True) -> None:
         if device in self.samples:
             self.samples[device].append((float(latency_ms), int(tokens), bool(ok)))
+            self._last_seen[device] = self._route_count
 
     def merge_remote(self, device: str,
                      remote: List[Tuple[float, int, bool]]) -> None:
@@ -394,7 +403,40 @@ class PerfStrategy(BaseStrategy):
             return total_lat / len(data) + self.fail_penalty * fail_rate
         return total_lat / total_tok + self.fail_penalty * fail_rate
 
+    def _explore_probe(self) -> Optional[RoutingDecision]:
+        """Deterministic staleness probe: route to the tier with no fresh
+        sample within the last explore_interval routed queries (a
+        never-seen tier is infinitely stale) so the rolling scores stay
+        live.  Marking ``_last_seen`` at probe time — not at sample
+        arrival — bounds probing to one per staleness window even while
+        the probe's own sample is still in flight (a 180 s in-flight
+        call must not attract every concurrent request)."""
+        if not self.explore:
+            return None
+        self._route_count += 1
+        floor = -10 ** 9
+        staleness = {d: self._route_count - self._last_seen.get(d, floor)
+                     for d in self.samples}
+        stale = [d for d, age in staleness.items()
+                 if age >= self.explore_interval]
+        if not stale:
+            return None
+        device = max(stale, key=staleness.get)
+        self._last_seen[device] = self._route_count
+        return RoutingDecision(
+            device=device,
+            confidence=0.30,
+            method="perf",
+            reasoning=f"exploration probe: no fresh perf sample for "
+                      f"{device} in the last {self.explore_interval} "
+                      f"queries",
+            transient=True,
+        )
+
     def route(self, query: str, context: Optional[str] = None) -> RoutingDecision:
+        probe = self._explore_probe()
+        if probe is not None:
+            return probe
         nano_s, orin_s = self._score("nano"), self._score("orin")
         if nano_s == float("inf") and orin_s == float("inf"):
             return RoutingDecision("nano", 0.2, "perf",

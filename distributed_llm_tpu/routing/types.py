@@ -22,3 +22,9 @@ class RoutingDecision:
     reasoning: str
     complexity_score: Optional[float] = None
     cache_hit: bool = False
+    # Transient decisions (e.g. perf exploration probes) must not seed
+    # the predictive routing cache: a lone cached probe record would
+    # normalize to vote_share 1.0 and pin similar queries to an
+    # arbitrarily-probed tier for a whole TTL (routing/engine.py skips
+    # the insert).
+    transient: bool = False

@@ -133,3 +133,21 @@ def test_smoke_flow_mirrors_reference_demo():
     assert all(d.cache_hit for d in second)
     stats = qr.get_cache_stats()
     assert stats["size"] == 3 and stats["hits"] >= 3
+
+
+def test_perf_probe_decisions_never_seed_cache():
+    """An exploration probe (transient decision) must not be inserted
+    into the predictive cache: a lone cached probe record would
+    normalize to vote_share 1.0 and pin similar queries to the probed
+    tier for a whole TTL."""
+    qr = QueryRouter("perf", prod_cfg(perf_explore=True,
+                                      perf_explore_interval=4))
+    d = qr.route_query("what's the weather like", context_key="probe-test")
+    assert d.transient and "probe" in d.reasoning
+    assert qr.get_cache_stats()["size"] == 0
+    # A non-transient decision (both tiers fresh) IS cached.
+    qr.router.update("nano", 100, 100, True)
+    qr.router.update("orin", 50, 100, True)
+    d2 = qr.route_query("what's the weather like", context_key="probe-test")
+    assert not d2.transient
+    assert qr.get_cache_stats()["size"] == 1

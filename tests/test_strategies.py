@@ -214,3 +214,48 @@ def test_perf_zero_tokens_uses_mean_latency():
     p = PerfStrategy(CFG)
     p.update("nano", latency_ms=500, tokens=0, ok=True)
     assert p._score("nano") == pytest.approx(500.0)
+
+
+# -- perf exploration (production-only divergence, PARITY.md) ---------------
+
+def test_perf_never_explores_without_config_key():
+    """Benchmark config (no perf_explore) keeps the reference's exact
+    never-explore semantics: a tier with no samples scores +inf and is
+    never probed (query_router_engine.py:449-451)."""
+    p = PerfStrategy(CFG)
+    p.update("nano", 100, 10, True)
+    for _ in range(100):
+        assert p.route("q").device == "nano"
+
+
+def test_perf_explore_probes_idle_tier():
+    """With perf_explore on, both tiers get probed up front, and the
+    un-picked tier is re-probed once per staleness window — so warming
+    can actually change perf decisions."""
+    p = PerfStrategy({**CFG, "perf_explore": True,
+                      "perf_explore_interval": 8})
+    first, second = p.route("q"), p.route("q")
+    assert {first.device, second.device} == {"nano", "orin"}
+    assert first.confidence == 0.30 and "probe" in first.reasoning
+    # Samples come back: nano fast, orin slow -> steady state nano...
+    p.update("nano", 100, 100, True)
+    p.update("orin", 5000, 10, True)
+    devices = [p.route("q").device for _ in range(20)]
+    # ...but orin still gets staleness probes (>0 orin routes), bounded
+    # to about one per interval.
+    assert devices.count("orin") >= 1
+    assert devices.count("orin") <= 4
+    assert devices.count("nano") > devices.count("orin")
+
+
+def test_perf_explore_keeps_fresh_tiers_unprobed():
+    """A tier with fresh samples is never probed: exploration only fires
+    on missing/stale sample windows."""
+    p = PerfStrategy({**CFG, "perf_explore": True,
+                      "perf_explore_interval": 8})
+    for _ in range(20):
+        p.update("nano", 100, 100, True)
+        p.update("orin", 50, 100, True)
+        d = p.route("q")
+        assert "probe" not in d.reasoning
+        assert d.device == "orin"          # genuinely better score wins
