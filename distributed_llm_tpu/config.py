@@ -73,6 +73,17 @@ PRODUCTION_CFG: Dict[str, Any] = {
     "enable_prefix_affinity": True,
     "prefix_affinity_min_confidence": 0.75,
     "prefix_affinity_min_tokens": 32,
+    # Perf-strategy exploration (beyond-reference, production only): the
+    # reference's perf router never probes a tier it has no samples for
+    # (src/query_router_engine.py:449-451 scores an empty history as
+    # +inf), so the idle tier stays idle forever and warming can never
+    # change its decisions.  In production we deterministically probe a
+    # tier whose samples are missing or stale (no sample in the last
+    # perf_explore_interval routed queries) so both score terms stay
+    # live.  Absent from BENCHMARK_CFG: benchmarks keep the reference's
+    # exact never-explore semantics (PARITY.md).
+    "perf_explore": True,
+    "perf_explore_interval": 16,
 }
 
 
@@ -250,6 +261,15 @@ class TierConfig:
     # device endpoints, src/models/nano.py:4-8).  When set, no local
     # engine/submesh is built for this tier; requests POST /query there.
     endpoint: Optional[str] = None
+    # Supervisor spawn command for the remote tier (argv tuple): how to
+    # (re)start the process serving ``endpoint`` when its /health stops
+    # answering — the reference's SSH bootstrap
+    # (src/models/server_manager.py:77-105 scripts a login + nohup)
+    # expressed as config.  On a pod this is typically
+    # ("ssh", host, "python", "-m", "distributed_llm_tpu.serving.tpu_api",
+    # ...); in tests a local python argv.  None keeps r3 semantics:
+    # readiness polling only, lifecycle owned by an external supervisor.
+    spawn_cmd: Optional[Tuple[str, ...]] = None
     # Per-request wall-clock cap, mirroring the reference clients' HTTP
     # read timeout (requests.post(..., timeout=(5, 180)),
     # src/models/nano.py:28): a device call that exceeds it returns the

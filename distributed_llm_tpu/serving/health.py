@@ -84,6 +84,17 @@ class HealthMonitor:
         except Exception as exc:
             return "failed", {"ok": False, "error": str(exc)}
         if not running:
+            # A DEAD remote is classified failed above (health() raises
+            # into the except).  This branch covers the remote that still
+            # ANSWERS /health but reports not-ok: a remote-lifecycle tier
+            # has no deliberate local stop, so once seen running that
+            # also means failure (restart may respawn via spawn_cmd).
+            # Local tiers keep the stopped/failed distinction (a lazily-
+            # stopped engine between benchmark configs must not be
+            # restarted).
+            if (getattr(mgr, "remote_lifecycle", False)
+                    and self._seen_running.get(name)):
+                return "failed", {**health, "ok": False}
             return "stopped", health
         # Running but unhealthy (e.g. a batching engine whose scheduler
         # thread died) counts as failed.

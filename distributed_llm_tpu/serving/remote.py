@@ -14,12 +14,15 @@ Divergences from the reference client, documented:
 - Both tiers get (connect, read) timeouts.  The reference's Orin client has
   NO timeout (src/models/orin.py:26, SURVEY.md §7 quirk list) — an
   asymmetric bug we fix rather than reproduce.
-- ``RemoteServerManager.start_server`` cannot SSH-bootstrap the remote
-  process (the reference scripts a login + nohup, server_manager.py:77-105;
-  a TPU pod host runs its tier server under its own supervisor).  It keeps
-  the same *readiness* semantics instead: poll ``GET /health`` 15×1 s
-  (reference server_manager.py:122-134) and raise if the server never
-  comes up.  ``stop_server`` is a local no-op for the same reason.
+- ``RemoteServerManager.start_server`` bootstraps the remote process when
+  the tier config carries a ``spawn_cmd`` — the reference's SSH script
+  (a login + nohup, server_manager.py:77-105) expressed as an argv the
+  deployment chooses (``ssh host python -m ...`` on a pod, a plain local
+  argv in tests/single-host).  It then keeps the same *readiness*
+  semantics: poll ``GET /health`` 15×1 s (reference
+  server_manager.py:122-134) and raise if the server never comes up.
+  Without a spawn_cmd, lifecycle stays with the remote host's supervisor
+  (readiness polling only) and ``stop_server`` is a no-op.
 - ``process`` opts into the ``stats`` extension of ``/query`` so the
   router's perf strategy and TTFT accounting keep working across hosts
   (the reference measures latency host-side only).
@@ -37,7 +40,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Any, Dict, List, Optional, Union
+from typing import Any, Dict, List, Optional, Sequence, Union
 
 from ..engine.inference import GenerationResult
 from ..utils.faults import FaultInjector
@@ -48,6 +51,9 @@ History = Union[str, List[Dict[str, Any]]]
 
 HEALTH_POLL_ATTEMPTS = 15          # reference: 15×1 s (server_manager.py:128)
 HEALTH_POLL_INTERVAL_S = 1.0
+SPAWN_READY_ATTEMPTS = 120         # spawned child: jax import + engine build
+SPAWN_GRACE_S = 180.0              # live child younger than this is starting,
+                                   # not wedged — never kill it mid-load
 CONNECT_TIMEOUT_S = 5.0            # reference nano.py:28 (5, 180)
 READ_TIMEOUT_S = 180.0
 
@@ -71,14 +77,35 @@ def _http_json(url: str, payload: Optional[Dict[str, Any]] = None,
 class RemoteServerManager:
     """ServerManager surface over a tier server on another host.
 
-    Lifecycle of the remote process belongs to that host's supervisor; this
-    manager owns *readiness*: ``start_server`` blocks until ``/health``
-    answers (or raises), ``is_server_running`` probes it once."""
+    With a ``spawn_cmd`` this manager owns the remote lifecycle the way
+    the reference's ServerManager does over SSH (server_manager.py:77-105):
+    ``start_server`` launches the argv when /health is dead, then polls
+    readiness; ``stop_server`` terminates a process it spawned.  Without
+    one, lifecycle belongs to the remote host's supervisor and this
+    manager owns *readiness* only."""
+
+    # Health-monitor contract: a tier served by this manager that was seen
+    # running and later stops answering /health has DIED (there is no
+    # deliberate local stop for a remote process) — the monitor treats
+    # "stopped" as failed and revives it (serving/health.py).
+    remote_lifecycle = True
 
     def __init__(self, base_url: str,
-                 connect_timeout: float = CONNECT_TIMEOUT_S):
+                 connect_timeout: float = CONNECT_TIMEOUT_S,
+                 spawn_cmd: Optional[Sequence[str]] = None,
+                 spawn_ready_attempts: int = SPAWN_READY_ATTEMPTS,
+                 spawn_grace_s: float = SPAWN_GRACE_S):
         self.base_url = base_url.rstrip("/")
         self.connect_timeout = connect_timeout
+        self.spawn_cmd = tuple(spawn_cmd) if spawn_cmd else None
+        # A process we just spawned gets a longer readiness budget than
+        # the reference's 15 s (a tier server imports jax and builds an
+        # engine), and a live child is only put down as wedged once its
+        # unhealthy age exceeds spawn_grace_s — never mid-startup.
+        self.spawn_ready_attempts = spawn_ready_attempts
+        self.spawn_grace_s = spawn_grace_s
+        self._proc: Optional["subprocess.Popen"] = None
+        self._spawned_at: Optional[float] = None
 
     def is_server_running(self) -> bool:
         try:
@@ -90,23 +117,68 @@ class RemoteServerManager:
         return _http_json(f"{self.base_url}/health",
                           timeout=self.connect_timeout)
 
+    def _spawn(self) -> None:
+        """Launch the supervisor argv, detached (the reference's
+        ``nohup ... &`` over SSH): no inherited stdio, own session, so a
+        router restart never takes the tier server down with it."""
+        import subprocess
+        logger.info("spawning remote tier server: %s",
+                    " ".join(self.spawn_cmd))
+        self._proc = subprocess.Popen(
+            list(self.spawn_cmd),
+            stdin=subprocess.DEVNULL,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            start_new_session=True)
+        self._spawned_at = time.monotonic()
+
     def start_server(self, beat=None) -> None:
-        """Wait for the remote tier to be ready (reference readiness
-        protocol: /health poll 15×1 s, server_manager.py:122-134).
-        ``beat`` is accepted for EngineManager signature parity (callers
-        feed a liveness watchdog); the wait loop is already bounded."""
-        for attempt in range(HEALTH_POLL_ATTEMPTS):
+        """Revive the remote tier if needed, then wait for readiness
+        (reference protocol: spawn over SSH then /health poll 15×1 s,
+        server_manager.py:77-134; a freshly-spawned child gets the
+        longer spawn_ready_attempts budget).  ``beat`` feeds a caller's
+        liveness watchdog through the wait."""
+        attempts = HEALTH_POLL_ATTEMPTS
+        if self.spawn_cmd and not self.is_server_running():
+            child_alive = self._proc is not None and self._proc.poll() is None
+            if not child_alive:
+                self._spawn()              # never spawned, or died with host
+            elif (self._spawned_at is not None
+                  and time.monotonic() - self._spawned_at > self.spawn_grace_s):
+                # A live child unhealthy past the startup grace has
+                # wedged (a still-loading server would have answered by
+                # now): put it down and respawn.  Inside the grace, keep
+                # polling — killing a mid-startup child would loop
+                # kill/respawn forever and the tier could never revive.
+                self._proc.terminate()
+                try:
+                    self._proc.wait(timeout=5)
+                except Exception:
+                    self._proc.kill()
+                self._spawn()
+            attempts = max(attempts, self.spawn_ready_attempts)
+        for attempt in range(attempts):
             if self.is_server_running():
                 return
-            if attempt < HEALTH_POLL_ATTEMPTS - 1:
+            if beat is not None:
+                beat()
+            if attempt < attempts - 1:
                 time.sleep(HEALTH_POLL_INTERVAL_S)
         raise TimeoutError(
             f"remote tier at {self.base_url} not healthy after "
-            f"{HEALTH_POLL_ATTEMPTS} attempts")
+            f"{attempts} attempts")
 
     def stop_server(self) -> None:
-        """No-op: the remote host supervises its own process (see module
-        docstring)."""
+        """Terminate a process WE spawned; no-op otherwise (the remote
+        host supervises its own process, see module docstring)."""
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=10)
+            except Exception:
+                self._proc.kill()
+        self._proc = None
+        self._spawned_at = None
 
 
 class RemoteTierClient:
@@ -115,12 +187,14 @@ class RemoteTierClient:
 
     def __init__(self, name: str, base_url: str,
                  fault_injector: Optional[FaultInjector] = None,
-                 read_timeout: float = READ_TIMEOUT_S):
+                 read_timeout: float = READ_TIMEOUT_S,
+                 spawn_cmd: Optional[Sequence[str]] = None):
         self.name = name
         self.tier = None                   # no local TierConfig — remote
         self.base_url = base_url.rstrip("/")
         self.read_timeout = read_timeout
-        self.server_manager = RemoteServerManager(self.base_url)
+        self.server_manager = RemoteServerManager(self.base_url,
+                                                  spawn_cmd=spawn_cmd)
         self.faults = fault_injector
         self.last_result: Optional[GenerationResult] = None
 

@@ -279,7 +279,8 @@ def build_tiers(
         if tier.endpoint:
             from .remote import RemoteTierClient
             tiers[tier.name] = RemoteTierClient(
-                tier.name, tier.endpoint, fault_injector=fault_injector)
+                tier.name, tier.endpoint, fault_injector=fault_injector,
+                spawn_cmd=tier.spawn_cmd)
             continue
         mesh = meshes[tier.name]
         # A 1-device mesh adds partitioning overhead for no benefit: pin to

@@ -168,3 +168,73 @@ def test_health_monitor_survives_dead_remote_tier():
         mon.stop()
         for tier in router.tiers.values():
             tier.server_manager.stop_server()
+
+
+def test_remote_revival_dead_to_serving(tmp_path):
+    """The supervisor contract end to end (VERDICT r3 #9): a spawn_cmd-
+    equipped RemoteServerManager starts the tier server process, the
+    process is killed out from under it (remote host crash), the health
+    monitor counts the dead /health as failures and auto-restart
+    respawns it — dead-remote → restarted → serving.
+    Reference: server_manager.py:77-105 (SSH bootstrap + nohup)."""
+    import socket
+    import sys
+    import time
+    import types
+
+    from distributed_llm_tpu.serving.health import HealthMonitor
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    script = tmp_path / "tier_server.py"
+    repo_root = str(__import__("pathlib").Path(__file__).resolve().parents[1])
+    script.write_text(f"""
+import sys
+sys.path.insert(0, {repo_root!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from wsgiref.simple_server import make_server
+from distributed_llm_tpu.config import TierConfig
+from distributed_llm_tpu.engine.manager import EngineManager
+from distributed_llm_tpu.serving.tpu_api import create_tier_app
+tier = TierConfig(name="nano", model_preset="nano_test", max_new_tokens=8,
+                  prefill_buckets=(16, 32, 64), kv_block_size=16)
+mgr = EngineManager(tier, warmup_on_start=False)
+app = create_tier_app("nano", manager=mgr)
+make_server("127.0.0.1", {port}, app).serve_forever()
+""")
+    spawn_cmd = (sys.executable, str(script))
+    client = RemoteTierClient("nano", f"http://127.0.0.1:{port}",
+                              spawn_cmd=spawn_cmd)
+    mgr = client.server_manager
+    try:
+        assert not mgr.is_server_running()
+        mgr.start_server()                       # spawns + readiness-polls
+        assert mgr.is_server_running()
+        out = client.process([{"role": "user", "content": "hello"}])
+        assert "response" in out
+
+        fake_router = types.SimpleNamespace(tiers={"nano": client})
+        mon = HealthMonitor(fake_router, interval_s=0.1,
+                            max_consecutive_failures=2, auto_restart=True)
+        mon.probe_once()                         # marks seen-running
+        assert mon.snapshot()["nano"]["state"] == "running"
+
+        mgr._proc.terminate()                    # remote host "crashes"
+        mgr._proc.wait(timeout=10)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            snap = mon.probe_once()
+            if snap["nano"]["state"] == "running" and \
+                    snap["nano"]["restarts"] >= 1:
+                break
+            time.sleep(0.1)
+        snap = mon.snapshot()
+        assert snap["nano"]["restarts"] >= 1, snap
+        assert mgr.is_server_running()
+        out = client.process([{"role": "user", "content": "back again?"}])
+        assert "response" in out
+    finally:
+        mgr.stop_server()
