@@ -112,6 +112,7 @@ def compact(result: dict) -> dict:
             "prefix_hits"),
         "orin_followup_ttft_speedup": (result.get("orin_prefix") or {}).get(
             "followup_ttft_speedup"),
+        "tier_quality": (result.get("tier_quality") or {}).get("verdict"),
         "flagship_decode_tok_per_s": {
             t: f.get("decode_tok_per_s")
             for t, f in (result.get("flagship") or {}).items()
@@ -681,6 +682,49 @@ def run(progress: "Progress" = None) -> dict:
     progress.section("utilization", utilization)
     progress.section("tiers", phases)
 
+    # Tier answer-quality asymmetry (VERDICT r3 missing #2): held-out
+    # per-token loss / next-token accuracy per tier over the SAME token
+    # stream (training/evaluate.py), next to measured serving cost per
+    # token — the premise every routing strategy trades on (orin buys
+    # quality, nano buys speed) measured instead of asserted.
+    tier_quality = {}
+    import sys
+    print("[bench] tier quality probe", file=sys.stderr, flush=True)
+    for name, tier in router.tiers.items():
+        # Per-tier failure isolation: one tier (e.g. a remote manager
+        # with no local engine) must not discard the others' numbers.
+        try:
+            from distributed_llm_tpu.training.evaluate import eval_quality
+            eng = tier.server_manager.engine()
+            q = eval_quality(eng.cfg, eng.params, n_batches=2, batch_size=4)
+            progress.beat()
+            t0q = time.perf_counter()
+            res = eng.generate("user: describe the largest river in "
+                               "geography", max_new_tokens=32)
+            dtq = (time.perf_counter() - t0q) * 1000.0
+            q["ms_per_token"] = round(dtq / max(res.gen_tokens, 1), 2)
+            q["params_m"] = round(eng.cfg.param_count() / 1e6, 1)
+            tier_quality[name] = q
+            progress.beat()
+        except Exception as exc:          # never lose the headline run
+            tier_quality[name] = {"error": str(exc)[:200]}
+    try:
+        if all(isinstance(tier_quality.get(t), dict)
+               and "eval_loss" in tier_quality[t] for t in ("nano", "orin")):
+            tier_quality["verdict"] = {
+                # >0 iff orin's held-out loss beats nano's.
+                "orin_quality_advantage": round(
+                    tier_quality["nano"]["eval_loss"]
+                    - tier_quality["orin"]["eval_loss"], 4),
+                # >1 iff orin costs more per generated token.
+                "orin_cost_ratio": round(
+                    tier_quality["orin"]["ms_per_token"]
+                    / max(tier_quality["nano"]["ms_per_token"], 1e-9), 2),
+            }
+    except Exception as exc:
+        tier_quality["verdict"] = {"error": str(exc)[:200]}
+    progress.section("tier_quality", tier_quality)
+
     # Long-context probe: a near-max_seq_len prompt through the orin tier -
     # cold long-prompt prefill TTFT, then a follow-up turn whose prefill
     # rides session KV prefix reuse (O(delta)).  The margin keeps the
@@ -845,6 +889,7 @@ def run(progress: "Progress" = None) -> dict:
         "flagship": flagship,
         "hw_dispatch": hw_dispatch,
         "tiers": phases,
+        "tier_quality": tier_quality,
     }
 
 

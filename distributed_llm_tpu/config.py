@@ -265,10 +265,15 @@ class TierConfig:
     # (re)start the process serving ``endpoint`` when its /health stops
     # answering — the reference's SSH bootstrap
     # (src/models/server_manager.py:77-105 scripts a login + nohup)
-    # expressed as config.  On a pod this is typically
-    # ("ssh", host, "python", "-m", "distributed_llm_tpu.serving.tpu_api",
-    # ...); in tests a local python argv.  None keeps r3 semantics:
-    # readiness polling only, lifecycle owned by an external supervisor.
+    # expressed as config.  CONTRACT: the command must REPLACE any
+    # existing remote instance (kill-then-start, like the reference's
+    # script) — the local manager can only terminate the local process
+    # it launched, so across SSH a bare start command would lose the
+    # port to a wedged predecessor.  E.g. ("ssh", host, "pkill -f
+    # tpu_api; nohup python -m distributed_llm_tpu.serving.tpu_api
+    # --tier orin &"); in tests a local python argv.  None keeps r3
+    # semantics: readiness polling only, lifecycle owned by an external
+    # supervisor.
     spawn_cmd: Optional[Tuple[str, ...]] = None
     # Per-request wall-clock cap, mirroring the reference clients' HTTP
     # read timeout (requests.post(..., timeout=(5, 180)),

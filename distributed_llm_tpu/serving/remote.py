@@ -82,7 +82,14 @@ class RemoteServerManager:
     ``start_server`` launches the argv when /health is dead, then polls
     readiness; ``stop_server`` terminates a process it spawned.  Without
     one, lifecycle belongs to the remote host's supervisor and this
-    manager owns *readiness* only."""
+    manager owns *readiness* only.
+
+    ``spawn_cmd`` contract (see TierConfig.spawn_cmd): the command must
+    REPLACE any existing remote instance — terminate() here only reaches
+    the LOCAL process (for ``ssh host ...`` that is the ssh client, not
+    the tier server), so a wedged remote can only be put down by the
+    command itself (the reference's script is kill-then-start for the
+    same reason)."""
 
     # Health-monitor contract: a tier served by this manager that was seen
     # running and later stops answering /health has DIED (there is no
@@ -150,11 +157,7 @@ class RemoteServerManager:
                 # now): put it down and respawn.  Inside the grace, keep
                 # polling — killing a mid-startup child would loop
                 # kill/respawn forever and the tier could never revive.
-                self._proc.terminate()
-                try:
-                    self._proc.wait(timeout=5)
-                except Exception:
-                    self._proc.kill()
+                self._put_down(self._proc)
                 self._spawn()
             attempts = max(attempts, self.spawn_ready_attempts)
         for attempt in range(attempts):
@@ -168,15 +171,29 @@ class RemoteServerManager:
             f"remote tier at {self.base_url} not healthy after "
             f"{attempts} attempts")
 
+    @staticmethod
+    def _put_down(proc) -> None:
+        """Terminate → kill → reap.  The final wait matters: a SIGKILL'd
+        child left unreaped is a zombie for the router's lifetime, and a
+        successor spawned before the old child released its listen port
+        would lose the bind race."""
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+            return
+        except Exception:
+            pass
+        proc.kill()
+        try:
+            proc.wait(timeout=5)
+        except Exception:
+            pass
+
     def stop_server(self) -> None:
         """Terminate a process WE spawned; no-op otherwise (the remote
         host supervises its own process, see module docstring)."""
         if self._proc is not None and self._proc.poll() is None:
-            self._proc.terminate()
-            try:
-                self._proc.wait(timeout=10)
-            except Exception:
-                self._proc.kill()
+            self._put_down(self._proc)
         self._proc = None
         self._spawned_at = None
 

@@ -39,16 +39,25 @@ def pretrain(preset: str, out: str, *,
              learning_rate: float = 1e-3,
              seed: int = 0,
              save_every: Optional[int] = None,
+             resume: bool = False,
              log: Callable[[str], None] = print) -> Dict[str, float]:
     """Train ``preset`` until the eval-window mean loss stops improving by
     ``min_delta`` for ``patience`` consecutive windows (or ``max_steps``),
     then checkpoint to ``out``.  ``save_every`` > 0 additionally
     checkpoints mid-run — a preemption leaves a resumable ``latest``.
+    ``resume`` continues from an existing checkpoint at ``out`` (params +
+    optimizer state + step counter); ``max_steps`` counts ADDITIONAL
+    steps.  The resumed run draws from a fresh generator stream offset by
+    the saved step count — disjoint from the original run's batches at
+    ANY (batch_size, seq_len), so changing the batch shape on resume
+    (tpu_round.sh extends the r3 orin checkpoint at a larger batch)
+    neither repeats nor skips training text.
 
     Data parallelism uses every local device that divides the batch
     (single device otherwise); the model families' own sharding rules
     handle anything bigger.
     """
+    import os
     cfg = MODEL_PRESETS[preset]
     seq = seq_len or min(256, cfg.max_seq_len)
     devs = jax.devices()
@@ -58,6 +67,11 @@ def pretrain(preset: str, out: str, *,
                                        learning_rate=learning_rate,
                                        warmup_steps=min(50, max_steps // 4),
                                        seed=seed), mesh)
+    resumed_from = 0
+    if resume and os.path.isdir(out):
+        trainer.load(out)
+        resumed_from = trainer.step_count
+        log(f"[pretrain] resumed {preset} from {out} at step {resumed_from}")
     log(f"[pretrain] {preset}: {cfg.num_layers}L/{cfg.hidden_size}h "
         f"({cfg.param_count()/1e6:.2f}M params) batch={batch_size} "
         f"seq={seq} dp={dp} max_steps={max_steps}")
@@ -68,7 +82,12 @@ def pretrain(preset: str, out: str, *,
     t0 = time.perf_counter()
     final = float("nan")
     from ..engine.tokenizer import get_tokenizer
-    data = batches(batch_size, seq, seed=seed, tokenizer=get_tokenizer(cfg))
+    # A resumed run offsets the generator seed by the saved step count:
+    # batches() derives each batch's rng from (seed << 20) ^ step, so a
+    # different seed yields a disjoint stream regardless of batch shape.
+    data_seed = seed + resumed_from
+    data = batches(batch_size, seq, seed=data_seed,
+                   tokenizer=get_tokenizer(cfg))
     for step, (toks, mask) in enumerate(data, start=1):
         metrics = trainer.train_step(toks, mask)
         window.append(metrics["loss"])
@@ -110,6 +129,9 @@ def main(argv=None) -> None:
     ap.add_argument("--learning-rate", type=float, default=1e-3)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--save-every", type=int, default=None)
+    ap.add_argument("--resume", action="store_true",
+                    help="continue from an existing checkpoint at --out "
+                         "(max-steps counts additional steps)")
     ap.add_argument("--cpu", action="store_true",
                     help="pin jax to host CPU (safe on a wedged-chip box)")
     args = ap.parse_args(argv)
@@ -121,7 +143,7 @@ def main(argv=None) -> None:
              seq_len=args.seq_len, max_steps=args.max_steps,
              eval_every=args.eval_every, patience=args.patience,
              min_delta=args.min_delta, learning_rate=args.learning_rate,
-             seed=args.seed, save_every=args.save_every)
+             seed=args.seed, save_every=args.save_every, resume=args.resume)
 
 
 if __name__ == "__main__":

@@ -1,5 +1,5 @@
 #!/bin/bash
-# Full TPU measurement sequence for a freshly healthy chip (round 3).
+# Full TPU measurement sequence for a freshly healthy chip (round 4).
 # Run exactly ONE instance.  Every chip-claiming step is timeout-wrapped
 # and health-gated: the r3 chip wedged mid-A/B and an unwrapped step
 # hangs forever (the claimant sleeps in the claim/response path).  A
@@ -72,12 +72,65 @@ PY
     probe_until_healthy || { echo "chip wedged — aborting"; exit 1; }
   fi
   if [ ! -L checkpoints/orin_bench/latest ]; then
-    timeout 2700 python -m distributed_llm_tpu.training.pretrain \
-      --preset orin_bench --out checkpoints/orin_bench --batch-size 4 \
-      --seq-len 256 --max-steps 500 --save-every 100 \
+    timeout 3600 python -m distributed_llm_tpu.training.pretrain \
+      --preset orin_bench --out checkpoints/orin_bench --batch-size 8 \
+      --seq-len 256 --max-steps 1200 --save-every 100 \
       || echo "orin_bench pretrain failed/timed out ($?)"
     probe_until_healthy || { echo "chip wedged — aborting"; exit 1; }
   fi
+
+  # 1b. Tier-quality gate (VERDICT r3 missing #2): the routing premise
+  #     needs orin to BEAT nano on held-out loss.  The r3 orin run saw
+  #     ~7x fewer tokens than nano (batch 4 x 475 steps vs 16 x 800) and
+  #     evaluated WORSE; extend its training (resume: params + optimizer
+  #     + data position) until the asymmetry holds or the budget is
+  #     spent, then log both tiers' held-out numbers for the artifact.
+  quality_gap() {
+    # Exit 0: gate met.  Exit 1: gate honestly not met.  Exit 2: the
+    # EVALUATION itself broke (unloadable checkpoint, crash) — training
+    # longer cannot fix that, so the caller must not burn extensions.
+    python - <<'PY'
+import json, subprocess, sys
+out = {}
+for preset in ("nano_bench", "orin_bench"):
+    r = subprocess.run(
+        [sys.executable, "-m", "distributed_llm_tpu.training.evaluate",
+         "--preset", preset, "--checkpoint", f"checkpoints/{preset}"],
+        capture_output=True, text=True, timeout=1200)
+    try:
+        out[preset] = json.loads(r.stdout.strip().splitlines()[-1])
+    except (IndexError, ValueError):
+        print(json.dumps({"error": f"evaluate {preset} failed (rc={r.returncode})",
+                          "stderr": r.stderr[-500:]}))
+        sys.exit(2)
+gap = out["nano_bench"]["eval_loss"] - out["orin_bench"]["eval_loss"]
+print(json.dumps({"gap": round(gap, 4), **out}))
+sys.exit(0 if gap > 0.02 else 1)
+PY
+  }
+  # Up to 2 training extensions; the gate re-runs AFTER the last one so
+  # /tmp/tier_quality_gap.json always describes the shipped checkpoint.
+  for pass_n in 1 2 3; do
+    quality_gap > /tmp/tier_quality_gap.json 2>&1
+    gate_rc=$?
+    if [ $gate_rc -eq 0 ]; then
+      echo "tier quality gate: orin beats nano ($(cat /tmp/tier_quality_gap.json))"
+      break
+    elif [ $gate_rc -eq 2 ]; then
+      echo "tier quality EVALUATION broke — skipping extensions ($(cat /tmp/tier_quality_gap.json))"
+      break
+    elif [ $pass_n -ge 3 ]; then
+      echo "tier quality gate NOT met after 2 extensions ($(cat /tmp/tier_quality_gap.json))"
+      break
+    fi
+    echo "tier quality gate NOT met ($(cat /tmp/tier_quality_gap.json)) — extending orin_bench (pass $pass_n)"
+    timeout 3600 python -m distributed_llm_tpu.training.pretrain \
+      --preset orin_bench --out checkpoints/orin_bench --batch-size 8 \
+      --seq-len 256 --max-steps 800 --save-every 100 --resume \
+      --patience 8 \
+      || echo "orin_bench extension failed/timed out ($?)"
+    probe_until_healthy || { echo "chip wedged — aborting"; exit 1; }
+  done
 
   # 2. Per-kernel micro A/B on quiet hardware, ONE KIND PER PROCESS with
   #    a timeout (VERDICT r2 #4; the r3 chip wedged mid-grid on the

@@ -39,3 +39,57 @@ def test_pretrain_save_every_leaves_resumable_latest(tmp_path):
                  TrainConfig(batch_size=4, seq_len=32), mesh)
     tr.load(str(out))
     assert tr.step_count == 10
+
+
+def test_pretrain_resume_continues_from_checkpoint(tmp_path):
+    """--resume loads params + optimizer + step counter and counts
+    max_steps as ADDITIONAL steps; the data stream skips past the saved
+    position so no batch repeats."""
+    out = tmp_path / "ck"
+    pt.pretrain("nano_test", str(out), batch_size=4, seq_len=32,
+                max_steps=8, eval_every=50, log=lambda *_: None)
+    res = pt.pretrain("nano_test", str(out), batch_size=4, seq_len=32,
+                      max_steps=5, eval_every=50, resume=True,
+                      log=lambda *_: None)
+    assert res["steps"] == 13          # 8 saved + 5 additional
+
+
+def test_heldout_eval_deterministic_and_seed_disjoint(tmp_path):
+    """Same (cfg, params, seed) -> identical numbers; the held-out stream
+    differs from the training stream (seed separation is the train/test
+    split for a generated corpus)."""
+    import numpy as np
+
+    from distributed_llm_tpu.config import MODEL_PRESETS
+    from distributed_llm_tpu.engine.tokenizer import get_tokenizer
+    from distributed_llm_tpu.training import evaluate as ev
+
+    cfg = MODEL_PRESETS["nano_test"]
+    tok = get_tokenizer(cfg)
+    held = next(iter(ev.heldout_batches(2, 64, tok)))[0]
+    train = next(iter(__import__(
+        "distributed_llm_tpu.training.data", fromlist=["batches"]
+    ).batches(2, 64, seed=0, tokenizer=tok)))[0]
+    assert not np.array_equal(held, train)
+
+    from distributed_llm_tpu.utils.checkpoint import load_params_for_tier
+    params = load_params_for_tier("checkpoints/nano_test", cfg)
+    a = ev.eval_quality(cfg, params, n_batches=1, batch_size=2, seq_len=64)
+    b = ev.eval_quality(cfg, params, n_batches=1, batch_size=2, seq_len=64)
+    assert a == b
+    assert 0.0 < a["eval_loss"] < 10.0
+    assert 0.0 <= a["next_token_acc"] <= 1.0
+
+
+def test_tier_quality_asymmetry_on_committed_checkpoints():
+    """The routing premise, measured (VERDICT r3 missing #2): the bigger
+    orin_test checkpoint beats nano_test on held-out per-token loss over
+    the identical token stream."""
+    from distributed_llm_tpu.config import MODEL_PRESETS
+    from distributed_llm_tpu.training.evaluate import eval_checkpoint
+
+    nano = eval_checkpoint("nano_test", "checkpoints/nano_test",
+                           n_batches=2, batch_size=4)
+    orin = eval_checkpoint("orin_test", "checkpoints/orin_test",
+                           n_batches=2, batch_size=4)
+    assert orin["eval_loss"] < nano["eval_loss"], (nano, orin)
