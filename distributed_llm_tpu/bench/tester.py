@@ -411,6 +411,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    choices=["off", "on"])
     p.add_argument("--output-csv", default="benchmark_results.csv")
     p.add_argument("--output-per-query-csv", default="benchmark_per_query.csv")
+    p.add_argument("--append", action="store_true",
+                   help="Append to existing output CSVs instead of "
+                        "starting fresh (multi-invocation sweeps "
+                        "accumulating one artifact)")
     p.add_argument("--no-telemetry", action="store_true",
                    help="Disable the HBM telemetry sampler")
     p.add_argument("--platform", default=None,
@@ -455,10 +459,13 @@ def main(argv: Optional[List[str]] = None) -> None:
         output_per_query_csv=args.output_per_query_csv,
         telemetry=not args.no_telemetry,
     )
-    # Fresh files each run to avoid header drift across versions.
-    for path in (run_cfg.output_csv, run_cfg.output_per_query_csv):
-        if os.path.exists(path):
-            os.remove(path)
+    # Fresh files each run to avoid header drift across versions;
+    # --append keeps them (ensure_csv_headers only writes headers into
+    # empty/new files, so rows accumulate under one header).
+    if not args.append:
+        for path in (run_cfg.output_csv, run_cfg.output_per_query_csv):
+            if os.path.exists(path):
+                os.remove(path)
     run_experiment(query_items, run_cfg)
 
 

@@ -18,19 +18,37 @@ from typing import Any, Dict, Optional, Tuple
 # Router-level canonical configs (reference parity)
 # =============================================================================
 
-# Single source of truth for the semantic-cache similarity threshold
-# (see the rationale comment at its use in PRODUCTION_CFG below).
-DEFAULT_CACHE_SIMILARITY = 0.40
+# Semantic-cache similarity thresholds, calibrated per embedder (see the
+# rationale comment at their use in PRODUCTION_CFG below).  The hashed
+# value survives for the no-artifact fallback path and the r1-r3 tests.
+DEFAULT_CACHE_SIMILARITY = 0.40        # hashed-ngram scale
+HYBRID_CACHE_SIMILARITY = 0.17         # hybrid lexical⊕semantic scale
+                                       # (α=0.35; held-out calibration:
+                                       # paraphrase hit rate 0.957, false
+                                       # hit 0.040 — encoder_train.py)
 
 # Benchmark: routing cache OFF so accuracy is measured cleanly per query
 # (reference: src/query_router_engine.py:704-719).
 BENCHMARK_CFG: Dict[str, Any] = {
     "token_threshold": 1000,
     "model": "tpu-native-bpe-4k",              # tokenizer identity, see engine/bpe.py
-    "embedding_model": "hashed-ngram-384",     # on-device embedder, see routing/embedder.py
+    # Hybrid lexical⊕semantic embedder (routing/embedder.py
+    # HybridEmbedder: contrastive-trained encoder ⊕ hashed n-grams) —
+    # the in-repo stand-in for the reference's MiniLM (r4; falls back to
+    # the r1-r3 hashed n-grams when no weights artifact exists).
+    # Measured: centroid-routing accuracy 29/32 across all three query
+    # sets (hashed alone 28/32), held-out paraphrase/unrelated
+    # separation 0.963 (encoder alone 0.88, hashed alone 0.92).
+    "embedding_model": "hybrid-lexsem-v1",
     "semantic_label_path": "",                 # resolved lazily to bench/semantic_labels.json
     "semantic_margin_threshold": 0.03,
-    "semantic_min_similarity": 0.05,
+    # Hybrid-scale "irrelevant" floor: trained cosines sit near 0 for
+    # unrelated text and go NEGATIVE for anti-related; only a query below
+    # both centroids by this much falls back to token routing.  (The
+    # hashed scale used +0.05; with the trained component that misrouted
+    # real multi-part questions whose embedding is near-orthogonal to
+    # both centroids.)
+    "semantic_min_similarity": -0.05,
     "heuristic_long_chars": 800,               # ~200 tokens
     "heuristic_multi_qmarks": 2,
     "heuristic_code_markers_needed": 2,
@@ -49,18 +67,19 @@ PRODUCTION_CFG: Dict[str, Any] = {
     "cache_ttl_seconds": 3600,
     "cache_max_size": 500,
     # Reference value is 0.85, tuned to MiniLM embeddings
-    # (src/query_router_engine.py:727).  Our hashed-ngram embedder
-    # (routing/embedder.py) scores paraphrases ~0.4-0.7, same-surface-form
-    # pairs ("capital of Japan"/"capital of France") ~0.4-0.65, and
-    # unrelated pairs ~0.0, so the threshold is recalibrated to keep the
-    # reference's *behavior*: paraphrases hit, unrelated queries miss.
-    # Same-surface false hits are acceptable here because this cache stores
-    # ROUTING predictions, not responses (the response cache keys exactly,
-    # serving/router.py): a false hit can only predict a device, almost
-    # always the right one since surface-similar queries share a complexity
-    # class, and the low-confidence + heavy-context overrides
-    # (routing/engine.py) re-route the residue.
-    "cache_similarity_threshold": DEFAULT_CACHE_SIMILARITY,
+    # (src/query_router_engine.py:727).  The hybrid space scores
+    # held-out paraphrases ≥0.21 at p10 and unrelated pairs ≤0.12 at
+    # p90, so 0.17 keeps the reference's *behavior*: paraphrases hit —
+    # including disjoint-wording ones the r1-r3 hashed embedder missed
+    # (hit rate 0.957) — and unrelated queries miss (false-hit 0.040).
+    # Residual false hits are acceptable because this cache stores
+    # ROUTING predictions, not responses (the response cache keys
+    # exactly, serving/router.py): a false hit can only predict a
+    # device, and the low-confidence + heavy-context overrides
+    # (routing/engine.py) re-route the residue.  (Hashed fallback
+    # sessions re-calibrate to DEFAULT_CACHE_SIMILARITY via
+    # routing/engine.py when no encoder artifact exists.)
+    "cache_similarity_threshold": HYBRID_CACHE_SIMILARITY,
     "use_semantic_cache": True,
     "prediction_confidence_threshold": 0.70,
     "enable_response_cache": True,

@@ -1,7 +1,8 @@
 from .types import RoutingDecision
 from .cache import (CacheEntry, CacheLookupResult, QueryCache, RoutingRecord,
                     PREDICTION_CONFIDENCE_THRESHOLD, RECENCY_DECAY)
-from .embedder import HashedNgramEmbedder, default_embedder
+from .embedder import HashedNgramEmbedder, default_embedder, get_embedder
+from .encoder import TrainedEncoder, encoder_available
 from .engine import QueryRouter
 from .strategies import (AVAILABLE_STRATEGIES, HeuristicStrategy, HybridStrategy,
                          PerfStrategy, SemanticStrategy, TokenStrategy)
@@ -10,7 +11,8 @@ from .token_counter import TokenCounter, approx_token_count
 __all__ = [
     "RoutingDecision", "CacheEntry", "CacheLookupResult", "QueryCache",
     "RoutingRecord", "PREDICTION_CONFIDENCE_THRESHOLD", "RECENCY_DECAY",
-    "HashedNgramEmbedder", "default_embedder", "QueryRouter",
+    "HashedNgramEmbedder", "default_embedder", "get_embedder",
+    "TrainedEncoder", "encoder_available", "QueryRouter",
     "AVAILABLE_STRATEGIES", "HeuristicStrategy", "HybridStrategy",
     "PerfStrategy", "SemanticStrategy", "TokenStrategy",
     "TokenCounter", "approx_token_count",

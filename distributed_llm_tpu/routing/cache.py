@@ -252,6 +252,13 @@ class QueryCache:
         qn = float(np.linalg.norm(q))
         if qn < 1e-9:
             return None
+        # Entries persisted under a different embedding_model (e.g. a
+        # hashed-384 cache file loaded into a trained-encoder-128
+        # session) are incomparable — skip them rather than crash the
+        # stack; they age out by TTL/LRU.
+        snapshot = [(h, emb) for h, emb in snapshot if emb.shape == q.shape]
+        if not snapshot:
+            return None
         mat = np.stack([emb for _, emb in snapshot]).astype(np.float32)
         norms = np.linalg.norm(mat, axis=1)
         safe = norms > 1e-9

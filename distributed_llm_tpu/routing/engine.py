@@ -21,7 +21,7 @@ import numpy as np
 
 from ..config import BENCHMARK_CFG, DEFAULT_CACHE_SIMILARITY
 from .cache import QueryCache
-from .embedder import default_embedder
+from .embedder import get_embedder
 from .strategies import AVAILABLE_STRATEGIES, HybridStrategy, SemanticStrategy
 from .types import RoutingDecision
 
@@ -41,30 +41,49 @@ class QueryRouter:
         self.strategy_name = strategy
         self.cache_enabled = bool(self.config.get("cache_enabled", True))
 
-        self._cache = QueryCache(
-            max_size=int(self.config.get("cache_max_size", 500)),
-            ttl_seconds=int(self.config.get("cache_ttl_seconds", 3600)),
-            similarity_threshold=float(self.config.get("cache_similarity_threshold",
-                                  DEFAULT_CACHE_SIMILARITY)),
-            use_semantic=bool(self.config.get("use_semantic_cache", True)),
-            prediction_confidence_threshold=float(
-                self.config.get("prediction_confidence_threshold", 0.70)),
-        )
-
         # One shared embedder: encodes each query once, reused for the
         # semantic strategy, cache lookup, and cache insert
         # (reference: query_router_engine.py:508-511 uses a second
         # SentenceTransformer instance; we share a singleton instead).
+        # Selected by config "embedding_model" — the trained semantic
+        # encoder when its artifact exists, hashed n-grams otherwise.
         self.cache_embedder = None
         if self.config.get("use_semantic_cache", True):
-            self.cache_embedder = default_embedder()
+            self.cache_embedder = get_embedder(
+                self.config.get("embedding_model"))
+
+        # The cache threshold is calibrated PER EMBEDDER: if the config
+        # asked for the trained/hybrid embedder but the artifact is
+        # missing (hashed fallback in play), the trained-scale threshold
+        # (0.17) would false-hit constantly on hashed scores — swap in
+        # the hashed calibration.  (SemanticStrategy recalibrates its
+        # own "irrelevant" floor the same way at ITS embedder selection,
+        # strategies.py.)
+        sim_threshold = float(self.config.get("cache_similarity_threshold",
+                                              DEFAULT_CACHE_SIMILARITY))
+        from .embedder import HashedNgramEmbedder
+        if (isinstance(self.cache_embedder, HashedNgramEmbedder)
+                and str(self.config.get("embedding_model", "")
+                        ).startswith(("trained-encoder", "hybrid-lexsem"))):
+            sim_threshold = DEFAULT_CACHE_SIMILARITY
+
+        self._cache = QueryCache(
+            max_size=int(self.config.get("cache_max_size", 500)),
+            ttl_seconds=int(self.config.get("cache_ttl_seconds", 3600)),
+            similarity_threshold=sim_threshold,
+            use_semantic=bool(self.config.get("use_semantic_cache", True)),
+            prediction_confidence_threshold=float(
+                self.config.get("prediction_confidence_threshold", 0.70)),
+        )
 
         self.router = self._build_strategy(strategy)
 
     def _build_strategy(self, strategy: str):
         cls = AVAILABLE_STRATEGIES[strategy]
         if cls in (SemanticStrategy, HybridStrategy):
-            return cls(self.config, embedder=self.cache_embedder or default_embedder())
+            return cls(self.config,
+                       embedder=self.cache_embedder or get_embedder(
+                           self.config.get("embedding_model")))
         return cls(self.config)
 
     @property

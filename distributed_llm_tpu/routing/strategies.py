@@ -18,7 +18,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .embedder import HashedNgramEmbedder, cosine, default_embedder
+from .embedder import cosine, get_embedder
 from .token_counter import approx_token_count
 from .types import RoutingDecision
 
@@ -88,11 +88,25 @@ class SemanticStrategy(BaseStrategy):
     the margin is too small ("ambiguous")
     (reference: query_router_engine.py:180-213)."""
 
-    def __init__(self, config: Dict[str, Any], embedder: Optional[HashedNgramEmbedder] = None):
+    def __init__(self, config: Dict[str, Any], embedder=None):
         super().__init__(config)
-        self.embedder = embedder or default_embedder()
+        # Same selection rule as QueryRouter: direct construction with a
+        # config must not silently pair hashed embeddings with
+        # encoder-calibrated thresholds.
+        self.embedder = embedder or get_embedder(config.get("embedding_model"))
         self.margin_threshold = float(config.get("semantic_margin_threshold", 0.03))
         self.min_similarity = float(config.get("semantic_min_similarity", 0.05))
+        # Per-embedder threshold calibration lives WITH the embedder
+        # selection: when the config asked for the trained/hybrid space
+        # but the hashed fallback is in play, the trained-scale
+        # "irrelevant" floor (-0.05) is unreachable on hashed cosines
+        # (they are never that negative) — swap in the hashed default.
+        from .embedder import HashedNgramEmbedder
+        if (isinstance(self.embedder, HashedNgramEmbedder)
+                and str(config.get("embedding_model", "")
+                        ).startswith(("trained-encoder", "hybrid-lexsem"))
+                and self.min_similarity == -0.05):
+            self.min_similarity = 0.05
         self._token_fallback = TokenStrategy(config)
         label_path = config.get("semantic_label_path") or _default_label_path()
         self.nano_centroid, self.orin_centroid = self._build_centroids(label_path)
@@ -315,7 +329,7 @@ class HybridStrategy(BaseStrategy):
     vote margin over the total weighted mass."""
 
     def __init__(self, config: Dict[str, Any],
-                 embedder: Optional[HashedNgramEmbedder] = None):
+                 embedder=None):
         super().__init__(config)
         self.weights = config.get(
             "weights", {"token": 0.35, "semantic": 0.35, "heuristic": 0.30})

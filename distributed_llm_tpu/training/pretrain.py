@@ -68,10 +68,15 @@ def pretrain(preset: str, out: str, *,
                                        warmup_steps=min(50, max_steps // 4),
                                        seed=seed), mesh)
     resumed_from = 0
-    if resume and os.path.isdir(out):
-        trainer.load(out)
-        resumed_from = trainer.step_count
-        log(f"[pretrain] resumed {preset} from {out} at step {resumed_from}")
+    if resume:
+        if os.path.isdir(out):
+            trainer.load(out)
+            resumed_from = trainer.step_count
+            log(f"[pretrain] resumed {preset} from {out} at step "
+                f"{resumed_from}")
+        else:
+            log(f"[pretrain] WARNING: --resume but no checkpoint at "
+                f"{out} — training from scratch")
     log(f"[pretrain] {preset}: {cfg.num_layers}L/{cfg.hidden_size}h "
         f"({cfg.param_count()/1e6:.2f}M params) batch={batch_size} "
         f"seq={seq} dp={dp} max_steps={max_steps}")

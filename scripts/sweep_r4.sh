@@ -1,0 +1,51 @@
+#!/bin/bash
+# Round-4 CPU harness sweep (VERDICT r3 #8) -> bench/results_r4/
+#
+# Two artifact families:
+#  1. The reference's SIGNATURE threshold-sweep experiment
+#     (routing_chatbot_tester.py:352-367): token strategy, thresholds
+#     100->4000, both cache modes — load shifts from orin to nano as the
+#     threshold rises.
+#  2. The full strategy grid over ALL THREE query sets, both cache
+#     modes (cache-on = production config: prefix affinity + trained-
+#     encoder semantic cache live) — the larger prefix-affinity and
+#     accuracy pool the r3 verdict asked for (72 queries/leg vs 24).
+#
+# CPU-safe (tiny_cluster presets); run alongside chip work freely.
+set -u
+cd /root/repo
+out=bench/results_r4
+mkdir -p "$out"
+cd "$out"
+
+run_tester() {
+  # --append: four invocations accumulate ONE artifact pair (the tester
+  # deletes existing CSVs without it).
+  JAX_PLATFORMS=cpu timeout 5400 python -m distributed_llm_tpu.bench.tester \
+    "$@" --append \
+    --output-csv benchmark_results.csv \
+    --output-per-query-csv benchmark_per_query.csv >> tester.log 2>&1 \
+    || echo "tester $* failed/timed out ($?)" >> tester.log
+}
+
+echo "=== sweep_r4 start $(date -u) @ $(git rev-parse --short HEAD) ===" >> tester.log
+rm -f benchmark_results.csv benchmark_per_query.csv
+
+# 1. Threshold sweep (token strategy only — the reference experiment).
+run_tester --query-set general_knowledge --strategies token \
+  --cache-modes off on --thresholds 100 250 500 1000 2000 4000
+
+# 2. Full strategy grid x 3 query sets at the canonical threshold.
+for qs in general_knowledge technical_coding personal_health; do
+  run_tester --query-set "$qs" \
+    --strategies token semantic heuristic hybrid perf \
+    --cache-modes off on --thresholds 1000
+done
+
+JAX_PLATFORMS=cpu python -m distributed_llm_tpu.bench.analysis \
+  --summary-csv benchmark_results.csv \
+  --per-query-csv benchmark_per_query.csv \
+  --output-md REPORT.md --plots-dir plots >> tester.log 2>&1 \
+  || echo "analysis failed" >> tester.log
+
+echo "=== sweep_r4 done $(date -u) ===" >> tester.log

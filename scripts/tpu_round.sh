@@ -92,18 +92,25 @@ PY
     python - <<'PY'
 import json, subprocess, sys
 out = {}
-for preset in ("nano_bench", "orin_bench"):
-    r = subprocess.run(
-        [sys.executable, "-m", "distributed_llm_tpu.training.evaluate",
-         "--preset", preset, "--checkpoint", f"checkpoints/{preset}"],
-        capture_output=True, text=True, timeout=1200)
-    try:
-        out[preset] = json.loads(r.stdout.strip().splitlines()[-1])
-    except (IndexError, ValueError):
-        print(json.dumps({"error": f"evaluate {preset} failed (rc={r.returncode})",
-                          "stderr": r.stderr[-500:]}))
-        sys.exit(2)
-gap = out["nano_bench"]["eval_loss"] - out["orin_bench"]["eval_loss"]
+try:
+    for preset in ("nano_bench", "orin_bench"):
+        r = subprocess.run(
+            [sys.executable, "-m", "distributed_llm_tpu.training.evaluate",
+             "--preset", preset, "--checkpoint", f"checkpoints/{preset}"],
+            capture_output=True, text=True, timeout=1200)
+        try:
+            out[preset] = json.loads(r.stdout.strip().splitlines()[-1])
+        except (IndexError, ValueError):
+            print(json.dumps({"error": f"evaluate {preset} failed "
+                                       f"(rc={r.returncode})",
+                              "stderr": r.stderr[-500:]}))
+            sys.exit(2)
+    gap = out["nano_bench"]["eval_loss"] - out["orin_bench"]["eval_loss"]
+except SystemExit:
+    raise
+except Exception as exc:          # hang/timeout/missing key = eval broken
+    print(json.dumps({"error": f"evaluation broke: {exc!r}"[:400]}))
+    sys.exit(2)
 print(json.dumps({"gap": round(gap, 4), **out}))
 sys.exit(0 if gap > 0.02 else 1)
 PY
@@ -174,8 +181,9 @@ PY
     --write || echo "tuning derivation failed"
 
   # 5. Reference-CLI harness sweep ON CHIP (bench tiers, trained
-  #    checkpoints): the r2/r3 artifact sets were CPU-only.
-  mkdir -p bench/results_r3_tpu && ( cd bench/results_r3_tpu && \
+  #    checkpoints): strategy grid at the canonical threshold plus the
+  #    reference's signature token-threshold sweep (100->4000).
+  mkdir -p bench/results_r4_tpu && ( cd bench/results_r4_tpu && \
     timeout 3600 python -m distributed_llm_tpu.bench.tester \
       --query-set general_knowledge \
       --strategies token semantic heuristic hybrid perf \
@@ -183,6 +191,14 @@ PY
       --output-csv benchmark_results.csv \
       --output-per-query-csv benchmark_per_query.csv \
       > tester.log 2>&1 && \
+    timeout 3600 python -m distributed_llm_tpu.bench.tester \
+      --query-set general_knowledge \
+      --strategies token \
+      --cache-modes off on --thresholds 100 250 500 1000 2000 4000 \
+      --append \
+      --output-csv benchmark_results.csv \
+      --output-per-query-csv benchmark_per_query.csv \
+      >> tester.log 2>&1 && \
     python -m distributed_llm_tpu.bench.analysis \
       --summary-csv benchmark_results.csv \
       --per-query-csv benchmark_per_query.csv \
