@@ -14,15 +14,18 @@
 # CPU-safe (tiny_cluster presets); run alongside chip work freely.
 set -u
 cd /root/repo
+export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
 out=bench/results_r4
 mkdir -p "$out"
 cd "$out"
 
 run_tester() {
   # --append: four invocations accumulate ONE artifact pair (the tester
-  # deletes existing CSVs without it).
-  JAX_PLATFORMS=cpu timeout 5400 python -m distributed_llm_tpu.bench.tester \
-    "$@" --append \
+  # deletes existing CSVs without it).  --platform cpu: the env var
+  # alone loses to this image's PJRT sitecustomize, and an unpinned run
+  # on a wedged chip blocks in the claim loop.
+  timeout 5400 python -m distributed_llm_tpu.bench.tester \
+    "$@" --append --platform cpu \
     --output-csv benchmark_results.csv \
     --output-per-query-csv benchmark_per_query.csv >> tester.log 2>&1 \
     || echo "tester $* failed/timed out ($?)" >> tester.log
@@ -42,7 +45,7 @@ for qs in general_knowledge technical_coding personal_health; do
     --cache-modes off on --thresholds 1000
 done
 
-JAX_PLATFORMS=cpu python -m distributed_llm_tpu.bench.analysis \
+python -m distributed_llm_tpu.bench.analysis \
   --summary-csv benchmark_results.csv \
   --per-query-csv benchmark_per_query.csv \
   --output-md REPORT.md --plots-dir plots >> tester.log 2>&1 \

@@ -8,6 +8,7 @@
 # next step.  Safe to re-run: completed checkpoints are kept, the
 # dispatch table merge-writes, and the tester sweep is cheap.
 cd /root/repo
+export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
 log=/tmp/tpu_round.log
 
 probe_until_healthy() {   # $1 = attempts (default 6)
