@@ -88,7 +88,7 @@ def compact(result: dict) -> dict:
     keep = ("metric", "value", "unit", "vs_baseline", "p50_ttft_ms",
             "p50_latency_ms", "routing_accuracy", "decode_tok_per_s",
             "backend", "queries", "mfu_prefill", "hbm_util_decode",
-            "per_strategy", "aborted", "hw_dispatch")
+            "per_strategy", "aborted", "hw_dispatch", "cluster")
     out = {k: result[k] for k in keep if result.get(k) is not None}
     util = result.get("utilization") or {}
     for key, ph, field in (("mfu_prefill", "prefill", "mfu"),
@@ -540,6 +540,7 @@ def run(progress: "Progress" = None) -> dict:
                 # first trace reads the fresh measurement.
                 from distributed_llm_tpu.ops import attention as _att
                 _att._DISPATCH_TABLE = None
+                _att._DISPATCH_META = None
                 progress.section("dispatch_measured", True)
         except Exception as exc:          # never lose the headline run
             progress.section("dispatch_measured", f"failed: {exc}"[:160])
@@ -553,7 +554,18 @@ def run(progress: "Progress" = None) -> dict:
     correct = 0
     gen_tokens = 0
 
-    router = Router(strategy=STRATEGIES[0], benchmark_mode=True)
+    # Chipless fallback serves the quality-asymmetric cpu_bench pair
+    # (mini_bench under nano_bench-as-orin) when its checkpoints exist,
+    # so the tier_quality premise holds on the cluster the headline
+    # actually ran (VERDICT r4 #2).  Explicit opt-in (not env-global):
+    # the unit suite's default Routers must keep the tiny tiers.
+    from distributed_llm_tpu.serving.router import default_cluster
+    cluster = default_cluster(cpu_bench=True) if backend == "cpu" else None
+    router = Router(strategy=STRATEGIES[0], benchmark_mode=True,
+                    cluster=cluster)
+    cluster_served = {t: getattr(router.cluster, t).model_preset
+                      for t in ("nano", "orin")}
+    progress.section("cluster", cluster_served)
     # Compile/warm both tier engines before the timed region.  The beat
     # callback keeps the wedge watchdog fed through warmup — dozens of
     # 20-40 s compiles per tier on chip, well past the 900 s window.
@@ -698,11 +710,20 @@ def run(progress: "Progress" = None) -> dict:
             eng = tier.server_manager.engine()
             q = eval_quality(eng.cfg, eng.params, n_batches=2, batch_size=4)
             progress.beat()
+            # One untimed warmup pays any first-touch prefill-bucket
+            # compile for this prompt shape, then average 2 timed
+            # generations — otherwise orin_cost_ratio can be dominated
+            # by compile time rather than steady-state cost.
+            prompt_q = "user: describe the largest river in geography"
+            eng.generate(prompt_q, max_new_tokens=32)
+            progress.beat()
             t0q = time.perf_counter()
-            res = eng.generate("user: describe the largest river in "
-                               "geography", max_new_tokens=32)
+            gen_toks = 0
+            for _ in range(2):
+                res = eng.generate(prompt_q, max_new_tokens=32)
+                gen_toks += res.gen_tokens
             dtq = (time.perf_counter() - t0q) * 1000.0
-            q["ms_per_token"] = round(dtq / max(res.gen_tokens, 1), 2)
+            q["ms_per_token"] = round(dtq / max(gen_toks, 1), 2)
             q["params_m"] = round(eng.cfg.param_count() / 1e6, 1)
             tier_quality[name] = q
             progress.beat()
@@ -876,6 +897,7 @@ def run(progress: "Progress" = None) -> dict:
         "routing_accuracy": round(correct / n_queries, 3),
         "decode_tok_per_s": round(gen_tokens / total_s, 1),
         "backend": backend,
+        "cluster": cluster_served,
         "queries": n_queries,
         "mfu_prefill": utilization.get("prefill", {}).get("mfu"),
         "hbm_util_decode": utilization.get("decode", {}).get("hbm_util"),

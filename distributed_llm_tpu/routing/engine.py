@@ -132,9 +132,13 @@ class QueryRouter:
                             method=decision.method,
                             q_emb=q_emb,
                         )
-                    decision.reasoning = (
-                        f"cache hit (hybrid re-route: {reason}) | " + decision.reasoning)
-                    decision.cache_hit = True
+                        # A transient perf probe is NOT a cache-derived
+                        # decision — leave its labeling alone so accuracy
+                        # attribution and logs don't credit the cache.
+                        decision.reasoning = (
+                            f"cache hit (hybrid re-route: {reason}) | "
+                            + decision.reasoning)
+                        decision.cache_hit = True
                     return decision
 
                 age = int(time.time() - hit.entry.timestamp)

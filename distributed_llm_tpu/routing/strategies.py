@@ -13,6 +13,7 @@ import json
 import logging
 import os
 import re
+import threading
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -394,11 +395,16 @@ class PerfStrategy(BaseStrategy):
         self.explore_interval = int(config.get("perf_explore_interval", 16))
         self._route_count = 0
         self._last_seen: Dict[str, int] = {}
+        # Production serving routes on concurrent HTTP threads; the probe's
+        # one-per-staleness-window invariant depends on read-modify-write
+        # of (_route_count, _last_seen) being atomic.
+        self._explore_lock = threading.Lock()
 
     def update(self, device: str, latency_ms: float, tokens: int, ok: bool = True) -> None:
         if device in self.samples:
             self.samples[device].append((float(latency_ms), int(tokens), bool(ok)))
-            self._last_seen[device] = self._route_count
+            with self._explore_lock:
+                self._last_seen[device] = self._route_count
 
     def merge_remote(self, device: str,
                      remote: List[Tuple[float, int, bool]]) -> None:
@@ -427,16 +433,17 @@ class PerfStrategy(BaseStrategy):
         call must not attract every concurrent request)."""
         if not self.explore:
             return None
-        self._route_count += 1
-        floor = -10 ** 9
-        staleness = {d: self._route_count - self._last_seen.get(d, floor)
-                     for d in self.samples}
-        stale = [d for d, age in staleness.items()
-                 if age >= self.explore_interval]
-        if not stale:
-            return None
-        device = max(stale, key=staleness.get)
-        self._last_seen[device] = self._route_count
+        with self._explore_lock:
+            self._route_count += 1
+            floor = -10 ** 9
+            staleness = {d: self._route_count - self._last_seen.get(d, floor)
+                         for d in self.samples}
+            stale = [d for d, age in staleness.items()
+                     if age >= self.explore_interval]
+            if not stale:
+                return None
+            device = max(stale, key=staleness.get)
+            self._last_seen[device] = self._route_count
         return RoutingDecision(
             device=device,
             confidence=0.30,

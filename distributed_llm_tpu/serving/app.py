@@ -263,14 +263,20 @@ def create_app(router: Optional[Router] = None,
         backend = _jax.default_backend()
         provenance = {"backend": backend}
         try:
-            import json as _json
-
-            from ..ops import attention as _att
-            with open(_att._DISPATCH_PATH) as f:
-                d = _json.load(f)
-            provenance["dispatch"] = (d.get("backend")
-                                      if d.get("backend") == backend
-                                      else f"ignored ({d.get('backend')})")
+            from ..ops.attention import dispatch_provenance
+            disp = dispatch_provenance()
+            if disp["active"]:
+                provenance["dispatch"] = disp["backend"]
+                # A table measured against older kernels still dispatches
+                # (re-measuring needs hardware) but must read as
+                # provisional (VERDICT r4 #8).
+                provenance["dispatch_kernel_gen"] = disp["kernel_gen"]
+                provenance["dispatch_stale_kernel_gen"] = (
+                    disp["stale_kernel_gen"])
+            elif disp["backend"] is not None:
+                provenance["dispatch"] = f"ignored ({disp['backend']})"
+            else:
+                provenance["dispatch"] = "none"
         except Exception:
             provenance["dispatch"] = "none"
         try:

@@ -66,12 +66,20 @@ def pretrain(preset: str, out: str, *,
     trainer = Trainer(cfg, TrainConfig(batch_size=batch_size, seq_len=seq,
                                        learning_rate=learning_rate,
                                        warmup_steps=min(50, max_steps // 4),
+                                       decay_steps=max(1000, max_steps),
                                        seed=seed), mesh)
     resumed_from = 0
     if resume:
         if os.path.isdir(out):
             trainer.load(out)
             resumed_from = trainer.step_count
+            # The restored optimizer count may sit at/past the fresh
+            # schedule's cosine horizon, where LR is pinned to the floor
+            # and the extension run cannot move the checkpoint.  Stretch
+            # the horizon so this run decays over ITS steps instead.
+            if trainer.extend_schedule(resumed_from + max_steps):
+                log(f"[pretrain] extended LR schedule to "
+                    f"{resumed_from + max_steps} steps")
             log(f"[pretrain] resumed {preset} from {out} at step "
                 f"{resumed_from}")
         else:

@@ -47,12 +47,25 @@ class TrainConfig:
     max_grad_norm: float = 1.0
     remat: bool = True
     seed: int = 0
+    # Cosine-decay horizon in optimizer steps.  None = max(warmup*10, 1000).
+    # A resumed run whose restored step counter sits past this horizon
+    # would otherwise train at the schedule floor forever — see
+    # Trainer.extend_schedule.
+    decay_steps: Optional[int] = None
+
+
+def schedule_horizon(tc: TrainConfig) -> int:
+    return tc.decay_steps or max(tc.warmup_steps * 10, 1000)
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    # end_value is a nonzero floor (10% of peak): a run that outlives the
+    # cosine horizon keeps learning slowly instead of silently freezing —
+    # the failure mode that made resumed quality-gate extensions no-ops.
     sched = optax.warmup_cosine_decay_schedule(
         init_value=0.0, peak_value=tc.learning_rate,
-        warmup_steps=tc.warmup_steps, decay_steps=max(tc.warmup_steps * 10, 1000))
+        warmup_steps=tc.warmup_steps, decay_steps=schedule_horizon(tc),
+        end_value=0.1 * tc.learning_rate)
     return optax.chain(
         optax.clip_by_global_norm(tc.max_grad_norm),
         optax.adamw(sched, weight_decay=tc.weight_decay),
@@ -154,6 +167,20 @@ class Trainer:
             self.params, self.opt_state, tokens, loss_mask)
         self.step_count += 1
         return {k: float(v) for k, v in metrics.items()}
+
+    def extend_schedule(self, total_steps: int) -> bool:
+        """Grow the cosine horizon to at least ``total_steps`` optimizer
+        steps, keeping the restored optimizer state (Adam moments + step
+        count carry over; only the count→LR mapping changes).  Called after
+        a resume so the restored step counter lands mid-cosine instead of
+        past the horizon, where the old schedule pinned LR to the floor.
+        Returns True if the optimizer was rebuilt."""
+        if total_steps <= schedule_horizon(self.tc):
+            return False
+        self.tc = dataclasses.replace(self.tc, decay_steps=total_steps)
+        self.optimizer = make_optimizer(self.tc)
+        self._step_fn = self._build_step()
+        return True
 
     # -- checkpoint/resume (utils/checkpoint.py) ---------------------------
 

@@ -132,3 +132,37 @@ def test_cache_survives_cross_embedder_persistence(tmp_path):
     qr2.load_cache(path)
     d = qr2.route_query(PARA_B, context_key="x")   # must not raise
     assert d.device in ("nano", "orin")
+
+
+def test_offgen_eval_artifact_in_sync_and_honest():
+    """The off-generator generalization eval (VERDICT r4 #7): the
+    committed artifact must match a live re-run (same pairs, same
+    embedders), and its headline finding — NO shipped embedder
+    generalizes to hand-written off-domain pairs the way MiniLM would
+    (AUC well below 0.7 on the adversarial suite) — is pinned here so
+    any future encoder that fixes it must also update the artifact and
+    the documented drift."""
+    import json
+    import os
+
+    from distributed_llm_tpu.routing.encoder_eval import load_pairs, run_eval
+
+    pos, neg = load_pairs()
+    assert len(pos) >= 50 and len(neg) >= 50
+    live = run_eval()
+    art_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench", "results_r5",
+        "offgen_eval.json")
+    with open(art_path) as f:
+        committed = json.load(f)
+    for emb in ("hashed", "encoder", "hybrid"):
+        assert emb in committed and emb in live, emb
+        for key in ("auc", "pos_mean", "neg_mean", "hit_rate_paraphrase",
+                    "false_hit_rate_unrelated"):
+            assert committed[emb][key] == pytest.approx(
+                live[emb][key], abs=1e-6), (emb, key)
+    # The honest negative result (documented in PARITY.md): off-generator
+    # semantics remain the gap vs the reference's MiniLM.  The hybrid
+    # still ranks above pure hashing on this suite.
+    assert live["hybrid"]["auc"] < 0.7
+    assert live["hybrid"]["auc"] > live["hashed"]["auc"]

@@ -54,6 +54,56 @@ def test_pretrain_resume_continues_from_checkpoint(tmp_path):
     assert res["steps"] == 13          # 8 saved + 5 additional
 
 
+def test_resume_extends_lr_schedule_past_horizon(tmp_path):
+    """A resume whose restored step counter sits at/past the cosine
+    horizon must NOT train at the schedule floor: pretrain stretches the
+    horizon to resumed_from + max_steps so the extension run decays over
+    its own steps (ADVICE r4 medium — the quality-gate extensions were
+    0-LR no-ops)."""
+    import numpy as np
+
+    from distributed_llm_tpu.config import MODEL_PRESETS
+    from distributed_llm_tpu.training.trainer import (
+        TrainConfig, Trainer, make_optimizer, schedule_horizon)
+
+    # Unit level: extend_schedule grows the horizon and keeps state.
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    tr = Trainer(MODEL_PRESETS["nano_test"],
+                 TrainConfig(batch_size=4, seq_len=32, warmup_steps=2),
+                 mesh)
+    assert schedule_horizon(tr.tc) == 1000
+    old_state = tr.opt_state
+    assert tr.extend_schedule(1800)
+    assert schedule_horizon(tr.tc) == 1800
+    # Optimizer state (moments + count) carries over untouched.
+    assert jax.tree.structure(tr.opt_state) == jax.tree.structure(old_state)
+    assert not tr.extend_schedule(1700)          # never shrinks
+
+    # Schedule level: at step 1000 the OLD horizon pinned LR to the
+    # floor; the stretched horizon keeps a mid-cosine LR well above it.
+    tc = TrainConfig(warmup_steps=50, learning_rate=1e-3)
+    import optax
+    old_sched = optax.warmup_cosine_decay_schedule(
+        0.0, 1e-3, 50, schedule_horizon(tc), end_value=1e-4)
+    new_sched = optax.warmup_cosine_decay_schedule(
+        0.0, 1e-3, 50, 1800, end_value=1e-4)
+    assert float(old_sched(1000)) == pytest.approx(1e-4)
+    assert float(new_sched(1000)) > 3e-4
+
+    # End-to-end: a resumed pretrain past the horizon logs the extension
+    # and still advances the checkpoint.
+    out = tmp_path / "ck"
+    pt.pretrain("nano_test", str(out), batch_size=4, seq_len=32,
+                max_steps=6, eval_every=50, log=lambda *_: None)
+    logs = []
+    # max_steps=1200 drives the horizon math (6 + 1200 > 1000) but the
+    # unmeetable min_delta plateaus the run after ~2 eval windows.
+    pt.pretrain("nano_test", str(out), batch_size=4, seq_len=32,
+                max_steps=1200, eval_every=5, patience=1,
+                min_delta=1000.0, resume=True, log=logs.append)
+    assert any("extended LR schedule to 1206" in line for line in logs)
+
+
 def test_heldout_eval_deterministic_and_seed_disjoint(tmp_path):
     """Same (cfg, params, seed) -> identical numbers; the held-out stream
     differs from the training stream (seed separation is the train/test

@@ -708,3 +708,35 @@ def test_prefix_affinity_end_to_end_with_real_engines(cluster):
         "nano", 0.5, "heuristic", "base", hist)
     assert dev2 == "orin", (method2, why2)
     assert "+prefix_affinity" in method2
+
+
+def test_default_cluster_cpu_bench_pair_is_opt_in(monkeypatch):
+    """On host CPU the headline bench opts into the quality-asymmetric
+    cpu_bench pair (mini_bench under nano_bench-as-orin) via the
+    explicit ``cpu_bench`` parameter — and only when BOTH presets have
+    published checkpoints; default Routers (the unit suite) keep the
+    tiny tiers (VERDICT r4 #2)."""
+    import distributed_llm_tpu.config as C
+    from distributed_llm_tpu.serving import router as R
+
+    # No opt-in: tiny pair, regardless of checkpoints.
+    monkeypatch.setattr(C, "default_checkpoint",
+                        lambda preset: f"/ck/{preset}")
+    cl = R.default_cluster()
+    assert cl.nano.model_preset == "nano_test"
+
+    # Opt-in + both checkpoints published: the cpu_bench pair, with the
+    # checkpoint paths filled in.
+    cl = R.default_cluster(cpu_bench=True)
+    assert (cl.nano.model_preset, cl.orin.model_preset) == (
+        "mini_bench", "nano_bench")
+    assert cl.nano.checkpoint_path == "/ck/mini_bench"
+    assert cl.orin.checkpoint_path == "/ck/nano_bench"
+
+    # A missing checkpoint downgrades to the tiny pair (random-init 130M
+    # on one core would be slow garbage).
+    monkeypatch.setattr(
+        C, "default_checkpoint",
+        lambda preset: None if preset == "mini_bench" else f"/ck/{preset}")
+    cl = R.default_cluster(cpu_bench=True)
+    assert cl.nano.model_preset == "nano_test"
