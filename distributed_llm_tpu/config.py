@@ -359,11 +359,20 @@ def bench_cluster() -> ClusterConfig:
                         max_new_tokens=128, quantize="int8",
                         draft_preset=draft),
     )
-    # Defaults follow measurement (same pattern as the attention dispatch
-    # table): a committed bench/tuning.json — written by
-    # `python -m distributed_llm_tpu.bench.tune` from real bench
-    # artifacts, backend-tagged — overlays quantize/kv_quantize/draft per
-    # tier.  The env override above still wins for the explicit spec A/B.
+    return _apply_tuning(cluster, draft_override=draft,
+                         draft_preset="nano_bench")
+
+
+def _apply_tuning(cluster: "ClusterConfig", *,
+                  draft_override: "Optional[str]" = None,
+                  draft_preset: str = "nano_bench") -> "ClusterConfig":
+    """Defaults follow measurement (same pattern as the attention
+    dispatch table): a committed bench/tuning.json — written by
+    `python -m distributed_llm_tpu.bench.tune` from real bench
+    artifacts, backend-tagged — overlays quantize/kv_quantize/draft per
+    tier when (and only when) its backend matches the running one.  An
+    explicit ``draft_override`` (the DLLM_BENCH_SPEC_ORIN A/B) still
+    wins over the table's speculative verdict."""
     try:
         import jax
 
@@ -371,16 +380,19 @@ def bench_cluster() -> ClusterConfig:
         tiers = load_tuning(jax.default_backend())
     except Exception:
         tiers = {}
-    if tiers:
-        def apply(tier: TierConfig) -> TierConfig:
-            t = tiers.get(tier.name) or {}
-            kw = {k: t[k] for k in ("quantize", "kv_quantize") if k in t}
-            if tier.name == "orin" and draft is None and "speculative" in t:
-                kw["draft_preset"] = "nano_bench" if t["speculative"] else None
-            return dataclasses.replace(tier, **kw) if kw else tier
-        cluster = ClusterConfig(nano=apply(cluster.nano),
-                                orin=apply(cluster.orin))
-    return cluster
+    if not tiers:
+        return cluster
+
+    def apply(tier: TierConfig) -> TierConfig:
+        t = tiers.get(tier.name) or {}
+        kw = {k: t[k] for k in ("quantize", "kv_quantize") if k in t}
+        if (tier.name == "orin" and draft_override is None
+                and "speculative" in t):
+            kw["draft_preset"] = draft_preset if t["speculative"] else None
+        return dataclasses.replace(tier, **kw) if kw else tier
+
+    return ClusterConfig(nano=apply(cluster.nano),
+                         orin=apply(cluster.orin), seed=cluster.seed)
 
 
 def cpu_bench_cluster() -> ClusterConfig:
@@ -397,14 +409,24 @@ def cpu_bench_cluster() -> ClusterConfig:
     nano_bench (~130M, chip-pretrained, held-out loss 1.257) as the
     strong one.  Smaller decode caps keep the 1-core sweep bounded.
     """
-    return ClusterConfig(
+    import os
+    draft = ("mini_bench"
+             if os.environ.get("DLLM_BENCH_SPEC_ORIN") == "1" else None)
+    cluster = ClusterConfig(
         nano=TierConfig(name="nano", model_preset="mini_bench", tp=1,
                         max_new_tokens=48,
                         prefill_buckets=(64, 128, 256, 512, 1024, 2048)),
         orin=TierConfig(name="orin", model_preset="nano_bench", tp=1,
-                        max_new_tokens=64,
+                        max_new_tokens=64, draft_preset=draft,
                         prefill_buckets=(64, 128, 256, 512, 1024, 2048)),
     )
+    # A cpu-backend tuning.json (bench.tune over the chipless headline's
+    # artifacts) steers THIS pair's quant/kv/spec defaults the same way
+    # the tpu table steers bench_cluster — the draft is the pair's own
+    # weak tier, and the explicit spec A/B env wins over the table here
+    # too.
+    return _apply_tuning(cluster, draft_override=draft,
+                         draft_preset="mini_bench")
 
 
 def flagship_cluster(n_devices: Optional[int] = None) -> ClusterConfig:
