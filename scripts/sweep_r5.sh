@@ -1,0 +1,61 @@
+#!/bin/bash
+# Round-5 CPU harness sweep (VERDICT r4 #4) -> bench/results_r5/
+#
+# The r4 sweep reproduced the reference's signature threshold experiment
+# (routing_chatbot_tester.py:352-367) only in a degenerate corner: every
+# query was tiny, so orin's share hit zero at threshold >=500 and rows
+# 500->4000 were identical.  Round 5 adds the long_context query set
+# (pasted multi-section documents at ~0.3k-2.5k tokens with short
+# follow-ups) so query+context token counts straddle the whole 100->4000
+# range — the sweep must now show load shifting at EVERY rung, mirroring
+# BASELINE.md's continuous shift.
+#
+# Artifacts:
+#  1. Threshold sweep, token strategy, long_context AND the reference's
+#     original general_knowledge, both cache modes.
+#  2. Full strategy grid over all FOUR query sets at the canonical
+#     threshold, both cache modes.
+#
+# CPU-safe (tiny_cluster presets); run alongside chip work freely.
+set -u
+cd /root/repo
+export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
+out=bench/results_r5
+mkdir -p "$out"
+cd "$out"
+
+run_tester() {
+  # --append: invocations accumulate ONE artifact pair (the tester
+  # deletes existing CSVs without it).  --platform cpu: the env var
+  # alone loses to this image's PJRT sitecustomize, and an unpinned run
+  # on a wedged chip blocks in the claim loop.
+  timeout 5400 python -m distributed_llm_tpu.bench.tester \
+    "$@" --append --platform cpu \
+    --output-csv benchmark_results.csv \
+    --output-per-query-csv benchmark_per_query.csv >> tester.log 2>&1 \
+    || echo "tester $* failed/timed out ($?)" >> tester.log
+}
+
+echo "=== sweep_r5 start $(date -u) @ $(git rev-parse --short HEAD) ===" >> tester.log
+rm -f benchmark_results.csv benchmark_per_query.csv
+
+# 1. Threshold sweeps (token strategy only — the reference experiment).
+run_tester --query-set long_context --strategies token \
+  --cache-modes off on --thresholds 100 250 500 1000 2000 4000
+run_tester --query-set general_knowledge --strategies token \
+  --cache-modes off on --thresholds 100 250 500 1000 2000 4000
+
+# 2. Full strategy grid x 4 query sets at the canonical threshold.
+for qs in general_knowledge technical_coding personal_health long_context; do
+  run_tester --query-set "$qs" \
+    --strategies token semantic heuristic hybrid perf \
+    --cache-modes off on --thresholds 1000
+done
+
+python -m distributed_llm_tpu.bench.analysis \
+  --summary-csv benchmark_results.csv \
+  --per-query-csv benchmark_per_query.csv \
+  --output-md REPORT.md --plots-dir plots >> tester.log 2>&1 \
+  || echo "analysis failed" >> tester.log
+
+echo "=== sweep_r5 done $(date -u) ===" >> tester.log
