@@ -905,7 +905,10 @@ def run(progress: "Progress" = None) -> dict:
         try:
             from distributed_llm_tpu.training.evaluate import eval_quality
             eng = tier.server_manager.engine()
-            q = eval_quality(eng.cfg, eng.params, n_batches=2, batch_size=4)
+            # Same settings as the evaluate CLI / tpu_round quality gate
+            # (8160 held-out tokens): the verdict gap is judged against
+            # those numbers and the 4x sample keeps it stable.
+            q = eval_quality(eng.cfg, eng.params, n_batches=4, batch_size=8)
             progress.beat()
             # One untimed warmup pays any first-touch prefill-bucket
             # compile for this prompt shape, then average 2 timed

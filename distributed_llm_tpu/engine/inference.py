@@ -168,13 +168,18 @@ class InferenceEngine:
         # a long new turn should chunk-stride (O(delta), warmed programs),
         # not pad out to a giant unsharded suffix prefill.
         self._suffix_buckets = list(self._buckets)
-        # Suffix buckets a prompt will REUSE a parked prefix through: the
-        # first three rungs cover typical chat turns, and warmup compiles
-        # every (reuse bucket, cache rung) suffix program — a prefix-hit
-        # turn can never trace mid-chat.  Longer new turns take the
-        # (warmed) chunk-stride path via allow_long_suffix instead of
-        # minting ever more suffix shapes.
-        self._reuse_buckets = self._buckets[:3]
+        # Suffix buckets a prompt will REUSE a parked prefix through:
+        # the ≤256-token rungs cover typical chat turns, and warmup
+        # compiles every (reuse bucket, cache rung) suffix program — a
+        # prefix-hit turn can never trace mid-chat.  Longer new turns
+        # take the (warmed) chunk-stride path via allow_long_suffix
+        # instead of minting ever more suffix shapes.  Selecting by SIZE
+        # (not the first three rungs) keeps a short ladder like
+        # (64, 256, 2048) from promoting its max-shape rung into a
+        # warmup suffix compile and from padding mid-size follow-ups to
+        # the top bucket (code review r5).
+        self._reuse_buckets = ([b for b in self._buckets if b <= 256][:3]
+                               or self._buckets[:1])
         if (mesh is not None and dict(mesh.shape).get("sp", 1) > 1
                 and self.cfg.num_experts == 1
                 and self._buckets and self._buckets[-1] < self._max_seq):

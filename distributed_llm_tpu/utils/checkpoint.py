@@ -115,6 +115,16 @@ def save_train_state(path: str, trainer) -> str:
     root = _abspath(path)
     os.makedirs(root, exist_ok=True)
     version_dir = os.path.join(root, f"v{trainer.step_count}")
+    if os.path.realpath(version_dir) == _latest_dir(root):
+        # Already published at this exact step (save_every divided
+        # max_steps, so the loop's save and the final save coincide).
+        # The orbax save would force-overwrite the LIVE artifact in
+        # place — a preemption mid-rewrite would leave 'latest' pointing
+        # at a half-written dir, breaking the kill-at-any-instant
+        # invariant — and the state it would write is identical anyway.
+        return root
+    # A stale same-step dir from an abandoned/rolled-back run is NOT the
+    # published artifact; orbax force-overwrites it below.
     save_checkpoint(os.path.join(version_dir, "state"), {
         "params": trainer.params,
         "opt_state": trainer.opt_state,

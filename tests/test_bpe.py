@@ -81,11 +81,18 @@ def test_committed_artifact_serves_the_presets():
     for preset in MODEL_PRESETS.values():
         if preset.tokenizer == "bpe":
             assert get_tokenizer(preset).vocab_size == preset.vocab_size
-    qtexts = [i["query"] for qs in query_sets.values() for i in qs]
-    chars = sum(len(t) for t in qtexts)
-    toks = sum(len(tok.encode(t, add_bos=False)) for t in qtexts)
+    # Compression regime is asserted on the CONVERSATIONAL sets the
+    # vocab was sized for; long_context's pasted pseudo-reports are
+    # deliberately figure-dense (numerals split to bytes) and sit below
+    # the chat regime — they still must roundtrip exactly (below).
+    chat_sets = ("general_knowledge", "technical_coding",
+                 "personal_health")
+    chat_texts = [i["query"] for name in chat_sets
+                  for i in query_sets[name]]
+    chars = sum(len(t) for t in chat_texts)
+    toks = sum(len(tok.encode(t, add_bos=False)) for t in chat_texts)
     assert 2.5 <= chars / toks <= 6.0, chars / toks
-    for t in qtexts:
+    for t in (i["query"] for qs in query_sets.values() for i in qs):
         assert tok.decode(tok.encode(t, add_bos=False)) == t
 
 

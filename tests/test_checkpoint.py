@@ -107,6 +107,42 @@ def test_versioned_saves_keep_latest_and_prune(tmp_path):
     assert t2.step_count == 3
 
 
+def test_save_replaces_stale_same_step_version(tmp_path):
+    """A rolled-back/abandoned run can leave a v<step> directory that a
+    retry reaches again at the same global step; the save force-
+    overwrites the stale version.  But when v<step> IS the live
+    published 'latest' (save_every divided max_steps, so the loop save
+    and the final save coincide), re-saving is a NO-OP — an in-place
+    rewrite of the live artifact would break the kill-at-any-instant
+    invariant for identical state."""
+    import os
+    t = _trainer(jax.devices()[:1], seed=11)
+    tokens, mask = next(batches(4, 32, seed=4))
+    root = str(tmp_path / "ckpt")
+    t.train_step(tokens, mask)
+    t.save(root)                                    # publishes v1
+    # Stale same-step dir from an abandoned run, NOT the published one.
+    t.train_step(tokens, mask)
+    stale = os.path.join(root, "v2")
+    os.makedirs(os.path.join(stale, "state"))
+    with open(os.path.join(stale, "state", "junk"), "w") as f:
+        f.write("stale")
+    t.save(root)                                    # replaces v2
+    assert os.path.realpath(os.path.join(root, "latest")).endswith("v2")
+    assert not os.path.exists(os.path.join(root, "v2", "state", "junk"))
+    t2 = _trainer(jax.devices()[:1], seed=12)
+    t2.load(root)
+    assert t2.step_count == 2
+
+    # Same-step REPUBLISH of the live artifact: untouched, still loads.
+    before = os.stat(os.path.join(root, "v2", "state")).st_mtime_ns
+    t.save(root)
+    assert os.stat(os.path.join(root, "v2", "state")).st_mtime_ns == before
+    t3 = _trainer(jax.devices()[:1], seed=13)
+    t3.load(root)
+    assert t3.step_count == 2
+
+
 def test_peek_vocab_size_reads_metadata_only():
     """scripts/tpu_round.sh's stale-vocab guard depends on this returning
     the real embed row count (ADVICE-style regression: the orbax metadata
