@@ -25,6 +25,7 @@ from ..engine.inference import GenerationResult
 from ..engine.manager import EngineManager
 from ..parallel.mesh import carve_tier_meshes
 from ..utils.faults import FaultInjector
+from .turns import ClippedStream, clip_turn
 
 logger = logging.getLogger(__name__)
 
@@ -158,7 +159,11 @@ class TierClient:
             # the reference error shape instead of crashing the worker.
             return {"error": f"Request failed: {self.name} engine "
                              f"returned no result"}, None
-        return {"response": result.text}, result
+        # Single-turn semantic: the corpus-trained LM continues the
+        # transcript past its own turn; the serving layer clips it
+        # (serving/turns.py — the reference gets this from Ollama's
+        # instruction-tuned models).
+        return {"response": clip_turn(result.text)}, result
 
     def process_stream(self, history: History):
         """Streaming twin of ``process``: returns a primed stream handle,
@@ -195,7 +200,8 @@ class TierClient:
                 return {"error": "Request failed: engine does not support "
                                  "token streaming"}
             if getattr(engine, "concurrent_safe", False):
-                return _PrimedStream(engine.generate_stream(history))
+                return _PrimedStream(
+                    ClippedStream(engine.generate_stream(history)))
             timeout = self.tier.request_timeout_s
             acquired = (self._engine_lock.acquire(timeout=timeout)
                         if timeout is not None
@@ -207,8 +213,9 @@ class TierClient:
                 return {"error": f"Request failed: {self.name} engine busy "
                                  f"after {timeout:.0f}s"}
             try:
-                return _PrimedStream(engine.generate_stream(history),
-                                     release=self._engine_lock.release)
+                return _PrimedStream(
+                    ClippedStream(engine.generate_stream(history)),
+                    release=self._engine_lock.release)
             except BaseException:
                 self._engine_lock.release()
                 raise

@@ -82,7 +82,8 @@ def create_tier_app(tier_name: str,
         try:
             result = manager.engine().generate(
                 query, max_new_tokens=max_new, temperature=temperature)
-            payload: Dict[str, Any] = {"response": result.text.strip()}
+            from .turns import clip_turn
+            payload: Dict[str, Any] = {"response": clip_turn(result.text)}
             if data.get("stats"):
                 # Opt-in extension (the bare reply stays reference-faithful,
                 # src/devices/nano_api.py:83): generation metrics so a
@@ -124,8 +125,10 @@ def create_tier_app(tier_name: str,
                                      "numeric"}), 400
         max_new = num_predict if num_predict > 0 else None
         try:
-            handle = engine.generate_stream(query, max_new_tokens=max_new,
-                                            temperature=temperature)
+            from .turns import ClippedStream
+            handle = ClippedStream(
+                engine.generate_stream(query, max_new_tokens=max_new,
+                                       temperature=temperature))
         except NotImplementedError as exc:
             # e.g. the speculative engine is greedy-only: keep the JSON
             # error contract instead of a framework 500 page.

@@ -25,6 +25,7 @@ models makes weight checkpointing a real subsystem:
 
 from __future__ import annotations
 
+import logging
 import os
 import re
 from typing import Any, Dict, Optional
@@ -121,7 +122,16 @@ def save_train_state(path: str, trainer) -> str:
         # The orbax save would force-overwrite the LIVE artifact in
         # place — a preemption mid-rewrite would leave 'latest' pointing
         # at a half-written dir, breaking the kill-at-any-instant
-        # invariant — and the state it would write is identical anyway.
+        # invariant.  In the in-run double-save case the state is
+        # identical; a run that reaches the published step by a
+        # DIFFERENT path (resumed from an older version) is discarded
+        # here, hence the warning — step once more to publish such a
+        # state under a fresh version.
+        logging.getLogger(__name__).warning(
+            "save skipped: %s is already the published 'latest' at step "
+            "%d; if this run's state differs (resume from an older "
+            "version), advance one step so it publishes under a new "
+            "version", version_dir, trainer.step_count)
         return root
     # A stale same-step dir from an abandoned/rolled-back run is NOT the
     # published artifact; orbax force-overwrites it below.
