@@ -11,11 +11,17 @@ contradicted them.  This tool closes the loop mechanically::
 
 It reads the headline bench's per-tier quant A/B (``quant.<tier>``) and
 the speculative A/B (``speculative.speedup`` from the spec-enabled run),
-decides each tier's ``quantize`` / ``kv_quantize`` / ``draft`` by which
-leg measured faster, and publishes ``bench/tuning.json`` tagged with the
-backend it was measured on.  ``config.bench_cluster`` overlays the table
-when (and only when) its backend matches the running one — a CPU-derived
-table can never steer the chip, and vice versa.
+decides each tier's ``quantize`` / ``kv_quantize`` by which leg measured
+faster, and publishes ``bench/tuning.json`` tagged with the backend it
+was measured on.  The speculative default is additionally behind a
+CAPABILITY gate (``SPEC_ENGINE_HAS_PREFIX_REUSE``): a measured decode
+win is recorded in the table's evidence, but the default only flips
+once the spec engine supports session prefix reuse — the table's
+``spec_note`` says so, and ``DLLM_BENCH_SPEC_ORIN=1`` serves spec
+explicitly regardless.  ``config.bench_cluster`` /
+``config.cpu_bench_cluster`` overlay the table when (and only when) its
+backend matches the running one — a CPU-derived table can never steer
+the chip, and vice versa.
 """
 
 from __future__ import annotations
@@ -26,6 +32,12 @@ import os
 
 TUNING_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "tuning.json")
+
+# Capability gate for the speculative default (see derive): the spec
+# engine currently serves without session KV prefix reuse, so a
+# measured decode-throughput win must not silently cost the multi-turn
+# TTFT capability.  Flip to True when engine/speculative.py parks KV.
+SPEC_ENGINE_HAS_PREFIX_REUSE = False
 
 
 def derive(headline: dict, spec: dict = None,
@@ -70,7 +82,23 @@ def derive(headline: dict, spec: dict = None,
             s = spec.get("speculative") or {}
             if s.get("speedup"):
                 orin = out["tiers"].setdefault("orin", {})
-                orin["speculative"] = bool(s["speedup"] >= min_speedup)
+                wins = bool(s["speedup"] >= min_speedup)
+                # Engine-capability gate: SpeculativeEngine serves
+                # WITHOUT session KV prefix reuse (engine/speculative.py
+                # has no prefix cache), so defaulting spec on would
+                # trade the measured multi-turn TTFT win (prefix-reuse
+                # verdicts) for a decode-throughput win — a different
+                # workload's trade that the single-turn A/B alone
+                # cannot justify.  The measured speedup is recorded;
+                # the default flips only once the spec engine parks KV
+                # (or explicitly via DLLM_BENCH_SPEC_ORIN=1).
+                orin["speculative"] = wins and SPEC_ENGINE_HAS_PREFIX_REUSE
+                if wins and not SPEC_ENGINE_HAS_PREFIX_REUSE:
+                    out["spec_note"] = (
+                        "spec wins on decode throughput but the "
+                        "speculative engine lacks session prefix reuse "
+                        "— default stays off (capability gate); serve "
+                        "it explicitly with DLLM_BENCH_SPEC_ORIN=1")
                 orin.setdefault("evidence", {})["spec_speedup"] = \
                     s["speedup"]
     return out

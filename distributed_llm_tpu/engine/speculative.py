@@ -158,6 +158,14 @@ class SpeculativeEngine:
         self._wbytes_t = roofline.weight_bytes(self.cfg_t, target.quantize)
         self._wbytes_d = roofline.weight_bytes(self.cfg_d, target.quantize)
 
+    @property
+    def params(self):
+        """InferenceEngine surface parity: the TARGET's weights — spec
+        decoding is greedy-exact, so served answer quality IS the
+        target model's (bench.py's tier_quality probe scores
+        eng.cfg/eng.params for any engine)."""
+        return self.params_t
+
     # -- compiled stages ---------------------------------------------------
 
     def _prefill_fn(self, bucket: int, cache_len: int):

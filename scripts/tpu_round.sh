@@ -168,7 +168,9 @@ PY
   probe_until_healthy || { echo "chip wedged — aborting"; exit 1; }
 
   # 4. Speculative-orin headline A/B (draft = nano model, greedy-exact):
-  #    decides whether the spec default flips (VERDICT r2 #5).
+  #    records the measured spec speedup (VERDICT r2 #5); the default
+  #    flip is additionally capability-gated (bench/tune.py
+  #    SPEC_ENGINE_HAS_PREFIX_REUSE).
   DLLM_BENCH_SPEC_ORIN=1 timeout 5400 python bench.py \
     > /tmp/BENCH_tpu_spec.json 2> /tmp/bench_tpu_spec.log \
     || echo "spec bench exited nonzero/timed out ($?)"
