@@ -148,6 +148,8 @@ def compact(result: dict) -> dict:
             "followup_ttft_speedup"),
         "tier_quality": (result.get("tier_quality") or {}).get("verdict"),
         "perf_steering": (result.get("perf_steering") or {}).get("verdict"),
+        "spec_followup_ttft_cost": (result.get("spec_multiturn") or {}).get(
+            "spec_followup_ttft_cost"),
         "flagship_decode_tok_per_s": {
             t: f.get("decode_tok_per_s")
             for t, f in (result.get("flagship") or {}).items()
@@ -400,6 +402,67 @@ def perf_steering_phase(injected_latency_s: float = 0.20,
         }
     except Exception as exc:
         out["verdict"] = {"error": str(exc)[:160]}
+    return out
+
+
+def spec_multiturn_phase(cluster, max_new: int = 16,
+                         beat=lambda: None) -> dict:
+    """Measure what speculative serving COSTS on multi-turn TTFT — the
+    number behind bench.tune's capability gate (SPEC_ENGINE_HAS_
+    PREFIX_REUSE): the spec engine re-prefills the whole history every
+    turn, while the plain engine's parked prefix makes the follow-up
+    O(new turn).  Reports the follow-up TTFT on both engines over the
+    same 2-turn conversation; ratio > 1 is the capability the gate
+    refuses to trade silently for spec's decode win."""
+    import sys
+
+    from distributed_llm_tpu.engine.inference import InferenceEngine
+    from distributed_llm_tpu.engine.speculative import SpeculativeEngine
+
+    print("[bench] spec multi-turn cost probe", file=sys.stderr, flush=True)
+    turn1 = ("Please give a detailed account of how rivers shape valleys "
+             "over geological time, with several concrete mechanisms "
+             "discussed one by one so the explanation runs long.")
+    turn2 = "and what about glaciers?"
+
+    def followup_ttft(eng) -> float:
+        hist = [{"role": "user", "content": turn1}]
+        first = eng.generate(hist, max_new_tokens=max_new)
+        beat()
+        hist += [{"role": "assistant", "content": first.text},
+                 {"role": "user", "content": turn2}]
+        # Two follow-ups: the first may pay one-off suffix-shape
+        # compiles; the second is the steady-state number.
+        ttfts = []
+        for extra in ("", " and fjords?"):
+            res = eng.generate(hist + ([{"role": "user", "content": extra}]
+                                       if extra else []),
+                               max_new_tokens=max_new)
+            ttfts.append(res.ttft_ms)
+            beat()
+        return min(ttfts)
+
+    out: dict = {}
+    try:
+        # Engine selection is explicit here (draft_preset is a
+        # manager-level knob the engines themselves never read): the
+        # plain engine IS prefix-reuse-capable, the spec engine drafts
+        # with the cluster's weak tier.
+        plain = InferenceEngine(cluster.orin, seed=5)
+        try:
+            out["plain_followup_ttft_ms"] = round(followup_ttft(plain), 2)
+        finally:
+            del plain
+        spec = SpeculativeEngine(cluster.orin, cluster.nano, seed=5)
+        try:
+            out["spec_followup_ttft_ms"] = round(followup_ttft(spec), 2)
+        finally:
+            del spec
+        out["spec_followup_ttft_cost"] = round(
+            out["spec_followup_ttft_ms"]
+            / max(out["plain_followup_ttft_ms"], 1e-6), 2)
+    except Exception as exc:              # never lose the headline line
+        out["error"] = str(exc)[:200]
     return out
 
 
@@ -1075,6 +1138,9 @@ def run(progress: "Progress" = None) -> dict:
     except Exception as exc:              # never lose the headline line
         perf_steering = {"error": str(exc)[:200]}
     progress.section("perf_steering", perf_steering)
+    spec_multiturn = spec_multiturn_phase(router.cluster,
+                                          beat=progress.beat)
+    progress.section("spec_multiturn", spec_multiturn)
 
     # North-star-scale serving (VERDICT r2 #2b).  Skipped on the CPU
     # fallback (a 1B model on one host core is not a measurement) unless
@@ -1115,6 +1181,7 @@ def run(progress: "Progress" = None) -> dict:
         "long_context": long_context,
         "orin_prefix": orin_prefix,
         "perf_steering": perf_steering,
+        "spec_multiturn": spec_multiturn,
         "flagship": flagship,
         "hw_dispatch": hw_dispatch,
         "tiers": phases,
