@@ -103,6 +103,19 @@ PRODUCTION_CFG: Dict[str, Any] = {
     # exact never-explore semantics (PARITY.md).
     "perf_explore": True,
     "perf_explore_interval": 16,
+    # Queue-aware perf routing (beyond-reference, production only): the
+    # Router feeds each tier's live load (admission queue depth + batch
+    # slot occupancy, serving/tiers.py) into the perf strategy before
+    # every decision, and the score adds perf_queue_penalty_ms per unit
+    # of load — so a saturated tier sheds quality-equivalent traffic to
+    # an idle one instead of stacking its queue until requests time out.
+    # On a multi-host mesh the load rows ride the same ICI health
+    # allgather as the perf windows (serving/health.py); locally the
+    # signal is in-process counters.  Absent from BENCHMARK_CFG: the
+    # labeled-accuracy benchmarks keep the reference's pure
+    # latency-per-token scoring.
+    "perf_queue_aware": True,
+    "perf_queue_penalty_ms": 50.0,
 }
 
 
@@ -244,10 +257,24 @@ class TierConfig:
     # decode_steps_per_tick batches that many sequential decode steps into
     # ONE device call per scheduler tick, amortizing the host↔device round
     # trip; costs ≤T-1 wasted steps per finishing request and delays new
-    # admissions by <T steps.
+    # admissions by <T steps.  Serving clusters default decode_batch > 1
+    # (concurrent-by-default: the shipped presets set nano=8 / orin=4
+    # slots); the dataclass default stays 1 so directly-constructed test
+    # tiers keep the sequential engine, and requesting decode_batch=1 is
+    # the documented opt-out back to it.  Speculative tiers
+    # (draft_preset) always serve sequentially — EngineManager falls back
+    # and logs when both are configured.
     decode_batch: int = 1
     kv_block_size: int = 64
     decode_steps_per_tick: int = 4
+    # Admission control (serving/tiers.py AdmissionController): the max
+    # requests allowed to WAIT for this tier beyond its decode_batch
+    # concurrent slots.  Past the bound — or earlier, when queued × EWMA
+    # service time predicts a wait that would blow request_timeout_s —
+    # new requests fail fast with the reference error shape, so Router
+    # failover and the perf fail penalty fire instead of the queue
+    # growing unboundedly.  None disables admission control.
+    admission_max_queue: Optional[int] = 16
     # Orbax checkpoint directory to serve trained weights from; None =
     # deterministic random init (utils/checkpoint.py load_params_for_tier).
     checkpoint_path: Optional[str] = None
@@ -326,10 +353,16 @@ class ClusterConfig:
     (single-chip dev boxes and the one-chip bench environment).
     """
 
+    # Concurrent-by-default: both tiers serve through the continuous-
+    # batching engine (decode_batch slots share one compiled decode
+    # step); the 3.67×-measured batching speedup only reaches traffic
+    # when it is the default path, not a bench-only A/B.
     nano: TierConfig = dataclasses.field(
-        default_factory=lambda: TierConfig(name="nano", model_preset="nano_1b", tp=1))
+        default_factory=lambda: TierConfig(name="nano", model_preset="nano_1b",
+                                           tp=1, decode_batch=8))
     orin: TierConfig = dataclasses.field(
-        default_factory=lambda: TierConfig(name="orin", model_preset="orin_8b", tp=4))
+        default_factory=lambda: TierConfig(name="orin", model_preset="orin_8b",
+                                           tp=4, decode_batch=4))
     seed: int = 0
 
     def tiers(self) -> Tuple[TierConfig, TierConfig]:
@@ -354,10 +387,11 @@ def bench_cluster() -> ClusterConfig:
              if os.environ.get("DLLM_BENCH_SPEC_ORIN") == "1" else None)
     cluster = ClusterConfig(
         nano=TierConfig(name="nano", model_preset="nano_bench", tp=1,
-                        max_new_tokens=64, quantize="int8"),
+                        max_new_tokens=64, quantize="int8",
+                        decode_batch=8),
         orin=TierConfig(name="orin", model_preset="orin_bench", tp=1,
                         max_new_tokens=128, quantize="int8",
-                        draft_preset=draft),
+                        decode_batch=4, draft_preset=draft),
     )
     return _apply_tuning(cluster, draft_override=draft,
                          draft_preset="nano_bench")
@@ -420,10 +454,11 @@ def cpu_bench_cluster() -> ClusterConfig:
     # long-context probe).
     cluster = ClusterConfig(
         nano=TierConfig(name="nano", model_preset="mini_bench", tp=1,
-                        max_new_tokens=48,
+                        max_new_tokens=48, decode_batch=8,
                         prefill_buckets=(64, 256, 2048)),
         orin=TierConfig(name="orin", model_preset="nano_bench", tp=1,
-                        max_new_tokens=64, draft_preset=draft,
+                        max_new_tokens=64, decode_batch=4,
+                        draft_preset=draft,
                         prefill_buckets=(64, 256, 2048)),
     )
     # A cpu-backend tuning.json (bench.tune over the chipless headline's
@@ -450,22 +485,28 @@ def flagship_cluster(n_devices: Optional[int] = None) -> ClusterConfig:
         import jax
         n_devices = len(jax.devices())
     nano = TierConfig(name="nano", model_preset="nano_1b", tp=1,
-                      max_new_tokens=64,
+                      max_new_tokens=64, decode_batch=8,
                       prefill_buckets=(256, 1024, 2048))
     if n_devices >= 5:
         orin = TierConfig(name="orin", model_preset="orin_8b", tp=4,
-                          max_new_tokens=128,
+                          max_new_tokens=128, decode_batch=4,
                           prefill_buckets=(256, 1024, 2048))
     else:
         orin = TierConfig(name="orin", model_preset="orin_8b", tp=1,
                           max_new_tokens=128, quantize="int8",
-                          kv_quantize="int8",
+                          kv_quantize="int8", decode_batch=4,
                           prefill_buckets=(256, 1024, 2048))
     return ClusterConfig(nano=nano, orin=orin)
 
 
 def tiny_cluster() -> ClusterConfig:
-    """Tiny cluster for CPU unit tests (8 virtual devices: 1 + 4 used)."""
+    """Tiny cluster for CPU unit tests (8 virtual devices: 1 + 4 used).
+
+    Deliberately sequential (decode_batch=1): hundreds of unit tests
+    build these tiers and the sequential engine's warmup is the cheaper
+    one; the concurrent-by-default serving path is covered by
+    ``tiny_batched_cluster`` (admission/soak tests and the bench's
+    chipless fallback) and the real serving presets above."""
     return ClusterConfig(
         nano=TierConfig(name="nano", model_preset="nano_test", tp=1,
                         max_new_tokens=8, prefill_buckets=(16, 32, 64),
@@ -474,6 +515,25 @@ def tiny_cluster() -> ClusterConfig:
                         max_new_tokens=8, prefill_buckets=(16, 32, 64),
                         kv_block_size=16),
     )
+
+
+def tiny_batched_cluster(nano_slots: int = 4,
+                         orin_slots: int = 2) -> ClusterConfig:
+    """The tiny tiers with the serving default's continuous-batching
+    engines (concurrent-by-default at test scale): used by the
+    admission/soak tests and by the bench's chipless tiny fallback so
+    the concurrent headline exercises the same engine family the real
+    presets serve.  max_new_tokens is raised to a serving-realistic 24
+    (the unit tiers' 8 is a test-speed artifact): batching amortizes the
+    DECODE loop, so a cap that makes requests all-prefill would
+    understate the default path the real presets (48-128 caps) serve."""
+    tiny = tiny_cluster()
+    return ClusterConfig(
+        nano=dataclasses.replace(tiny.nano, decode_batch=nano_slots,
+                                 max_new_tokens=24),
+        orin=dataclasses.replace(tiny.orin, decode_batch=orin_slots,
+                                 max_new_tokens=24),
+        seed=tiny.seed)
 
 
 def default_checkpoint(preset: str) -> Optional[str]:

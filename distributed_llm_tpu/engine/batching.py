@@ -673,6 +673,26 @@ class ContinuousBatchingEngine:
 
         return StreamHandle(deltas(), req)
 
+    def queue_depth(self) -> int:
+        """Requests submitted but not yet admitted to a batch slot."""
+        return self._queue.qsize()
+
+    def slot_stats(self) -> Dict[str, Any]:
+        """Live occupancy snapshot for health()/telemetry: queued
+        requests, busy batch slots, and occupancy in [0,1].  Read from
+        the scheduler's slot list without a lock — single-word reads of
+        a list the scheduler thread owns, safe under the GIL; the
+        snapshot is advisory (routing signal), not a synchronization
+        point."""
+        active = sum(1 for s in self._slots if s is not None)
+        total = self.paged.max_slots
+        return {
+            "queue_depth": self._queue.qsize(),
+            "active_slots": active,
+            "max_slots": total,
+            "slot_occupancy": round(active / max(1, total), 3),
+        }
+
     def prefix_affinity(self, history) -> int:
         """Longest parked-prefix token match in the paged pool for
         ``history`` (non-destructive; see InferenceEngine.prefix_affinity)."""

@@ -65,16 +65,24 @@ class EngineManager:
                     beat()
             use_speculative = bool(self.tier.draft_preset)
             if use_speculative and (self.mesh is not None
-                                    or self.tier.decode_batch > 1
                                     or self.tier.temperature > 0):
                 logger.warning(
                     "tier %s: draft_preset=%s ignored (speculative decoding "
-                    "is greedy-only and unsharded/unbatched; mesh=%s "
-                    "decode_batch=%d temperature=%s)",
+                    "is greedy-only and unsharded; mesh=%s temperature=%s)",
                     self.tier.name, self.tier.draft_preset,
-                    self.mesh is not None, self.tier.decode_batch,
-                    self.tier.temperature)
+                    self.mesh is not None, self.tier.temperature)
                 use_speculative = False
+            if use_speculative and self.tier.decode_batch > 1:
+                # Concurrent-by-default presets set decode_batch>1, but a
+                # configured draft still wins: speculative serving is the
+                # sequential engine family, so the tier falls back to it
+                # (the documented automatic fallback) instead of silently
+                # dropping the draft.
+                logger.warning(
+                    "tier %s: decode_batch=%d ignored — draft_preset=%s "
+                    "selects the sequential speculative engine",
+                    self.tier.name, self.tier.decode_batch,
+                    self.tier.draft_preset)
             if use_speculative:
                 import dataclasses as _dc
 
@@ -131,9 +139,17 @@ class EngineManager:
     # -- health (device-server GET /health surface) ------------------------
 
     def health(self) -> Dict[str, Any]:
+        """Liveness + load snapshot (device-server GET /health surface).
+
+        Beyond the reference's {"ok"}: the snapshot carries the tier's
+        live load — admission queue depth, in-flight requests, batch
+        slot occupancy — so queue-aware perf routing and the health
+        allgather read one assembler (the TierClient registers its
+        AdmissionController on ``self.admission``; batching engines
+        expose ``queue_depth``/``slot_stats``)."""
         with self._lock:
             running = self._engine is not None
-            return {
+            entry: Dict[str, Any] = {
                 "ok": running,
                 "tier": self.tier.name,
                 "model": self.tier.model_preset,
@@ -141,6 +157,36 @@ class EngineManager:
                 "devices": ([d.id for d in self.mesh.devices.flat]
                             if self.mesh is not None else None),
             }
+            engine = self._engine
+        # Load/occupancy outside the lifecycle lock: counters are plain
+        # ints guarded by their own locks (or GIL-safe reads).
+        slots = getattr(engine, "slot_stats", None)
+        if callable(slots):
+            try:
+                entry.update(slots())
+            except Exception:
+                pass
+        admission = getattr(self, "admission", None)
+        if admission is not None:
+            adm = admission.snapshot()
+            entry["admission"] = adm
+            # Top-level queue_depth = requests waiting beyond the
+            # engine's concurrent slots (the perf strategy's signal);
+            # engines without slot_stats get their occupancy inferred
+            # from admission in-flight vs the tier's slot count.
+            entry.setdefault("queue_depth", adm["queue_depth"])
+            if "max_slots" not in entry:
+                # The controller's slot count, not decode_batch: the
+                # speculative fallback serves sequentially regardless
+                # of the configured batch.
+                slots_n = adm.get("slots") or max(1, self.tier.decode_batch)
+                active = min(adm["inflight"], slots_n)
+                entry["active_slots"] = active
+                entry["max_slots"] = slots_n
+                entry["slot_occupancy"] = round(active / slots_n, 3)
+        elif "queue_depth" not in entry:
+            entry["queue_depth"] = 0
+        return entry
 
 
 def mesh_devs(mesh):

@@ -198,6 +198,21 @@ class QueryRouter:
         if hasattr(self.router, "update"):
             self.router.update(device=device, latency_ms=latency_ms, tokens=tokens, ok=ok)
 
+    def update_load(self, device: str, **load: Any) -> None:
+        """Feed a tier's live queue/slot load into a queue-aware strategy
+        (PerfStrategy.update_load); no-op for the others."""
+        if hasattr(self.router, "update_load"):
+            self.router.update_load(device=device, **load)
+
+    @property
+    def wants_load(self) -> bool:
+        """True iff the active strategy actually SCORES load (queue-aware
+        perf) — a reference-semantics perf run must not pay per-request
+        admission-lock and slot-stat reads for a penalty that is
+        unconditionally zero."""
+        return (hasattr(self.router, "update_load")
+                and getattr(self.router, "queue_aware", False))
+
     def change_strategy(self, strategy: str) -> None:
         if strategy not in AVAILABLE_STRATEGIES:
             raise ValueError(f"Unknown strategy={strategy}")

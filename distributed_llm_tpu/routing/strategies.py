@@ -377,6 +377,16 @@ class PerfStrategy(BaseStrategy):
 
     On multi-host TPU deployments the per-tier samples are merged across hosts
     via the ICI/DCN health allgather (parallel/collectives.py) before scoring.
+
+    Queue-aware extension (production only, ``perf_queue_aware``): the
+    Router feeds each tier's live load — admission queue depth and batch
+    slot occupancy (serving/tiers.py ``load_snapshot``) — via
+    ``update_load`` before every decision, and the score adds
+    ``perf_queue_penalty_ms`` per queued request (plus a fractional term
+    for slot occupancy).  A saturated tier thus sheds quality-equivalent
+    traffic to an idle one BEFORE requests start timing out; the rolling
+    latency window alone only learns that after the damage.  Off by
+    default so BENCHMARK_CFG keeps the reference's exact scoring.
     """
 
     def __init__(self, config: Dict[str, Any]):
@@ -387,6 +397,17 @@ class PerfStrategy(BaseStrategy):
             "nano": deque(maxlen=self.window),
             "orin": deque(maxlen=self.window),
         }
+        self.queue_aware = bool(config.get("perf_queue_aware", False))
+        self.queue_penalty_ms = float(
+            config.get("perf_queue_penalty_ms", 50.0))
+        # device -> (queue_depth, slot_occupancy in [0,1]); plain dict
+        # swaps are atomic under the GIL, concurrent readers see either
+        # the old or the new snapshot.  Local and remote parts are kept
+        # SEPARATE: the Router refreshes the local part before every
+        # decision, while the health allgather refreshes the remote part
+        # on its own cadence — one feed must not clobber the other.
+        self._load: Dict[str, Tuple[float, float]] = {}
+        self._remote_load: Dict[str, Tuple[float, float]] = {}
         # Production-only exploration (PRODUCTION_CFG sets perf_explore;
         # benchmark mode keeps the reference's never-explore scoring —
         # see config.py for the rationale and PARITY.md for the
@@ -412,6 +433,29 @@ class PerfStrategy(BaseStrategy):
         for lat, tok, ok in remote:
             self.update(device, lat, tok, ok)
 
+    def update_load(self, device: str, queue_depth: float = 0.0,
+                    active_slots: float = 0.0, max_slots: float = 1.0,
+                    remote: bool = False) -> None:
+        """Record a tier's live load (queue depth + slot occupancy) for
+        the queue-aware score term.  The Router feeds the LOCAL part
+        before each decision; the mesh health allgather feeds the
+        cross-host sum with ``remote=True`` on its own cadence
+        (serving/health.py _exchange_load).  The two parts add in the
+        penalty — a per-decision local refresh must not clobber the
+        slower remote view."""
+        if device in self.samples:
+            occupancy = float(active_slots) / max(1.0, float(max_slots))
+            entry = (max(0.0, float(queue_depth)),
+                     min(1.0, max(0.0, occupancy)))
+            (self._remote_load if remote else self._load)[device] = entry
+
+    def _queue_penalty(self, device: str) -> float:
+        if not self.queue_aware:
+            return 0.0
+        depth, occupancy = self._load.get(device, (0.0, 0.0))
+        r_depth, r_occ = self._remote_load.get(device, (0.0, 0.0))
+        return self.queue_penalty_ms * (depth + occupancy + r_depth + r_occ)
+
     def _score(self, device: str) -> float:
         data = list(self.samples[device])
         if not data:
@@ -420,8 +464,10 @@ class PerfStrategy(BaseStrategy):
         total_tok = sum(s[1] for s in data)
         fail_rate = 1.0 - sum(1 for s in data if s[2]) / len(data)
         if total_tok == 0:
-            return total_lat / len(data) + self.fail_penalty * fail_rate
-        return total_lat / total_tok + self.fail_penalty * fail_rate
+            return (total_lat / len(data) + self.fail_penalty * fail_rate
+                    + self._queue_penalty(device))
+        return (total_lat / total_tok + self.fail_penalty * fail_rate
+                + self._queue_penalty(device))
 
     def _explore_probe(self) -> Optional[RoutingDecision]:
         """Deterministic staleness probe: route to the tier with no fresh
@@ -460,6 +506,18 @@ class PerfStrategy(BaseStrategy):
             return probe
         nano_s, orin_s = self._score("nano"), self._score("orin")
         if nano_s == float("inf") and orin_s == float("inf"):
+            if self.queue_aware:
+                # No latency history yet, but live load still
+                # discriminates: don't stack a saturated tier's queue
+                # while an idle one waits.
+                pen = {d: self._queue_penalty(d) for d in self.samples}
+                if pen["nano"] != pen["orin"]:
+                    device = min(pen, key=pen.get)
+                    return RoutingDecision(
+                        device, 0.3, "perf",
+                        f"no perf stats yet -> least-loaded {device} "
+                        f"(queue penalties nano={pen['nano']:.0f} "
+                        f"orin={pen['orin']:.0f})")
             return RoutingDecision("nano", 0.2, "perf",
                                    "no perf stats yet -> default nano")
         device = "orin" if orin_s < nano_s else "nano"

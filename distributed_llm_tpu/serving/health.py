@@ -192,7 +192,15 @@ class HealthMonitor:
     def exchange_health(self) -> Optional[Dict[str, np.ndarray]]:
         """All-gather each tier's perf summary over the mesh; fold rows
         owned by other processes into the local perf strategy.  Returns the
-        gathered rows per tier (None without a mesh or perf strategy)."""
+        gathered rows per tier (None without a mesh or perf strategy).
+
+        When the strategy is queue-aware, each tier's live load row
+        ([queue_depth, active_slots, max_slots], serving/tiers.py
+        load_snapshot) rides the same ICI allgather, and the local
+        strategy scores the cluster-wide totals — a tier saturated on
+        ANY host sheds traffic everywhere.  On a single host the Router
+        feeds the local snapshot directly (serving/router.py
+        _feed_perf_load); this exchange only adds the cross-host sum."""
         perf = self._perf_strategy()
         if self.mesh is None or perf is None:
             return None
@@ -204,7 +212,53 @@ class HealthMonitor:
             out = allgather_health(self.mesh, rows)   # own row in its slot
             gathered[tier_name] = out
             self._merge_gathered(perf, tier_name, out, remote_mask)
+        self._exchange_load(perf, n, remote_mask)
         return gathered
+
+    def _exchange_load(self, perf, n: int,
+                       remote_mask: Sequence[bool]) -> None:
+        """Allgather queue/slot load rows and feed the cluster-wide
+        totals into the queue-aware perf strategy (no-op when the
+        strategy isn't queue-aware).  Same participant convention as
+        the perf-window merge: each row along the mesh's first axis is
+        one contributor; remote rows sum on top of the local
+        snapshot."""
+        if not (getattr(perf, "queue_aware", False)
+                and hasattr(perf, "update_load")):
+            return
+        # Iterate the STRATEGY's fixed tier set (nano+orin on every
+        # host) and always run the allgather, contributing a zero row
+        # when the local tier has no load to report (remote-endpoint
+        # tier, or a transient snapshot failure): a mesh collective's
+        # call count must be identical on every participant, or this
+        # tick's load exchange pairs against another host's perf-window
+        # exchange and corrupts both (or hangs the mesh).
+        for name in perf.samples:
+            tier = self.router.tiers.get(name)
+            snap = None
+            snap_fn = getattr(tier, "load_snapshot", None)
+            if snap_fn is not None:
+                try:
+                    snap = snap_fn()
+                except Exception:
+                    snap = None
+            row = (np.array([snap["queue_depth"], snap["active_slots"],
+                             snap["max_slots"]], np.float32)
+                   if snap is not None else np.zeros(3, np.float32))
+            rows = np.tile(row, (n, 1))
+            out = allgather_health(self.mesh, rows)
+            # Remote rows ONLY: the local part is fed per-decision by
+            # the Router (_feed_perf_load) — summing it here too would
+            # double-count, and storing local+remote under one key would
+            # let the next local refresh clobber the remote view.
+            remote = np.zeros(3, np.float32)
+            for i, r in enumerate(out):
+                if remote_mask[i]:
+                    remote += r
+            perf.update_load(name, queue_depth=float(remote[0]),
+                             active_slots=float(remote[1]),
+                             max_slots=max(1.0, float(remote[2])),
+                             remote=True)
 
     @staticmethod
     def _merge_gathered(perf, tier_name: str, rows: np.ndarray,

@@ -51,7 +51,7 @@ def default_cluster(cpu_bench: bool = False) -> ClusterConfig:
     pretrained weights when ``checkpoints/<preset>`` exists
     (training/pretrain.py)."""
     from ..config import (cpu_bench_cluster, default_checkpoint,
-                          with_default_checkpoints)
+                          tiny_batched_cluster, with_default_checkpoints)
     if jax.default_backend() != "cpu":
         return with_default_checkpoints(bench_cluster())
     if cpu_bench:
@@ -59,7 +59,11 @@ def default_cluster(cpu_bench: bool = False) -> ClusterConfig:
         if all(default_checkpoint(t.model_preset)
                for t in cpu_pair.tiers()):
             return with_default_checkpoints(cpu_pair)
-    return with_default_checkpoints(tiny_cluster())
+    # Concurrent-by-default even on the tiny CPU fallback: serving entry
+    # points and the chipless bench get batched tiers (the unit suite
+    # builds tiny_cluster() directly and keeps the cheaper sequential
+    # warmup).
+    return with_default_checkpoints(tiny_batched_cluster())
 
 
 class Router:
@@ -271,6 +275,22 @@ class Router:
 
     # -- main pipeline -----------------------------------------------------
 
+    def _feed_perf_load(self) -> None:
+        """Queue-aware routing input: push each tier's live load
+        (admission queue depth + batch slot occupancy) into the active
+        strategy before it decides.  Cheap in-memory counters; skipped
+        entirely unless the strategy consumes them (perf only)."""
+        if not getattr(self.query_router, "wants_load", False):
+            return
+        for name, tier in self.tiers.items():
+            snap_fn = getattr(tier, "load_snapshot", None)
+            if snap_fn is None:
+                continue                     # remote tiers: no local load
+            try:
+                self.query_router.update_load(name, **snap_fn())
+            except Exception:
+                pass
+
     def _decide(self, query: str, context: str, ctx_hash: str,
                 history: List[Dict[str, Any]]):
         """The routing-decision stage shared by the sync and streaming
@@ -278,6 +298,7 @@ class Router:
         fallback on engine failure (src/router.py:258-270).  Returns
         (device, method, confidence, reasoning, cache_hit, overhead_ms)."""
         t0 = time.perf_counter()
+        self._feed_perf_load()
         device = "nano"
         method, confidence, reasoning = "unknown", 0.0, ""
         cache_hit = False

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -31,6 +32,115 @@ logger = logging.getLogger(__name__)
 
 History = Union[str, List[Dict[str, Any]]]
 
+# Chars a fully-clipped stream may silently drain during _PrimedStream's
+# eager first-delta pull before ClippedStream releases the primer with an
+# empty delta (worst case documented on ClippedStream): small enough that
+# priming never stalls ~a whole generation, large enough that ordinary
+# clipped turns finish their drain inside the prime.
+PRIME_DRAIN_CHARS = 256
+
+
+class AdmissionController:
+    """Bounded per-tier admission with predictive fail-fast.
+
+    The concurrency story for a batched tier is no longer a lock queue:
+    requests admit freely up to the engine's ``decode_batch`` slots, and
+    beyond that a bounded waiting line.  A request is REJECTED (reference
+    error shape, so Router failover and the perf fail penalty fire) when
+    either
+
+    - the waiting line is full (``tier.admission_max_queue`` requests
+      already waiting beyond the slots), or
+    - the EWMA of recent service times predicts this request would wait
+      past ``tier.request_timeout_s`` anyway — failing in microseconds
+      what would otherwise fail by timeout after blocking a thread for
+      the full cap.
+
+    Composes with the abandoned-worker accounting: an abandoned
+    timed-out call keeps its admission slot until the worker really
+    finishes (the engine genuinely is busy with it), so a wedged tier's
+    predicted wait grows and new traffic sheds to the healthy tier.
+    """
+
+    def __init__(self, tier: TierConfig, slots: Optional[int] = None):
+        self.tier = tier
+        # ``slots`` = the engine's REAL concurrency when the caller
+        # knows it differs from decode_batch (the speculative fallback
+        # serves sequentially) — admission believing in concurrency the
+        # engine doesn't have would admit N× what can be served.
+        self.slots = max(1, slots if slots is not None
+                         else tier.decode_batch)
+        self.max_queue = tier.admission_max_queue
+        self.timeout_s = tier.request_timeout_s
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._ewma_s: Optional[float] = None
+        self._alpha = 0.25                    # EWMA smoothing
+        self.admitted = 0
+        self.rejected = 0
+
+    def try_admit(self) -> Optional[str]:
+        """None = admitted (caller MUST release exactly once); else the
+        human-readable rejection reason."""
+        with self._lock:
+            waiting = max(0, self._inflight - self.slots)
+            # The line this request would JOIN: cap 0 means "slots only,
+            # nobody waits", not "reject even with free slots".
+            waiting_after = max(0, self._inflight + 1 - self.slots)
+            enabled = self.max_queue is not None   # None = control off
+            if enabled and waiting_after > self.max_queue:
+                self.rejected += 1
+                return (f"queue full ({waiting} waiting, "
+                        f"cap {self.max_queue})")
+            if enabled and self.timeout_s is not None and self._ewma_s:
+                # Queue wait only (queue_depth × EWMA / slots): a slow
+                # request with a free slot is the per-request timeout's
+                # job; admission rejects what would spend its whole
+                # budget WAITING.
+                predicted = (waiting / self.slots) * self._ewma_s
+                if predicted > self.timeout_s:
+                    self.rejected += 1
+                    return (f"predicted queue wait {predicted:.1f}s "
+                            f"exceeds the {self.timeout_s:.0f}s request "
+                            f"timeout (queue_depth={waiting}, "
+                            f"ewma_service={self._ewma_s:.2f}s)")
+            self._inflight += 1
+            self.admitted += 1
+            return None
+
+    def release(self, service_s: Optional[float] = None) -> None:
+        """End of an admitted request.  ``service_s`` (wall time the
+        engine was actually occupied — including timed-out calls, which
+        are exactly the slow evidence the EWMA exists to capture) feeds
+        the service-time estimate; pass None for requests that never
+        reached the engine (injected faults, setup failures)."""
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            if service_s is not None and service_s >= 0:
+                self._ewma_s = (service_s if self._ewma_s is None
+                                else (1 - self._alpha) * self._ewma_s
+                                + self._alpha * service_s)
+
+    # -- observability -----------------------------------------------------
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return max(0, self._inflight - self.slots)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            waiting = max(0, self._inflight - self.slots)
+            return {
+                "inflight": self._inflight,
+                "queue_depth": waiting,
+                "slots": self.slots,
+                "max_queue": self.max_queue,
+                "ewma_service_ms": (round(self._ewma_s * 1000.0, 2)
+                                    if self._ewma_s is not None else None),
+                "admitted": self.admitted,
+                "rejected": self.rejected,
+            }
+
 
 class TierClient:
     def __init__(
@@ -44,6 +154,22 @@ class TierClient:
         self.server_manager = manager          # name matches reference surface
         self.faults = fault_injector
         self.last_result: Optional[GenerationResult] = None
+        # Bounded admission replaces lock-serialization as the
+        # concurrency story; registered on the manager so health()
+        # snapshots expose queue depth next to slot occupancy.
+        # Slot count mirrors EngineManager's engine choice: a tier whose
+        # draft_preset will select the sequential speculative engine
+        # (greedy, unsharded — manager.py start_server) serves ONE
+        # stream regardless of decode_batch.
+        slots = max(1, tier.decode_batch)
+        if (tier.draft_preset and (tier.temperature or 0) <= 0
+                and getattr(manager, "mesh", None) is None):
+            slots = 1
+        self.admission = AdmissionController(tier, slots=slots)
+        try:
+            manager.admission = self.admission
+        except Exception:
+            pass                               # stub managers in tests
         # Serializes the sequential engines once request timeouts can
         # abandon a still-running worker thread (engines without
         # ``concurrent_safe`` assume serialized callers); the batched
@@ -71,19 +197,37 @@ class TierClient:
         for; its stale completion never overwrites ``last_result``.
         While an abandoned call is still outstanding on a serialized
         engine, new requests fail fast instead of spawning workers that
-        would only queue behind the wedged call."""
+        would only queue behind the wedged call.
+
+        Admission control runs FIRST (before fault injection, so a
+        rejected request cannot consume a one-shot injected fault): a
+        full waiting line or a predicted wait past the timeout returns
+        the reference error shape in microseconds instead of blocking a
+        serving thread for the full cap (AdmissionController)."""
+        admit_err = self.admission.try_admit()
+        if admit_err is not None:
+            logger.warning("tier %s admission rejected a request: %s",
+                           self.name, admit_err)
+            return {"error": f"Request failed: {self.name} admission "
+                             f"rejected: {admit_err}"}
         if self.faults is not None:
             fault = self.faults.intercept(self.name)
             if fault is not None:
+                self.admission.release()     # never reached the engine
                 return fault
 
         timeout = self.tier.request_timeout_s
         if timeout is None:
-            resp, result = self._process_body(history)
+            t0 = time.perf_counter()
+            try:
+                resp, result = self._process_body(history)
+            finally:
+                self.admission.release(time.perf_counter() - t0)
             if result is not None:
                 self.last_result = result
             return resp
         if self._abandoned and not self._engine_concurrent_safe():
+            self.admission.release()
             logger.warning("tier %s has an abandoned timed-out call "
                            "outstanding — failing fast", self.name)
             return {"error": f"Request failed: {self.name} is busy with "
@@ -94,6 +238,7 @@ class TierClient:
         def work():
             resp: Dict[str, Any] = {"error": "Request failed: worker died"}
             result = None
+            t0 = time.perf_counter()
             try:
                 resp, result = self._process_body(history)
             finally:
@@ -108,6 +253,11 @@ class TierClient:
                         self._abandoned -= 1
                     elif result is not None:
                         self.last_result = result
+                # The admission slot is held for the worker's whole
+                # life — an abandoned worker still occupies the engine,
+                # and its true duration is exactly the slow evidence
+                # the EWMA should see.
+                self.admission.release(time.perf_counter() - t0)
 
         threading.Thread(target=work, daemon=True,
                          name=f"{self.name}-request").start()
@@ -162,8 +312,17 @@ class TierClient:
         # Single-turn semantic: the corpus-trained LM continues the
         # transcript past its own turn; the serving layer clips it
         # (serving/turns.py — the reference gets this from Ollama's
-        # instruction-tuned models).
-        return {"response": clip_turn(result.text)}, result
+        # instruction-tuned models).  Per-request timing rides in the
+        # raw dict (additive keys; _extract_text/_is_error only read
+        # "response"/"error"): under concurrent clients the shared
+        # ``last_result`` can belong to another request, so this is the
+        # only race-free per-request TTFT a caller can observe.
+        resp: Dict[str, Any] = {"response": clip_turn(result.text)}
+        for key in ("ttft_ms", "total_ms", "gen_tokens"):
+            val = getattr(result, key, None)   # stub results may omit these
+            if val is not None:
+                resp[key] = round(val, 3) if isinstance(val, float) else val
+        return resp, result
 
     def process_stream(self, history: History):
         """Streaming twin of ``process``: returns a primed stream handle,
@@ -186,41 +345,105 @@ class TierClient:
         chip) or a stalled live stream holds it, this returns the
         reference error shape so Router stream failover and the perf
         failure penalty fire instead of the serving thread hanging
-        forever before priming."""
-        if self.faults is not None:
-            fault = self.faults.intercept(self.name)
-            if fault is not None:
-                return fault
+        forever before priming.
+
+        Streams occupy engine capacity like sync requests, so admission
+        control gates them the same way; the admission slot is released
+        exactly once when the stream finishes (exhaustion, close, or GC
+        of an unconsumed handle).  Holding the slot until the CONSUMER
+        drains is deliberate backpressure — slow SSE clients bound how
+        many streams a tier buffers — but the EWMA service time uses the
+        ENGINE-TRUE generation time from the final result when available
+        (wall drain time is dominated by client read pace, and feeding
+        it to the EWMA would let slow readers poison the predictive
+        fail-fast against an idle engine)."""
+        admit_err = self.admission.try_admit()
+        if admit_err is not None:
+            logger.warning("tier %s admission rejected a stream: %s",
+                           self.name, admit_err)
+            return {"error": f"Request failed: {self.name} admission "
+                             f"rejected: {admit_err}"}
+        t0 = time.perf_counter()
+        handle_box: Dict[str, Any] = {}
+
+        def finish_admission():
+            result = getattr(handle_box.get("handle"), "result", None)
+            engine_ms = getattr(result, "total_ms", 0) if result else 0
+            self.admission.release(engine_ms / 1000.0 if engine_ms
+                                   else time.perf_counter() - t0)
+
         try:
+            if self.faults is not None:
+                fault = self.faults.intercept(self.name)
+                if fault is not None:
+                    self.admission.release()   # never reached the engine
+                    return fault
             if not self.server_manager.is_server_running():
                 logger.info("No running %s engine found, starting...", self.name)
                 self.server_manager.start_server()
             engine = self.server_manager.engine()
             if not hasattr(engine, "generate_stream"):
+                self.admission.release()
                 return {"error": "Request failed: engine does not support "
                                  "token streaming"}
             if getattr(engine, "concurrent_safe", False):
-                return _PrimedStream(
-                    ClippedStream(engine.generate_stream(history)))
+                clipped = ClippedStream(
+                    engine.generate_stream(history),
+                    prime_drain_chars=PRIME_DRAIN_CHARS)
+                handle_box["handle"] = clipped
+                return _PrimedStream(clipped, release=finish_admission)
             timeout = self.tier.request_timeout_s
             acquired = (self._engine_lock.acquire(timeout=timeout)
                         if timeout is not None
                         else self._engine_lock.acquire())
             if not acquired:
+                self.admission.release()
                 logger.warning("tier %s stream setup could not take the "
                                "engine lock within %.0fs — failing over",
                                self.name, timeout)
                 return {"error": f"Request failed: {self.name} engine busy "
                                  f"after {timeout:.0f}s"}
+
+            def release_all():
+                self._engine_lock.release()
+                finish_admission()
+
             try:
-                return _PrimedStream(
-                    ClippedStream(engine.generate_stream(history)),
-                    release=self._engine_lock.release)
+                clipped = ClippedStream(
+                    engine.generate_stream(history),
+                    prime_drain_chars=PRIME_DRAIN_CHARS)
+                handle_box["handle"] = clipped
+                return _PrimedStream(clipped, release=release_all)
             except BaseException:
                 self._engine_lock.release()
                 raise
         except Exception as exc:
+            self.admission.release()
             return {"error": f"Request failed: {exc}"}
+
+    def load_snapshot(self) -> Dict[str, Any]:
+        """Live load signal for queue-aware perf routing: requests
+        waiting beyond the engine's concurrent slots, plus slot
+        occupancy.  Never starts an engine (a stopped tier reads idle);
+        cheap in-memory counters only."""
+        adm = self.admission.snapshot()
+        out = {"queue_depth": adm["queue_depth"],
+               "active_slots": min(adm["inflight"], adm["slots"]),
+               "max_slots": adm["slots"]}
+        engine = getattr(self.server_manager, "_engine", None)
+        slots = getattr(engine, "slot_stats", None)
+        if callable(slots):
+            try:
+                st = slots()
+                # The scheduler's view is sharper than admission's: its
+                # queue counts submitted-not-admitted requests.
+                out["queue_depth"] = max(out["queue_depth"],
+                                         st["queue_depth"])
+                out["active_slots"] = st["active_slots"]
+                out["max_slots"] = st["max_slots"]
+            except Exception:
+                pass
+        return out
 
 
 class _PrimedStream:
@@ -240,6 +463,11 @@ class _PrimedStream:
         self._exhausted = False
         try:
             self._first = next(self._it)
+            if self._first == "":
+                # ClippedStream's prime-release sentinel (a fully-
+                # clipped stream capping its silent drain): the prime
+                # succeeded, but there is no real first delta to replay.
+                self._first = None
         except StopIteration:
             self._exhausted = True
         except BaseException:

@@ -40,6 +40,27 @@ DEFAULT_TEMPERATURE = 0.0
 TIER_PORTS = {"nano": 5001, "orin": 5000}   # reference ports
 
 
+class _ReleaseOnce:
+    """Invoke ``fn`` exactly once — explicitly or via GC.  The stream
+    route's admission release lives in its generator's ``finally``, but
+    a WSGI layer can drop the response without ever STARTING the
+    generator (client gone before the first byte); close() on a
+    never-started generator runs no body, which would leak the slot
+    forever.  Holding the release in an object the generator (and only
+    the generator) references makes GC the backstop."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self) -> None:
+        fn, self._fn = self._fn, None
+        if fn is not None:
+            fn()
+
+    def __del__(self):
+        self()
+
+
 def create_tier_app(tier_name: str,
                     cluster: Optional[ClusterConfig] = None,
                     manager: Optional[EngineManager] = None) -> Flask:
@@ -52,6 +73,15 @@ def create_tier_app(tier_name: str,
             raise ValueError(f"unknown tier {tier_name!r}")
         manager = tiers[tier_name].server_manager
     app.extensions["dllm_manager"] = manager
+    # Admission also gates the CROSS-HOST path: in-process requests go
+    # through TierClient (which registers the controller on the
+    # manager), but a remote router POSTs here directly — without this
+    # gate a saturated remote tier would queue unboundedly.  A rejected
+    # request gets 503 (urllib surfaces it as an error → RemoteTierClient
+    # returns the reference error shape → Router failover fires).
+    # Directly-passed managers (unit tests, bespoke deployments) may
+    # carry no controller; then the gate is a no-op.
+    admission = getattr(manager, "admission", None)
 
     @app.route("/")
     def home():
@@ -79,6 +109,14 @@ def create_tier_app(tier_name: str,
             return jsonify({"error": "num_predict/temperature must be numeric"}), 400
         max_new = num_predict if num_predict > 0 else None
 
+        if admission is not None:
+            admit_err = admission.try_admit()
+            if admit_err is not None:
+                return jsonify({"error": f"Request failed: {tier_name} "
+                                         f"admission rejected: "
+                                         f"{admit_err}"}), 503
+        import time as _time
+        t0 = _time.perf_counter()
         try:
             result = manager.engine().generate(
                 query, max_new_tokens=max_new, temperature=temperature)
@@ -101,6 +139,9 @@ def create_tier_app(tier_name: str,
         except Exception as exc:
             logger.exception("inference failed")
             return jsonify({"error": f"Inference failed: {exc}"}), 500
+        finally:
+            if admission is not None:
+                admission.release(_time.perf_counter() - t0)
 
     @app.route("/query/stream", methods=["POST"])
     def process_query_stream():
@@ -124,6 +165,14 @@ def create_tier_app(tier_name: str,
             return jsonify({"error": "num_predict/temperature must be "
                                      "numeric"}), 400
         max_new = num_predict if num_predict > 0 else None
+        if admission is not None:
+            admit_err = admission.try_admit()
+            if admit_err is not None:
+                return jsonify({"error": f"Request failed: {tier_name} "
+                                         f"admission rejected: "
+                                         f"{admit_err}"}), 503
+        import time as _time
+        t0 = _time.perf_counter()
         try:
             from .turns import ClippedStream
             handle = ClippedStream(
@@ -132,10 +181,26 @@ def create_tier_app(tier_name: str,
         except NotImplementedError as exc:
             # e.g. the speculative engine is greedy-only: keep the JSON
             # error contract instead of a framework 500 page.
+            if admission is not None:
+                admission.release()
             return jsonify({"error": str(exc)}), 501
         except Exception as exc:
             logger.exception("stream setup failed")
+            if admission is not None:
+                admission.release()
             return jsonify({"error": f"Inference failed: {exc}"}), 500
+
+        def _release_slot():
+            if admission is None:
+                return
+            # Engine-true generation time when the stream completed;
+            # wall time otherwise (client disconnect mid-generation).
+            result = getattr(handle, "result", None)
+            engine_ms = getattr(result, "total_ms", 0) if result else 0
+            admission.release(engine_ms / 1000.0 if engine_ms
+                              else _time.perf_counter() - t0)
+
+        release = _ReleaseOnce(_release_slot)
 
         def events():
             try:
@@ -144,6 +209,11 @@ def create_tier_app(tier_name: str,
                 yield sse_done_event(handle.result)
             except Exception as exc:
                 yield sse_event({"error": str(exc)})
+            finally:
+                # Exactly once: exhaustion, client disconnect (generator
+                # close), or — if the generator is dropped before it ever
+                # starts — GC of the _ReleaseOnce it closes over.
+                release()
 
         return streaming_response(events())
 

@@ -71,10 +71,25 @@ class ClippedStream:
     bounded by the tier's ``max_new_tokens`` decode cap (48-128 across
     the shipped clusters) — the same budget the sync path always
     spends, since it clips after the fact.
+
+    WORST CASE (and the ``prime_drain_chars`` cap): when the model emits
+    a role marker from token one, nothing is ever emitted and a single
+    ``next()`` on this stream blocks for the ENTIRE drain — up to
+    max_new_tokens of decoding.  A caller that eagerly primes the first
+    delta before handing the stream out (serving/tiers.py
+    ``_PrimedStream``, which holds the sequential engine lock while
+    priming) would stall its serving thread for a full generation before
+    the handle is even returned.  ``prime_drain_chars`` caps that: once
+    a fully-clipped stream has silently drained that many characters, an
+    EMPTY delta is yielded once so the primer's ``next()`` returns; the
+    remaining drain then happens lazily as the consumer iterates.
+    Consumers must tolerate one "" delta (``_PrimedStream`` swallows
+    it).  None keeps the uncapped r5 behavior.
     """
 
-    def __init__(self, handle):
+    def __init__(self, handle, prime_drain_chars: Optional[int] = None):
         self._handle = handle
+        self._prime_drain_chars = prime_drain_chars
         self._emitted_any = False
 
     def __iter__(self) -> Iterator[str]:
@@ -85,9 +100,19 @@ class ClippedStream:
         buf_line_start = True
         label_checked = False
         clipped = False
+        drained = 0               # chars silently drained after a clip
+        prime_released = False
         for delta in self._handle:
             if clipped:
-                continue          # drain for result/lock, emit nothing
+                # Drain for result/lock, emit nothing — but release an
+                # eager primer once (see class docstring worst case).
+                drained += len(delta)
+                if (self._prime_drain_chars is not None
+                        and not self._emitted_any and not prime_released
+                        and drained >= self._prime_drain_chars):
+                    prime_released = True
+                    yield ""
+                continue
             buf += delta
             if not label_checked:
                 # Wait until the buffer can't be a partial leading label.

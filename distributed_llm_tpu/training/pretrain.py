@@ -122,7 +122,9 @@ def pretrain(preset: str, out: str, *,
             trainer.save(out)
         if step >= max_steps:
             break
-    path = trainer.save(out)
+    # None = the loop's save_every save already published this exact
+    # step (save skipped, state identical) — report the root it lives at.
+    path = trainer.save(out) or out
     log(f"[pretrain] saved {path} at step {trainer.step_count} "
         f"(loss={final:.4f})")
     return {"steps": trainer.step_count, "final_loss": final,

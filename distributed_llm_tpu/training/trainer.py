@@ -184,8 +184,12 @@ class Trainer:
 
     # -- checkpoint/resume (utils/checkpoint.py) ---------------------------
 
-    def save(self, path: str) -> str:
-        """Checkpoint params + optimizer state + step counter."""
+    def save(self, path: str) -> "Optional[str]":
+        """Checkpoint params + optimizer state + step counter.  Returns
+        the checkpoint root, or None when the save was skipped because
+        this exact step is already the published 'latest'
+        (utils/checkpoint.save_train_state) — advance a step and retry
+        if this run's state genuinely differs."""
         from ..utils.checkpoint import save_train_state
         return save_train_state(path, self)
 

@@ -110,9 +110,15 @@ def _prune_versions(root: str, keep: int = 2) -> None:
             shutil.rmtree(d, ignore_errors=True)
 
 
-def save_train_state(path: str, trainer) -> str:
+def save_train_state(path: str, trainer) -> Optional[str]:
     """Checkpoint params + optimizer moments + step counter under a new
-    ``v<step>`` version, then atomically publish it as ``latest``."""
+    ``v<step>`` version, then atomically publish it as ``latest``.
+
+    Returns the checkpoint root, or **None when the save was skipped**
+    because this exact step is already the published ``latest`` — the
+    caller can then advance a step and retry if its state genuinely
+    differs (resume from an older version reached by a different path);
+    a log warning alone gave no programmatic signal (ADVICE r5)."""
     root = _abspath(path)
     os.makedirs(root, exist_ok=True)
     version_dir = os.path.join(root, f"v{trainer.step_count}")
@@ -125,14 +131,14 @@ def save_train_state(path: str, trainer) -> str:
         # invariant.  In the in-run double-save case the state is
         # identical; a run that reaches the published step by a
         # DIFFERENT path (resumed from an older version) is discarded
-        # here, hence the warning — step once more to publish such a
-        # state under a fresh version.
+        # here — None tells the caller, who can step once more to
+        # publish such a state under a fresh version.
         logging.getLogger(__name__).warning(
             "save skipped: %s is already the published 'latest' at step "
             "%d; if this run's state differs (resume from an older "
             "version), advance one step so it publishes under a new "
             "version", version_dir, trainer.step_count)
-        return root
+        return None
     # A stale same-step dir from an abandoned/rolled-back run is NOT the
     # published artifact; orbax force-overwrites it below.
     save_checkpoint(os.path.join(version_dir, "state"), {

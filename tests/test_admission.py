@@ -1,0 +1,431 @@
+"""Admission-controlled serving + queue-aware perf routing.
+
+The concurrency story for a batched tier (ISSUE 1 tentpole): requests
+admit up to the engine's decode_batch slots plus a bounded waiting line
+(serving/tiers.py AdmissionController); past the bound — or when the
+EWMA of service times predicts the wait would blow the request timeout —
+they fail fast with the reference error shape, so Router failover and the
+perf fail penalty fire instead of the queue growing without bound.  The
+live load (queue depth + slot occupancy) is exposed through
+EngineManager.health() and fed into the perf strategy, which sheds
+traffic off a saturated tier.
+"""
+
+import dataclasses
+import threading
+import time
+
+import pytest
+
+from distributed_llm_tpu.config import (BENCHMARK_CFG, TierConfig,
+                                        tiny_batched_cluster, tiny_cluster)
+from distributed_llm_tpu.engine.manager import EngineManager
+from distributed_llm_tpu.routing.strategies import PerfStrategy
+from distributed_llm_tpu.serving.tiers import AdmissionController, TierClient
+
+
+def _tier(**kw):
+    defaults = dict(name="nano", model_preset="nano_test", max_new_tokens=6,
+                    prefill_buckets=(16, 32, 64), kv_block_size=16)
+    defaults.update(kw)
+    return TierConfig(**defaults)
+
+
+class _StubManager:
+    def __init__(self, engine):
+        self._engine = engine
+
+    def is_server_running(self):
+        return True
+
+    def engine(self):
+        return self._engine
+
+
+# -- AdmissionController unit semantics -------------------------------------
+
+def test_admission_hard_queue_bound():
+    ac = AdmissionController(_tier(decode_batch=2, admission_max_queue=1,
+                                   request_timeout_s=None))
+    assert ac.try_admit() is None            # slot 1
+    assert ac.try_admit() is None            # slot 2
+    assert ac.try_admit() is None            # the one allowed waiter
+    err = ac.try_admit()
+    assert err is not None and "queue full" in err
+    assert ac.snapshot()["rejected"] == 1
+    ac.release(0.01)                         # a slot frees
+    assert ac.try_admit() is None
+
+
+def test_admission_predictive_fail_fast():
+    """queue_depth × EWMA service time past the request timeout rejects
+    in microseconds — but a slow request with a FREE slot still admits
+    (its own duration is the per-request timeout's job, not admission's)."""
+    ac = AdmissionController(_tier(decode_batch=1, admission_max_queue=10,
+                                   request_timeout_s=1.0))
+    assert ac.try_admit() is None
+    ac.release(5.0)                          # EWMA now 5 s >> 1 s timeout
+    assert ac.try_admit() is None            # free slot: admitted anyway
+    assert ac.try_admit() is None            # first waiter: zero queue ahead
+    err = ac.try_admit()                     # second waiter: 5 s wait ahead
+    assert err is not None and "predicted queue wait" in err
+    snap = ac.snapshot()
+    assert snap["ewma_service_ms"] == pytest.approx(5000.0)
+    assert snap["queue_depth"] == 1
+
+
+def test_admission_disabled_with_none_queue():
+    ac = AdmissionController(_tier(decode_batch=1, admission_max_queue=None,
+                                   request_timeout_s=0.001))
+    for _ in range(64):
+        assert ac.try_admit() is None        # control off: never rejects
+    assert ac.snapshot()["inflight"] == 64
+
+
+def test_admission_release_floor_and_ewma():
+    ac = AdmissionController(_tier(decode_batch=1))
+    ac.release(1.0)                          # spurious release: floor at 0
+    assert ac.snapshot()["inflight"] == 0
+    assert ac.try_admit() is None
+    ac.release(1.0)
+    ac.release(None)                         # no-service release: EWMA kept
+    assert ac.snapshot()["ewma_service_ms"] == pytest.approx(1000.0)
+
+
+# -- TierClient integration --------------------------------------------------
+
+def test_tier_client_admission_fail_fast_under_saturation():
+    """With all slots busy and the waiting line full, a new request gets
+    the reference error shape immediately instead of queueing."""
+    release = threading.Event()
+
+    class Hanging:
+        concurrent_safe = True               # no lock serialization
+
+        def generate(self, history, **kw):
+            release.wait(30)
+
+            class R:
+                text = "ok"
+            return R()
+
+    client = TierClient(_tier(decode_batch=2, admission_max_queue=1,
+                              request_timeout_s=None),
+                        _StubManager(Hanging()))
+    outs = {}
+
+    def go(i):
+        outs[i] = client.process(f"q{i}")
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 5
+    while (client.admission.snapshot()["inflight"] < 3
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    assert client.admission.snapshot()["inflight"] == 3
+    out = client.process("q-overflow")       # 2 slots + 1 waiter: full
+    assert "admission rejected" in out.get("error", ""), out
+    assert "queue full" in out["error"]
+    release.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert all("response" in o for o in outs.values()), outs
+    assert client.admission.snapshot()["inflight"] == 0
+
+
+def test_admission_slot_held_by_abandoned_worker():
+    """A timed-out (abandoned) worker keeps its admission slot until the
+    engine call really finishes — composing the two accountings: the
+    tier looks busy because it IS busy."""
+    release = threading.Event()
+
+    class Wedged:
+        concurrent_safe = True
+
+        def generate(self, history, **kw):
+            release.wait(30)
+
+            class R:
+                text = "late"
+            return R()
+
+    client = TierClient(_tier(decode_batch=1, admission_max_queue=0,
+                              request_timeout_s=0.1),
+                        _StubManager(Wedged()))
+    out = client.process("will time out")
+    assert "timed out" in out["error"]
+    # Abandoned worker still holds the slot; queue cap 0 → reject.
+    out2 = client.process("while wedged")
+    assert "admission rejected" in out2["error"]
+    release.set()
+    deadline = time.monotonic() + 5
+    while (client.admission.snapshot()["inflight"] > 0
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    assert client.admission.snapshot()["inflight"] == 0
+    assert client.last_result is None        # stale completion never lands
+
+
+def test_admission_rejection_does_not_consume_injected_fault():
+    """Admission runs before fault interception: a rejected request must
+    not eat a one-shot scripted fault meant for the next served one."""
+    from distributed_llm_tpu.utils.faults import FaultInjector
+
+    hold = threading.Event()
+    started = threading.Event()
+
+    class Slow:
+        concurrent_safe = True
+
+        def generate(self, history, **kw):
+            started.set()
+            hold.wait(10)
+
+            class R:
+                text = "ok"
+            return R()
+
+    fi = FaultInjector()
+    client = TierClient(_tier(decode_batch=1, admission_max_queue=0,
+                              request_timeout_s=None),
+                        _StubManager(Slow()), fault_injector=fi)
+    holder = threading.Thread(target=client.process, args=("slow",))
+    holder.start()
+    assert started.wait(5)                   # holder is inside the engine
+    fi.timeout_next("nano")                  # fault for the NEXT served call
+    out = client.process("rejected")
+    assert "admission rejected" in out["error"]
+    hold.set()
+    holder.join(timeout=10)
+    out2 = client.process("served next")     # the fault is still queued
+    assert "timed out on Nano" in out2["error"]
+
+
+# -- health() / telemetry exposure -------------------------------------------
+
+def test_health_exposes_queue_depth_and_slot_occupancy_batched():
+    tier = _tier(decode_batch=3)
+    mgr = EngineManager(tier, warmup_on_start=False)
+    client = TierClient(tier, mgr)
+    try:
+        client.process("user: hello")
+        h = mgr.health()
+        assert h["ok"] and h["max_slots"] == 3
+        assert h["queue_depth"] == 0 and h["active_slots"] == 0
+        assert h["slot_occupancy"] == 0.0
+        adm = h["admission"]
+        assert adm["admitted"] == 1 and adm["rejected"] == 0
+        assert adm["ewma_service_ms"] > 0
+        snap = client.load_snapshot()
+        assert snap == {"queue_depth": 0, "active_slots": 0,
+                        "max_slots": 3}
+    finally:
+        mgr.stop_server()
+
+
+def test_health_exposes_slots_for_sequential_tier():
+    tier = _tier(decode_batch=1)
+    mgr = EngineManager(tier, warmup_on_start=False)
+    TierClient(tier, mgr)                    # registers admission
+    mgr.start_server()
+    try:
+        h = mgr.health()
+        assert h["max_slots"] == 1 and h["active_slots"] == 0
+        assert h["queue_depth"] == 0 and "admission" in h
+    finally:
+        mgr.stop_server()
+
+
+def test_batched_engine_slot_stats_under_load():
+    from distributed_llm_tpu.engine.batching import ContinuousBatchingEngine
+
+    engine = ContinuousBatchingEngine(_tier(decode_batch=2), seed=7)
+    try:
+        reqs = [engine.submit(f"user: q {i}", max_new_tokens=4)
+                for i in range(5)]
+        st = engine.slot_stats()
+        assert set(st) == {"queue_depth", "active_slots", "max_slots",
+                           "slot_occupancy"}
+        assert st["max_slots"] == 2
+        for r in reqs:
+            assert r.done.wait(timeout=60)
+        st2 = engine.slot_stats()
+        assert st2["active_slots"] == 0 and st2["queue_depth"] == 0
+    finally:
+        engine.stop()
+
+
+# -- queue-aware perf routing ------------------------------------------------
+
+def _fed_perf(queue_aware: bool) -> PerfStrategy:
+    cfg = dict(BENCHMARK_CFG)
+    if queue_aware:
+        cfg["perf_queue_aware"] = True
+        cfg["perf_queue_penalty_ms"] = 50.0
+    strat = PerfStrategy(cfg)
+    for dev in ("nano", "orin"):             # identical latency history
+        strat.update(dev, 100.0, 10, ok=True)
+    strat.update_load("nano", queue_depth=6, active_slots=4, max_slots=4)
+    strat.update_load("orin", queue_depth=0, active_slots=0, max_slots=4)
+    return strat
+
+
+def test_perf_strategy_routes_away_from_saturated_tier():
+    """The acceptance-criteria unit test: equal latency scores, nano
+    saturated (6 queued + full slots), orin idle → queue-aware perf
+    routes to orin; with queue awareness off (reference semantics) the
+    tie still resolves to nano."""
+    aware = _fed_perf(queue_aware=True)
+    d = aware.route("any question")
+    assert d.device == "orin", d.reasoning
+
+    reference = _fed_perf(queue_aware=False)
+    assert reference.route("any question").device == "nano"
+
+
+def test_perf_remote_load_survives_local_refresh():
+    """The Router refreshes the LOCAL load before every decision; the
+    mesh allgather feeds the REMOTE sum on its own cadence.  A local
+    refresh must not clobber the remote view (code review r6): a tier
+    saturated on another host keeps shedding here even while the local
+    counters read idle."""
+    cfg = dict(BENCHMARK_CFG)
+    cfg["perf_queue_aware"] = True
+    strat = PerfStrategy(cfg)
+    for dev in ("nano", "orin"):
+        strat.update(dev, 100.0, 10, ok=True)
+    # Remote hosts report nano saturated; locally both tiers are idle.
+    strat.update_load("nano", queue_depth=8, active_slots=4, max_slots=4,
+                      remote=True)
+    strat.update_load("nano", queue_depth=0, active_slots=0, max_slots=4)
+    strat.update_load("orin", queue_depth=0, active_slots=0, max_slots=4)
+    assert strat.route("q").device == "orin"
+    # Remote view cleared (next allgather says idle) -> tie back to nano.
+    strat.update_load("nano", queue_depth=0, active_slots=0, max_slots=4,
+                      remote=True)
+    assert strat.route("q").device == "nano"
+
+
+def test_perf_strategy_least_loaded_default_without_samples():
+    cfg = dict(BENCHMARK_CFG)
+    cfg["perf_queue_aware"] = True
+    strat = PerfStrategy(cfg)
+    strat.update_load("nano", queue_depth=4, active_slots=1, max_slots=1)
+    d = strat.route("cold start")
+    assert d.device == "orin" and "least-loaded" in d.reasoning
+
+
+class _HeldNano:
+    """Context helper: a perf Router on tiny tiers whose nano slot is
+    held busy by a hanging request from another thread."""
+
+    def __init__(self, queue_aware: bool):
+        from distributed_llm_tpu.config import ClusterConfig
+        from distributed_llm_tpu.serving.router import Router
+
+        tiny = tiny_cluster()
+        cluster = ClusterConfig(
+            nano=dataclasses.replace(tiny.nano, decode_batch=1,
+                                     admission_max_queue=0,
+                                     request_timeout_s=None),
+            orin=dataclasses.replace(tiny.orin, tp=1, decode_batch=2))
+        cfg = dict(BENCHMARK_CFG)
+        cfg["perf_queue_aware"] = queue_aware
+        self.router = Router(strategy="perf", benchmark_mode=True,
+                             config=cfg, cluster=cluster)
+        self.release = threading.Event()
+        self.entered = threading.Event()
+        self.holder = None
+
+    def __enter__(self):
+        # Warm both engines so the saturating thread isn't stuck compiling.
+        for tier in self.router.tiers.values():
+            tier.server_manager.start_server()
+        nano_eng = self.router.tiers["nano"].server_manager.engine()
+        real_generate = nano_eng.generate
+
+        def slow_generate(history, **kw):
+            self.entered.set()
+            self.release.wait(20)
+            return real_generate(history, **kw)
+
+        nano_eng.generate = slow_generate
+        self.holder = threading.Thread(
+            target=self.router.tiers["nano"].process, args=("user: hold",))
+        self.holder.start()
+        assert self.entered.wait(10)
+        return self.router
+
+    def __exit__(self, *exc):
+        self.release.set()
+        if self.holder is not None:
+            self.holder.join(timeout=20)
+        for tier in self.router.tiers.values():
+            tier.server_manager.stop_server()
+        return False
+
+
+def test_router_fails_over_on_admission_reject():
+    """Reference perf semantics (no queue awareness) default cold
+    traffic to nano; the saturated nano admission-rejects, the Router
+    fails over to orin, and the primary's failure lands in the perf
+    window (fail penalty steers later traffic off the full tier)."""
+    with _HeldNano(queue_aware=False) as router:
+        resp, _tok, device = router.route_query(
+            [{"role": "user", "content": "hello there"}])
+        assert device == "orin"
+        assert resp["ok"] and resp["response"]
+        assert router.tiers["nano"].admission.rejected >= 1
+        perf = router.query_router.router
+        assert any(not ok for _l, _t, ok in perf.samples["nano"])
+
+
+def test_router_queue_aware_sheds_before_rejecting():
+    """With queue awareness ON the Router's load feed makes perf route
+    AROUND the busy nano — no admission rejection, no failover: the
+    queue signal acts before the damage, not after."""
+    with _HeldNano(queue_aware=True) as router:
+        resp, _tok, device = router.route_query(
+            [{"role": "user", "content": "hello there"}])
+        assert device == "orin"
+        assert resp["ok"]
+        assert router.tiers["nano"].admission.rejected == 0
+
+
+def test_admission_slots_follow_speculative_fallback():
+    """A draft_preset tier serves the SEQUENTIAL speculative engine no
+    matter its decode_batch (manager fallback) — admission and health
+    must reflect that real concurrency of 1, not the configured batch
+    (code review r6: admission believing in 4 slots would admit 4× what
+    the engine can serve and suppress the fail-fast)."""
+    from distributed_llm_tpu.engine.speculative import SpeculativeEngine
+
+    tier = _tier(decode_batch=4, draft_preset="nano_test")
+    mgr = EngineManager(tier, warmup_on_start=False)
+    client = TierClient(tier, mgr)
+    try:
+        assert client.admission.slots == 1
+        assert isinstance(mgr.engine(), SpeculativeEngine)
+        assert mgr.health()["max_slots"] == 1
+        assert client.load_snapshot()["max_slots"] == 1
+    finally:
+        mgr.stop_server()
+
+
+def test_tiny_batched_cluster_builds_batching_engines():
+    """The concurrent-by-default serving path at test scale: the batched
+    tiny cluster's managers build ContinuousBatchingEngine."""
+    from distributed_llm_tpu.engine.batching import ContinuousBatchingEngine
+    from distributed_llm_tpu.serving.tiers import build_tiers
+
+    tiers = build_tiers(tiny_batched_cluster(), warmup_on_start=False)
+    try:
+        for name, client in tiers.items():
+            engine = client.server_manager.engine()
+            assert isinstance(engine, ContinuousBatchingEngine), name
+            assert engine.paged.max_slots > 1
+    finally:
+        for client in tiers.values():
+            client.server_manager.stop_server()

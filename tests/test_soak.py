@@ -96,6 +96,73 @@ def test_soak_mixed_concurrent_traffic():
             tier.server_manager.stop_server()
 
 
+def test_soak_router_batched_default_mixed_strategies():
+    """ISSUE 1 satellite: N client threads straight through Router →
+    TierClient on the concurrent-by-default batched tiers, mixed
+    strategies hot-swapping mid-soak, one tier under a request timeout
+    (abandoned-worker path live) — no deadlock, coherent responses, and
+    the admission counters stay balanced (every admit released)."""
+    import time
+
+    from distributed_llm_tpu.config import tiny_batched_cluster
+    from distributed_llm_tpu.serving.router import Router
+
+    batched = tiny_batched_cluster()
+    cluster = ClusterConfig(
+        nano=dataclasses.replace(batched.nano, max_new_tokens=6,
+                                 request_timeout_s=30.0,
+                                 admission_max_queue=8),
+        orin=dataclasses.replace(batched.orin, tp=1, max_new_tokens=6,
+                                 admission_max_queue=8))
+    router = Router(strategy="hybrid", benchmark_mode=True, cluster=cluster)
+    errors = []
+    strategies = ("token", "semantic", "heuristic", "hybrid", "perf")
+
+    def client(i: int):
+        try:
+            hist = []
+            for turn in range(3):
+                hist.append({"role": "user",
+                             "content": f"client {i} turn {turn}: tell me "
+                                        f"about rivers and topic {i}"})
+                resp, _tok, dev = router.route_query(hist[-6:])
+                assert dev in ("nano", "orin"), dev
+                assert "response" in resp
+                hist.append({"role": "assistant",
+                             "content": resp.get("response", "")})
+        except BaseException as exc:      # noqa: BLE001 — collect, don't die
+            errors.append(("client", i, repr(exc)))
+
+    def strategy_cycler():
+        try:
+            for s in strategies:
+                router.query_router.change_strategy(s)
+                time.sleep(0.02)
+        except BaseException as exc:
+            errors.append(("strategy", 0, repr(exc)))
+
+    try:
+        threads = ([threading.Thread(target=client, args=(i,),
+                                     name=f"rclient-{i}") for i in range(5)]
+                   + [threading.Thread(target=strategy_cycler,
+                                       name="strategies")])
+        _run_all(threads, errors)
+        # Admission accounting balanced: nothing leaked an in-flight slot.
+        total_admitted = 0
+        for name, tier in router.tiers.items():
+            snap = tier.admission.snapshot()
+            assert snap["inflight"] == 0, (name, snap)
+            total_admitted += snap["admitted"]
+        assert total_admitted >= 15          # every turn admitted somewhere
+        # Health snapshots expose the load fields after real traffic.
+        h = router.tiers["nano"].server_manager.health()
+        assert {"queue_depth", "active_slots", "max_slots",
+                "slot_occupancy", "admission"} <= set(h)
+    finally:
+        for tier in router.tiers.values():
+            tier.server_manager.stop_server()
+
+
 def test_soak_streaming_alongside_sync_requests():
     """SSE streams and synchronous queries interleave on one batched tier
     without deadlock or cross-talk."""

@@ -93,6 +93,50 @@ def test_clipped_stream_quoted_marker_on_cut_boundary_not_clipped():
     assert "".join(ClippedStream(_FakeHandle(deltas))) == "First line okay"
 
 
+def test_clipped_stream_prime_drain_cap_releases_early():
+    """ADVICE r5 tiers.py:204: a marker from token one makes the clipped
+    drain consume the WHOLE generation inside a single next() — with
+    ``prime_drain_chars`` the stream yields one empty delta once that
+    many chars have drained, so an eager primer returns early; the rest
+    drains lazily and the degenerate fallback still lands."""
+    # An echoed label then a transcript from token one: nothing ever
+    # emits, so the whole stream would drain inside the first next().
+    deltas = (["assistant:\n", "user: filler question?\n"]
+              + ["assistant: filler words. "] * 20)
+    s = ClippedStream(_FakeHandle(deltas, text="assistant: only labels"),
+                      prime_drain_chars=30)
+    it = iter(s)
+    first = next(it)
+    assert first == ""                       # prime released, not blocked
+    rest = list(it)
+    assert rest == ["assistant: only labels"]  # degenerate fallback at end
+
+
+def test_clipped_stream_prime_cap_noop_for_normal_streams():
+    """The cap must not inject empty deltas into streams that emit real
+    text (the primer sentinel only fires on fully-clipped streams)."""
+    deltas = ["Hello ", "there, ", "rivers are long."]
+    out = list(ClippedStream(_FakeHandle(deltas), prime_drain_chars=4))
+    assert "" not in out
+    assert "".join(out) == "Hello there, rivers are long."
+
+
+def test_primed_stream_swallows_prime_sentinel():
+    """Through TierClient's primer: the empty release delta never
+    reaches the consumer, and the stream still ends with the fallback."""
+    from distributed_llm_tpu.serving.tiers import _PrimedStream
+
+    deltas = (["assistant:\n", "user: filler question?\n"]
+              + ["assistant: more filler text. "] * 20)
+    clipped = ClippedStream(_FakeHandle(deltas, text="assistant: labels"),
+                            prime_drain_chars=30)
+    released = []
+    primed = _PrimedStream(clipped, release=lambda: released.append(1))
+    out = list(primed)
+    assert "" not in out and out == ["assistant: labels"]
+    assert released == [1]                   # release fired exactly once
+
+
 def test_tier_process_clips_served_reply():
     """End-to-end through TierClient.process: a transcript-continuing
     generation serves only its own turn."""
@@ -122,4 +166,7 @@ def test_tier_process_clips_served_reply():
     tier = TierClient(TierConfig(name="nano", model_preset="nano_test",
                                  request_timeout_s=None), FakeManager())
     resp = tier.process([{"role": "user", "content": "capital of Japan?"}])
-    assert resp == {"response": "It is Tokyo."}
+    assert resp["response"] == "It is Tokyo."
+    # Per-request timing rides in the raw dict (additive keys so
+    # concurrent bench clients get race-free TTFT; serving/tiers.py).
+    assert resp["ttft_ms"] == 1.0 and resp["gen_tokens"] == 12
