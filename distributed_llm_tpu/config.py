@@ -340,6 +340,18 @@ class TierConfig:
     # semantics where the Jetson keeps crunching after the client
     # times out.
     request_timeout_s: Optional[float] = 180.0
+    # Decode-watchdog deadline (serving/health.py + engine/batching.py):
+    # a batched engine with admitted/queued work but NO step progress
+    # (tick completion, admission, or idle heartbeat) for this many
+    # seconds is declared wedged — the round-5 failure mode, where the
+    # chip hung inside a device call and only probe-count escalation
+    # (minutes later) would have noticed.  EngineManager.health() flips
+    # unhealthy past the deadline and the HealthMonitor restarts the
+    # engine IMMEDIATELY through its existing bounded restart path.
+    # Generous default: a mid-serve XLA retrace (deeper decode window
+    # rung) legitimately stalls the loop for tens of seconds on chip.
+    # None disables the watchdog.
+    watchdog_stall_s: Optional[float] = 300.0
 
     def model(self) -> ModelConfig:
         return MODEL_PRESETS[self.model_preset]
@@ -364,6 +376,26 @@ class ClusterConfig:
         default_factory=lambda: TierConfig(name="orin", model_preset="orin_8b",
                                            tp=4, decode_batch=4))
     seed: int = 0
+    # Per-tier circuit breaker (serving/breaker.py): after
+    # ``breaker_failures`` CONSECUTIVE error-shaped results a tier goes
+    # OPEN and sheds all traffic for ``breaker_cooldown_s``, then a
+    # single half-open canary request (or a HealthMonitor probe) decides
+    # between closing and re-opening.  The threshold is deliberately
+    # above the one-shot faults the unit suite scripts (a single
+    # injected failure must keep reference failover semantics);
+    # breaker_failures=0 disables the breaker entirely.
+    breaker_failures: int = 5
+    breaker_cooldown_s: float = 30.0
+    # Bounded retry for TRANSIENT error shapes (connection refused/reset,
+    # engine-returned-no-result — not timeouts, which already consumed
+    # their whole budget): up to ``retry_attempts`` re-issues on the SAME
+    # tier with jittered exponential backoff starting at
+    # ``retry_backoff_s``.  No retry starts past the primary tier's
+    # request_timeout_s from dispatch; each attempt stays individually
+    # capped by the tier's own timeout (serving/router.py; failover
+    # keeps its reference one-shot semantics).
+    retry_attempts: int = 1
+    retry_backoff_s: float = 0.05
 
     def tiers(self) -> Tuple[TierConfig, TierConfig]:
         return (self.nano, self.orin)
@@ -425,8 +457,8 @@ def _apply_tuning(cluster: "ClusterConfig", *,
             kw["draft_preset"] = draft_preset if t["speculative"] else None
         return dataclasses.replace(tier, **kw) if kw else tier
 
-    return ClusterConfig(nano=apply(cluster.nano),
-                         orin=apply(cluster.orin), seed=cluster.seed)
+    return dataclasses.replace(cluster, nano=apply(cluster.nano),
+                               orin=apply(cluster.orin))
 
 
 def cpu_bench_cluster() -> ClusterConfig:
@@ -528,12 +560,12 @@ def tiny_batched_cluster(nano_slots: int = 4,
     DECODE loop, so a cap that makes requests all-prefill would
     understate the default path the real presets (48-128 caps) serve."""
     tiny = tiny_cluster()
-    return ClusterConfig(
+    return dataclasses.replace(
+        tiny,
         nano=dataclasses.replace(tiny.nano, decode_batch=nano_slots,
                                  max_new_tokens=24),
         orin=dataclasses.replace(tiny.orin, decode_batch=orin_slots,
-                                 max_new_tokens=24),
-        seed=tiny.seed)
+                                 max_new_tokens=24))
 
 
 def default_checkpoint(preset: str) -> Optional[str]:

@@ -204,6 +204,15 @@ class ContinuousBatchingEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lifecycle = threading.Lock()   # guards start()/stop()
+        # Decode-watchdog heartbeat: the monotonic time of the last
+        # COMPLETED unit of scheduler progress (an admission's prefill, a
+        # decode tick's fanout, or an idle pass with nothing to do).  A
+        # wedged device call (the round-5 failure mode) leaves the loop
+        # stuck inside block_until_ready, so this goes stale while work
+        # is pending — progress_stall_s() is the observable signal
+        # EngineManager.health() and the HealthMonitor's watchdog read.
+        # Single-word float write/read, GIL-safe.
+        self._progress_t = time.monotonic()
 
         # Per-phase wall-time + roofline work (GET /stats, bench MFU/HBM
         # accounting — utils/telemetry.py, utils/roofline.py).  Only the
@@ -509,6 +518,7 @@ class ContinuousBatchingEngine:
                         self._queue.put(req)     # no KV blocks yet
                         break
                     admitted_any = True
+                    self._progress_t = time.monotonic()
                 except BaseException as exc:     # surface to the caller
                     req.error = exc
                     if req.token_queue is not None:
@@ -518,6 +528,9 @@ class ContinuousBatchingEngine:
             active = [ix for ix, s in enumerate(self._slots) if s is not None]
             if not active:
                 if not admitted_any:
+                    # Idle is trivially "progressing": the watchdog only
+                    # measures staleness while work is pending.
+                    self._progress_t = time.monotonic()
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                 continue
@@ -584,6 +597,7 @@ class ContinuousBatchingEngine:
                                or self._pos[ix] >= self.cfg.max_seq_len - 1)
                     if hit_cap or hit_end:
                         self._finish(ix)
+            self._progress_t = time.monotonic()  # tick completed
 
     # -- public surface (InferenceEngine parity) ---------------------------
 
@@ -676,6 +690,22 @@ class ContinuousBatchingEngine:
     def queue_depth(self) -> int:
         """Requests submitted but not yet admitted to a batch slot."""
         return self._queue.qsize()
+
+    def progress_stall_s(self) -> float:
+        """Seconds since the scheduler last completed a unit of progress
+        WHILE work is pending — the decode watchdog's signal.  0.0 when
+        the engine is idle (nothing queued, no active slot) or the loop
+        isn't running: an idle engine is not wedged.  A stale value with
+        pending work means the loop is stuck inside a device call
+        (wedged chip) or died — exactly what the round-5 probes couldn't
+        see from outside."""
+        if self._thread is None:
+            return 0.0
+        has_work = (self._queue.qsize() > 0
+                    or any(s is not None for s in self._slots))
+        if not has_work:
+            return 0.0
+        return max(0.0, time.monotonic() - self._progress_t)
 
     def slot_stats(self) -> Dict[str, Any]:
         """Live occupancy snapshot for health()/telemetry: queued

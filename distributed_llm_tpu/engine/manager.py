@@ -166,6 +166,26 @@ class EngineManager:
                 entry.update(slots())
             except Exception:
                 pass
+        # Decode watchdog (engine/batching.py progress_stall_s): a
+        # scheduler with pending work but no completed progress past
+        # tier.watchdog_stall_s is WEDGED — the round-5 failure mode.
+        # health() flips unhealthy immediately so the HealthMonitor's
+        # bounded restart fires on the next probe instead of waiting for
+        # probe-count escalation.
+        stall = getattr(engine, "progress_stall_s", None)
+        if callable(stall):
+            try:
+                stall_s = float(stall())
+            except Exception:
+                stall_s = 0.0
+            entry["decode_stall_s"] = round(stall_s, 3)
+            deadline = self.tier.watchdog_stall_s
+            if deadline is not None and stall_s > deadline:
+                entry["ok"] = False
+                entry["wedged"] = True
+                entry["error"] = (f"decode watchdog: no step progress for "
+                                  f"{stall_s:.1f}s (deadline "
+                                  f"{deadline:.0f}s)")
         admission = getattr(self, "admission", None)
         if admission is not None:
             adm = admission.snapshot()

@@ -204,6 +204,12 @@ class QueryRouter:
         if hasattr(self.router, "update_load"):
             self.router.update_load(device=device, **load)
 
+    def update_breaker(self, device: str, is_open: bool) -> None:
+        """Feed a tier's circuit-breaker state into a breaker-aware
+        strategy (PerfStrategy.update_breaker); no-op for the others."""
+        if hasattr(self.router, "update_breaker"):
+            self.router.update_breaker(device=device, is_open=is_open)
+
     @property
     def wants_load(self) -> bool:
         """True iff the active strategy actually SCORES load (queue-aware

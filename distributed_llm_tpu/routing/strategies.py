@@ -420,6 +420,18 @@ class PerfStrategy(BaseStrategy):
         # one-per-staleness-window invariant depends on read-modify-write
         # of (_route_count, _last_seen) being atomic.
         self._explore_lock = threading.Lock()
+        # Circuit-breaker state fed by the Router (serving/breaker.py):
+        # an OPEN tier scores a whole fail_penalty on top — it sheds
+        # quality-equivalent traffic the moment the breaker trips, not
+        # after the rolling window fills with failures.  Dict swaps are
+        # atomic under the GIL (same pattern as _load).
+        self._breaker_open: Dict[str, bool] = {}
+
+    def update_breaker(self, device: str, is_open: bool) -> None:
+        """Record a tier's breaker state (Router feeds this alongside the
+        live load before each decision)."""
+        if device in self.samples:
+            self._breaker_open[device] = bool(is_open)
 
     def update(self, device: str, latency_ms: float, tokens: int, ok: bool = True) -> None:
         if device in self.samples:
@@ -463,11 +475,12 @@ class PerfStrategy(BaseStrategy):
         total_lat = sum(s[0] for s in data)
         total_tok = sum(s[1] for s in data)
         fail_rate = 1.0 - sum(1 for s in data if s[2]) / len(data)
+        breaker = self.fail_penalty if self._breaker_open.get(device) else 0.0
         if total_tok == 0:
             return (total_lat / len(data) + self.fail_penalty * fail_rate
-                    + self._queue_penalty(device))
+                    + self._queue_penalty(device) + breaker)
         return (total_lat / total_tok + self.fail_penalty * fail_rate
-                + self._queue_penalty(device))
+                + self._queue_penalty(device) + breaker)
 
     def _explore_probe(self) -> Optional[RoutingDecision]:
         """Deterministic staleness probe: route to the tier with no fresh

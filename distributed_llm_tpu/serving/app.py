@@ -294,6 +294,12 @@ def create_app(router: Optional[Router] = None,
             "measured_tables": provenance,
             "prefix_affinity_overrides": getattr(
                 router_, "prefix_affinity_overrides", 0),
+            # Fault-tolerance observability (serving/breaker.py): per-tier
+            # circuit state + how many requests the degraded path served.
+            "breaker": (router_.breaker.snapshot()
+                        if getattr(router_, "breaker", None) is not None
+                        else None),
+            "degraded_served": getattr(router_, "degraded_served", 0),
         })
 
     @app.route("/history", methods=["GET"])

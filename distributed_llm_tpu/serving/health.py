@@ -34,8 +34,6 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from ..parallel.collectives import allgather_health, summarize_perf_window
-
 logger = logging.getLogger(__name__)
 
 
@@ -69,6 +67,12 @@ class HealthMonitor:
         self._seen_running: Dict[str, bool] = {}
         self._last: Dict[str, Dict[str, Any]] = {}
         self._restarts: Dict[str, int] = {}
+        # Restart workers abandoned past restart_timeout_s: each one
+        # still HOLDS its manager lock (a wedged-chip rebuild never
+        # returns), so until now it silently blocked every later restart
+        # of that tier with no observable signal — counted and exposed
+        # in the per-tier health entries.
+        self._restarts_abandoned: Dict[str, int] = {}
         self._restarting: Dict[str, threading.Thread] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -113,23 +117,50 @@ class HealthMonitor:
         snapshot: Dict[str, Dict[str, Any]] = {}
         to_restart: List[Tuple[str, Any]] = []
 
+        breaker = getattr(self.router, "breaker", None)
         for name, tier in self.router.tiers.items():
             mgr = tier.server_manager
             state, health = self._probe_tier(name, mgr)
+            wedged = bool(health.get("wedged"))
             with self._lock:
                 if state == "running":
                     self._fail_counts[name] = 0
                     self._seen_running[name] = True
-                elif state == "failed" and self._seen_running.get(name):
-                    self._fail_counts[name] = self._fail_counts.get(name, 0) + 1
+                elif state == "failed" and (self._seen_running.get(name)
+                                            or wedged):
+                    if wedged:
+                        # Decode watchdog: stalled step progress is
+                        # DIRECT wedge evidence (manager health flipped
+                        # past tier.watchdog_stall_s) — restart through
+                        # the existing bounded path NOW instead of
+                        # waiting out probe-count escalation.  (A wedged
+                        # engine necessarily ran, so seen_running is not
+                        # required.)
+                        self._fail_counts[name] = max(
+                            self._fail_counts.get(name, 0) + 1,
+                            self.max_failures)
+                    else:
+                        self._fail_counts[name] = \
+                            self._fail_counts.get(name, 0) + 1
                     if (self.auto_restart
                             and self._fail_counts[name] >= self.max_failures):
                         to_restart.append((name, mgr))
                 entry = {**health, "state": state,
                          "consecutive_failures": self._fail_counts.get(name, 0),
-                         "restarts": self._restarts.get(name, 0)}
+                         "restarts": self._restarts.get(name, 0),
+                         "restarts_abandoned":
+                             self._restarts_abandoned.get(name, 0)}
                 self._last[name] = entry
             snapshot[name] = entry
+            # Half-open probing rides the liveness cadence: a healthy
+            # probe of an OPEN tier past its cooldown advances the
+            # breaker to half-open, so recovery doesn't need a client
+            # request to discover the cooldown expired.
+            if breaker is not None:
+                try:
+                    breaker.note_probe(name, state == "running")
+                except Exception:
+                    pass
 
         for name, mgr in to_restart:
             prev = self._restarting.get(name)
@@ -150,6 +181,14 @@ class HealthMonitor:
                         if name in self._last:
                             self._last[name]["restarts"] = \
                                 self._restarts[name]
+                    # A successful restart voids the failure streak that
+                    # opened the tier's circuit: force-close so traffic
+                    # returns without waiting out the cooldown.
+                    if breaker is not None:
+                        try:
+                            breaker.reset(name)
+                        except Exception:
+                            pass
                 except Exception as exc:
                     logger.error("tier %s restart failed: %s", name, exc)
 
@@ -165,6 +204,12 @@ class HealthMonitor:
                 logger.error("tier %s restart exceeded %.0fs — abandoning "
                              "the worker and continuing to probe",
                              name, self.restart_timeout_s)
+                with self._lock:
+                    self._restarts_abandoned[name] = \
+                        self._restarts_abandoned.get(name, 0) + 1
+                    if name in self._last:
+                        self._last[name]["restarts_abandoned"] = \
+                            self._restarts_abandoned[name]
         return snapshot
 
     # -- cross-host perf exchange ------------------------------------------
@@ -204,6 +249,12 @@ class HealthMonitor:
         perf = self._perf_strategy()
         if self.mesh is None or perf is None:
             return None
+        # Imported lazily: the mesh collectives need jax.shard_map, which
+        # some deployment jaxlibs lack — liveness probing, the decode
+        # watchdog, and restart/breaker plumbing must keep working there
+        # (the cross-host perf exchange is the only piece that needs it).
+        from ..parallel.collectives import (allgather_health,
+                                            summarize_perf_window)
         n, remote_mask = self._participants()
         gathered: Dict[str, np.ndarray] = {}
         for tier_name, samples in perf.samples.items():
@@ -226,6 +277,7 @@ class HealthMonitor:
         if not (getattr(perf, "queue_aware", False)
                 and hasattr(perf, "update_load")):
             return
+        from ..parallel.collectives import allgather_health
         # Iterate the STRATEGY's fixed tier set (nano+orin on every
         # host) and always run the allgather, contributing a zero row
         # when the local tier has no load to report (remote-endpoint

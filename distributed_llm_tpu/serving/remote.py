@@ -56,6 +56,10 @@ SPAWN_GRACE_S = 180.0              # live child younger than this is starting,
                                    # not wedged — never kill it mid-load
 CONNECT_TIMEOUT_S = 5.0            # reference nano.py:28 (5, 180)
 READ_TIMEOUT_S = 180.0
+CONNECT_RETRY_ATTEMPTS = 3         # connection-refused during tier bring-up
+CONNECT_RETRY_BACKOFF_S = 0.2      # (spawned server not yet listening) —
+                                   # short bounded retry so cross-host spawn
+                                   # races don't surface as instant failover
 
 
 def _http_json(url: str, payload: Optional[Dict[str, Any]] = None,
@@ -227,13 +231,30 @@ class RemoteTierClient:
         fast into the router's failover instead of stalling each request
         for read_timeout.  The reference client's lazy SSH restart
         (src/models/nano.py:19-21) has no equivalent here — the remote
-        host supervises its own process."""
+        host supervises its own process.
+
+        Connection-REFUSED gets a short bounded retry
+        (CONNECT_RETRY_ATTEMPTS × CONNECT_RETRY_BACKOFF_S): during tier
+        spawn the process exists but hasn't bound its port yet, and that
+        bring-up race should cost milliseconds, not an instant failover
+        that brands the tier failed.  Timeouts/unreachable hosts are NOT
+        retried — a blackholed host would multiply the 5 s probe cost."""
         parts = urllib.parse.urlsplit(self.base_url)
         port = parts.port or (443 if parts.scheme == "https" else 80)
-        conn = socket.create_connection(
-            (parts.hostname, port),
-            timeout=self.server_manager.connect_timeout)
-        conn.close()
+        for attempt in range(CONNECT_RETRY_ATTEMPTS):
+            try:
+                conn = socket.create_connection(
+                    (parts.hostname, port),
+                    timeout=self.server_manager.connect_timeout)
+                conn.close()
+                return
+            except ConnectionRefusedError:
+                if attempt == CONNECT_RETRY_ATTEMPTS - 1:
+                    raise
+                logger.info("tier %s: connection refused (bring-up race?) "
+                            "— connect retry %d/%d", self.name, attempt + 1,
+                            CONNECT_RETRY_ATTEMPTS - 1)
+                time.sleep(CONNECT_RETRY_BACKOFF_S * (attempt + 1))
 
     def process(self, history: History) -> Dict[str, Any]:
         fault = self._intercept()
@@ -290,7 +311,10 @@ class RemoteTierClient:
             # this is the router's stream-failover window.
             handle.prime()
             resp = None                  # handle owns the connection now
-            return handle
+            # Scripted mid-stream kills apply to remote tiers too, so the
+            # chaos harness can exercise cross-host stream failover.
+            from ..utils.faults import maybe_break_stream
+            return maybe_break_stream(self.faults, self.name, handle)
         except (urllib.error.URLError, socket.timeout, TimeoutError,
                 ValueError, OSError, RuntimeError) as exc:
             return {"error": f"Request failed: {exc}"}
@@ -359,6 +383,14 @@ class _RemoteStream:
         router failover window), mirroring tiers._PrimedStream."""
         if not self._queued and not self._done:
             self._read_frames()
+
+    def close(self) -> None:
+        """Drop the connection (mid-stream kill / abandoning consumer)."""
+        self._done = True
+        try:
+            self._resp.close()
+        except Exception:
+            pass
 
     def __iter__(self):
         while True:

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import hashlib
 import logging
+import random
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -36,6 +37,22 @@ from ..utils.faults import FaultInjector
 from .tiers import TierClient, build_tiers
 
 logger = logging.getLogger(__name__)
+
+# Error-shape substrings the bounded retry treats as TRANSIENT (a fresh
+# attempt on the same tier plausibly succeeds in milliseconds): connection-
+# level races and an engine that shut down mid-flight.  Deliberately NOT
+# timeouts — a timed-out call already consumed its whole request budget,
+# and retrying it would double the client's wait for the same outcome —
+# and NOT admission rejections, where the queue is full and immediate
+# re-entry would only re-reject (failover is the productive move).
+_TRANSIENT_MARKERS = (
+    "connection refused",
+    "connection reset",
+    "reset by peer",
+    "temporarily unavailable",
+    "engine returned no result",
+    "(transient)",
+)
 
 
 def default_cluster(cpu_bench: bool = False) -> ClusterConfig:
@@ -94,6 +111,27 @@ class Router:
         self.orin = self.tiers["orin"]
 
         self.query_router = QueryRouter(strategy=strategy, config=self.config)
+
+        # Per-tier circuit breaker (serving/breaker.py): consulted before
+        # dispatch so an OPEN tier sheds traffic in microseconds instead
+        # of each request discovering the outage via a timeout.
+        # breaker_failures=0 in the cluster disables it (pure reference
+        # per-call failover semantics).
+        self.breaker = None
+        if getattr(self.cluster, "breaker_failures", 0):
+            from .breaker import CircuitBreaker
+            self.breaker = CircuitBreaker(
+                [t.name for t in self.cluster.tiers()],
+                failure_threshold=self.cluster.breaker_failures,
+                cooldown_s=self.cluster.breaker_cooldown_s)
+        # Bounded retry for transient error shapes (_TRANSIENT_MARKERS):
+        # budgeted against the dispatching tier's request_timeout_s so
+        # retry + failover never exceed the reference's per-request cap.
+        self.retry_attempts = max(0, int(getattr(self.cluster,
+                                                 "retry_attempts", 0)))
+        self.retry_backoff_s = float(getattr(self.cluster,
+                                             "retry_backoff_s", 0.05))
+        self.degraded_served = 0       # both-tiers-open responses served
 
         self.enable_response_cache = (
             not benchmark_mode
@@ -259,6 +297,66 @@ class Router:
     def _is_error(raw: Any) -> bool:
         return isinstance(raw, dict) and "error" in raw
 
+    @staticmethod
+    def _is_transient_error(raw: Any) -> bool:
+        """Error shapes worth one quick same-tier retry (connection races,
+        engine shut down mid-flight) — see _TRANSIENT_MARKERS."""
+        if not (isinstance(raw, dict) and "error" in raw):
+            return False
+        msg = str(raw.get("error", "")).lower()
+        return any(m in msg for m in _TRANSIENT_MARKERS)
+
+    @staticmethod
+    def _other(device: str) -> str:
+        return "orin" if device == "nano" else "nano"
+
+    def _tier_timeout_s(self, device: str) -> Optional[float]:
+        """The tier's per-request wall budget (TierConfig.request_timeout_s
+        locally, the read timeout for a remote tier); None = unbounded."""
+        tier = self.tiers.get(device)
+        cfg = getattr(tier, "tier", None)
+        if cfg is not None and cfg.request_timeout_s:
+            return float(cfg.request_timeout_s)
+        read_timeout = getattr(tier, "read_timeout", None)
+        return float(read_timeout) if read_timeout else None
+
+    @staticmethod
+    def _is_admission_rejection(raw: Any) -> bool:
+        return (isinstance(raw, dict)
+                and "admission rejected" in str(raw.get("error", "")))
+
+    def _breaker_record(self, device: str, ok: bool,
+                        raw: Any = None) -> None:
+        """Feed a dispatch outcome to the breaker.  Admission rejections
+        are NEITHER success nor failure: they are healthy backpressure
+        (the queue-aware perf penalty's job), and counting them would
+        open the circuit on a tier that is merely at capacity — a burst
+        could then cascade both tiers into degraded fail-fast while both
+        engines are healthy and draining."""
+        if self.breaker is None:
+            return
+        if not ok and self._is_admission_rejection(raw):
+            # Still repay a half-open canary permit: the rejection proves
+            # the engine is up and draining — holding the permit would
+            # shed the tier for another whole cooldown.
+            self.breaker.release_probe(device)
+            return
+        self.breaker.record(device, ok)
+
+    def _breaker_record_stream_setup(self, device: str, handle: Any) -> None:
+        """Breaker feedback for a stream SETUP result: only FAILURES
+        (error dicts, minus admission rejections) count here.  A
+        successful setup proves one primed token, nothing more — a tier
+        that wedges MID-decode (the round-5 mode) passes setup every
+        time, and recording that as success would reset the failure
+        streak each request and keep the circuit closed forever on a
+        streaming-only workload.  ALL success verdicts come from stream
+        completion (``on_done``)."""
+        if self.breaker is None:
+            return
+        if self._is_error(handle):
+            self._breaker_record(device, False, handle)
+
     def _run_device(self, device: str,
                     history: List[Dict[str, Any]]) -> Tuple[Any, str, float]:
         tier = self.tiers.get(device, self.nano)
@@ -267,11 +365,101 @@ class Router:
         raw = tier.process(history)
         return raw, tier.name, (time.perf_counter() - t0) * 1000.0
 
+    def _run_device_retrying(self, device: str, history: List[Dict[str, Any]],
+                             deadline: Optional[float] = None
+                             ) -> Tuple[Any, str, float]:
+        """``_run_device`` plus bounded retry with jittered exponential
+        backoff for TRANSIENT error shapes.  ``deadline`` (monotonic) is
+        the retry layer's wall budget — the dispatching tier's
+        request_timeout_s from dispatch start: no retry STARTS past it
+        (a timed-out call has no retry budget left by construction).
+        Each attempt is still individually capped by the tier's own
+        timeout, so the theoretical worst case is budget + one per-call
+        cap — reachable only by a transient failure surfacing at the
+        budget's edge; in practice the retried shapes (connection
+        refused/reset) fail in milliseconds."""
+        raw, which, lat_ms = self._run_device(device, history)
+        for attempt in range(self.retry_attempts):
+            if not self._is_transient_error(raw):
+                break
+            backoff = (self.retry_backoff_s * (2 ** attempt)
+                       * (0.5 + random.random()))
+            if (deadline is not None
+                    and time.monotonic() + backoff >= deadline):
+                logger.warning("%s transient error but no retry budget "
+                               "left — giving up the retry", which)
+                break
+            logger.warning("%s transient error (%.80s) — retry %d/%d after "
+                           "%.0fms", which, raw.get("error", ""),
+                           attempt + 1, self.retry_attempts, backoff * 1000)
+            time.sleep(backoff)
+            raw2, _, lat2 = self._run_device(device, history)
+            lat_ms += lat2
+            raw = raw2
+        return raw, which, lat_ms
+
     # -- response cache (src/router.py:179-193) ----------------------------
 
     def _response_cache_key(self, ctx_hash: str, query: str) -> str:
         # Deliberately context-independent (reference intent, router.py:57-59)
         return f"{self.query_router.strategy}|{query.lower().strip()}"
+
+    def _degraded_response(self, query: str, ctx_hash: str, method: str,
+                           confidence: float, overhead_ms: float,
+                           device: str) -> Tuple[Dict[str, Any], int, str]:
+        """Both tiers' circuits are open: serve a response-cache hit if
+        one exists (stale beats dead), else fail FAST with the reference
+        error shape plus a retry-after hint — never dispatch into a
+        known-dead cluster and burn a serving thread on a timeout."""
+        cached = self._response_store.get(
+            self._response_cache_key(ctx_hash, query))
+        # Skip error-shaped entries: the store keeps every reply
+        # (reference behavior), and re-serving a cached ERROR as an
+        # ok=True "degraded hit" would report a failure as an answer.
+        if cached is not None and self._is_error(cached.get("raw")):
+            cached = None
+        if cached is not None:
+            text = cached.get("text", "")
+            which = cached.get("device", device)
+            tokens = self.token_counter.count_tokens(
+                {"role": "assistant", "content": text})
+            self.degraded_served += 1
+            return {
+                "response": text,
+                "raw": cached.get("raw"),
+                "cache_hit": True,
+                "degraded": True,
+                "routing_method": "response_cache_degraded",
+                "routing_confidence": 1.0,
+                "routing_reasoning": ("all tiers' circuits open -> stale "
+                                      f"response-cache hit ({which})"),
+                "routing_overhead_ms": round(overhead_ms, 2),
+                "ok": True,
+            }, tokens, which
+        retry_after = (self.breaker.retry_after_s()
+                       if self.breaker is not None else 0.0)
+        raw = {"error": ("Request failed: all tiers unavailable (circuit "
+                         f"open); retry in {retry_after:.1f}s")}
+        text = self._extract_text(raw) or "No response available"
+        tokens = self.token_counter.count_tokens(
+            {"role": "assistant", "content": text})
+        self.degraded_served += 1
+        logger.warning("degraded fail-fast: all circuits open "
+                       "(retry_after=%.1fs)", retry_after)
+        return {
+            "response": text,
+            "raw": raw,
+            "cache_hit": False,
+            "degraded": True,
+            "retry_after_s": round(retry_after, 2),
+            "benchmark_mode": self.benchmark_mode,
+            "routing_method": f"{method}+breaker_degraded",
+            "routing_confidence": round(confidence, 4),
+            "routing_reasoning": ("all tiers' circuits open; shedding "
+                                  "without dispatch"),
+            "routing_overhead_ms": round(overhead_ms, 2),
+            "ok": False,
+        }, tokens, device
 
     # -- main pipeline -----------------------------------------------------
 
@@ -280,6 +468,21 @@ class Router:
         (admission queue depth + batch slot occupancy) into the active
         strategy before it decides.  Cheap in-memory counters; skipped
         entirely unless the strategy consumes them (perf only)."""
+        if (self.breaker is not None
+                and hasattr(getattr(self.query_router, "router", None),
+                            "update_breaker")):
+            # Breaker state reaches the strategies too (perf scores an
+            # OPEN tier a whole fail_penalty), so shedding starts at the
+            # DECISION, before the Router's dispatch-time veto.  Gated on
+            # the ACTIVE strategy consuming it — same pattern as
+            # wants_load: no per-request breaker lock/snapshot for the
+            # strategies that ignore the feed.
+            for name, st in self.breaker.snapshot().items():
+                try:
+                    self.query_router.update_breaker(
+                        name, st["state"] == "open")
+                except Exception:
+                    pass
         if not getattr(self.query_router, "wants_load", False):
             return
         for name, tier in self.tiers.items():
@@ -355,11 +558,33 @@ class Router:
         device, method, reasoning = self._apply_prefix_affinity(
             device, confidence, method, reasoning, history)
 
-        # 2) inference + failover
-        raw, which, lat_ms = self._run_device(device, history)
+        # 1.6) circuit-breaker veto: an OPEN tier sheds traffic BEFORE
+        # dispatch (before its admission queue even sees the request);
+        # both tiers open → the degraded path (cache hit or fast fail
+        # with a retry-after hint) instead of a doomed dispatch.
+        if self.breaker is not None and not self.breaker.allow(device):
+            other = self._other(device)
+            if device in self.tiers and self.breaker.allow(other):
+                reasoning = (f"circuit open on {device} -> rerouted to "
+                             f"{other}; {reasoning}")
+                method = f"{method}+breaker"
+                device = other
+            else:
+                return self._degraded_response(query, ctx_hash, method,
+                                               confidence, overhead_ms,
+                                               device)
+
+        # 2) inference + bounded transient retry + failover.  The retry
+        # layer is budgeted against the primary tier's request_timeout_s
+        # from dispatch start (retries never extend the reference cap).
+        timeout_s = self._tier_timeout_s(device)
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
+        raw, which, lat_ms = self._run_device_retrying(device, history,
+                                                       deadline)
+        self._breaker_record(which, not self._is_error(raw), raw)
         if self.enable_failover and self._is_error(raw):
-            other = "orin" if which == "nano" else "nano"
-            logger.warning("%s failed — failing over to %s", which, other)
+            other = self._other(which)
             # Record the PRIMARY's failure before switching: the
             # reference feeds perf only for the device that ultimately
             # served (router.py:292-295), so failover masked every
@@ -371,9 +596,25 @@ class Router:
                 self.query_router.update_perf(which, lat_ms, 0, ok=False)
             except Exception:
                 pass
-            raw2, which2, lat2 = self._run_device(other, history)
-            if not self._is_error(raw2):
-                raw, which, lat_ms = raw2, which2, lat2
+            # Failover keeps the reference's one-shot semantics — it
+            # fires even after a full wall timeout (a wedged tier's
+            # request MUST still reach the survivor; that is the round-5
+            # scenario this layer exists for).  The deadline bounds only
+            # the RETRY layer: the failover attempt runs retry-free when
+            # the budget is spent.  Repeated timeout+failover cost is the
+            # BREAKER's job — after breaker_failures of these, the wedged
+            # tier sheds pre-dispatch and nobody pays the timeout again.
+            # Only an open circuit on the survivor suppresses failover.
+            if self.breaker is None or self.breaker.allow(other):
+                logger.warning("%s failed — failing over to %s", which, other)
+                raw2, which2, lat2 = self._run_device_retrying(
+                    other, history, deadline)
+                self._breaker_record(which2, not self._is_error(raw2), raw2)
+                if not self._is_error(raw2):
+                    raw, which, lat_ms = raw2, which2, lat2
+            else:
+                logger.warning("%s failed and %s's circuit is open — "
+                               "no failover target", which, other)
 
         # 3) normalize + count
         text = self._extract_text(raw) or "No response available"
@@ -411,12 +652,16 @@ class Router:
     def route_query_stream(self, history: List[Dict[str, Any]]
                            ) -> "RoutedStream":
         """Streaming twin of ``route_query``: same decision stage
-        (``_decide`` incl. the ctx-size fallback), same one-shot tier
-        failover — applied at stream SETUP, where a clean switch is still
-        possible — and the same perf feedback, fired when the stream
-        completes.  The response cache does not participate: a streamed
-        reply is consumed as it is produced.  Raises RuntimeError if no
-        tier can start a stream."""
+        (``_decide`` incl. the ctx-size fallback), the same circuit-
+        breaker veto, one-shot tier failover at stream SETUP, plus
+        MID-STREAM failover — a stream whose decode loop dies after the
+        first token is re-issued on the surviving tier with the already-
+        emitted prefix replayed silently (RoutedStream) — and the same
+        perf feedback, fired when the stream completes.  The response
+        cache does not participate: a streamed reply is consumed as it
+        is produced.  Raises RuntimeError if no tier can start a stream
+        (message carries a retry-after hint when every circuit is
+        open)."""
         query, context, ctx_hash = self._history_to_query_and_context(history)
         (device, method, confidence, reasoning,
          cache_hit, overhead_ms) = self._decide(query, context, ctx_hash,
@@ -424,12 +669,28 @@ class Router:
         device, method, reasoning = self._apply_prefix_affinity(
             device, confidence, method, reasoning, history)
 
+        # Circuit-breaker veto, mirroring the sync path: shed an open
+        # tier pre-dispatch; both open → fail fast with a retry hint.
+        if self.breaker is not None and not self.breaker.allow(device):
+            other = self._other(device)
+            if self.breaker.allow(other):
+                reasoning = (f"circuit open on {device} -> rerouted to "
+                             f"{other}; {reasoning}")
+                method = f"{method}+breaker"
+                device = other
+            else:
+                self.degraded_served += 1
+                raise RuntimeError(
+                    "Request failed: all tiers unavailable (circuit "
+                    f"open); retry in {self.breaker.retry_after_s():.1f}s")
+
         t0 = time.perf_counter()
         tier = self.tiers.get(device, self.nano)
         handle = tier.process_stream(history)
         which = tier.name
+        self._breaker_record_stream_setup(which, handle)
         if self._is_error(handle) and self.enable_failover:
-            other = "orin" if which == "nano" else "nano"
+            other = self._other(which)
             logger.warning("%s stream setup failed — failing over to %s",
                            which, other)
             # Same as the sync path: the primary's failure must reach
@@ -439,13 +700,26 @@ class Router:
                     which, (time.perf_counter() - t0) * 1000.0, 0, ok=False)
             except Exception:
                 pass
-            alt = self.tiers[other].process_stream(history)
-            if not self._is_error(alt):
-                handle, which = alt, other
+            if self.breaker is None or self.breaker.allow(other):
+                alt = self.tiers[other].process_stream(history)
+                self._breaker_record_stream_setup(other, alt)
+                if not self._is_error(alt):
+                    handle, which = alt, other
         if self._is_error(handle):
             raise RuntimeError(handle.get("error", "stream setup failed"))
 
-        def on_done(result, ok: bool) -> None:
+        # Shared mutable view of the live (handle, device): mid-stream
+        # failover swaps both, and the completion callback must attribute
+        # the final result to the tier that ACTUALLY finished the stream.
+        state: Dict[str, Any] = {"handle": handle, "device": which}
+
+        def on_done(ok: bool) -> None:
+            # The stream's COMPLETION is the breaker's verdict for the
+            # serving tier (setup only primes one token — see
+            # _breaker_record_stream_setup): a half-open canary closes
+            # the circuit only by finishing its stream.
+            self._breaker_record(state["device"], ok)
+            result = getattr(state["handle"], "result", None)
             # Engine-true generation time, NOT wall time to exhaustion: a
             # slow SSE consumer would otherwise poison the perf strategy's
             # latency window for a healthy tier.
@@ -455,9 +729,63 @@ class Router:
                 lat_ms = (time.perf_counter() - t0) * 1000.0
             tokens = result.gen_tokens if result else 0
             try:
-                self.query_router.update_perf(which, lat_ms, tokens, ok=ok)
+                self.query_router.update_perf(state["device"], lat_ms,
+                                              tokens, ok=ok)
             except Exception:
                 pass
+
+        def resume_mid_stream(emitted_chars: int, exc: BaseException):
+            """Mid-stream failover: the live stream died after emitting
+            ``emitted_chars`` chars.  Re-issue the SAME request on the
+            surviving tier and return an iterator that silently replays
+            (skips) the already-delivered prefix, or None when no tier
+            can take over (the caller then surfaces the original
+            failure).  The replacement tier re-generates from scratch —
+            its first ``emitted_chars`` chars are dropped, so the client
+            sees one seamless stream (prefix replay; the spliced suffix
+            may of course diverge in wording from what the dead tier
+            WOULD have said — it is a different model)."""
+            if not self.enable_failover:
+                return None
+            dying = state["device"]
+            other = self._other(dying)
+            logger.warning("%s stream died mid-decode after %d chars (%s) "
+                           "— re-issuing on %s", dying, emitted_chars, exc,
+                           other)
+            # On every None return below, on_done(False) fires for the
+            # still-current state["device"] (the dying tier) — so the
+            # dying tier's breaker/perf failure is recorded HERE only on
+            # the success path, where on_done will credit the SURVIVOR
+            # instead.  Recording in both places would double-count one
+            # stream death and trip the breaker at half its threshold.
+            if self.breaker is not None and not self.breaker.allow(other):
+                return None
+            alt = self.tiers[other].process_stream(history)
+            self._breaker_record_stream_setup(other, alt)
+            if self._is_error(alt):
+                logger.warning("mid-stream failover target %s also failed "
+                               "(%s)", other, alt.get("error"))
+                return None
+            self._breaker_record(dying, False)
+            try:
+                self.query_router.update_perf(
+                    dying, (time.perf_counter() - t0) * 1000.0, 0, ok=False)
+            except Exception:
+                pass
+            state["handle"], state["device"] = alt, other
+
+            def replayed():
+                skip = emitted_chars
+                for delta in alt:
+                    if skip > 0:
+                        if len(delta) <= skip:
+                            skip -= len(delta)
+                            continue
+                        delta = delta[skip:]
+                        skip = 0
+                    yield delta
+
+            return replayed()
 
         meta = {
             "device": which,
@@ -472,43 +800,79 @@ class Router:
             "routing_cache_hit": cache_hit,
             "routing_overhead_ms": round(overhead_ms, 2),
         }
-        return RoutedStream(handle, which, meta, on_done)
+        return RoutedStream(state, meta, on_done,
+                            resume=resume_mid_stream)
 
 
 class RoutedStream:
     """A routed token stream: iterate for text deltas; ``.result`` holds
     the GenerationResult once exhausted.  Fires the router's perf-feedback
     callback exactly once, whether the stream completes, errors, or is
-    abandoned mid-iteration (client disconnect)."""
+    abandoned mid-iteration (client disconnect).
 
-    def __init__(self, handle, device: str, meta: Dict[str, Any], on_done):
-        self._handle = handle
-        self.device = device
+    ``resume`` is the Router's mid-stream failover hook: when the LIVE
+    stream raises between deltas (decode-loop death after the first
+    token — setup-time failover can no longer help), it is called once
+    with the number of chars already delivered; a non-None return is an
+    iterator over the surviving tier's stream with that prefix already
+    skipped (prefix replay), and iteration continues seamlessly.  A None
+    return (failover disabled, no surviving tier, its circuit open)
+    surfaces the original failure — the SSE layer splices the
+    error-shaped tail event."""
+
+    def __init__(self, state: Dict[str, Any], meta: Dict[str, Any],
+                 on_done, resume=None):
+        self._state = state
         self.meta = meta
         self._on_done = on_done
+        self._resume = resume
+        self._resumed = False
         self._fired = False
+
+    @property
+    def device(self) -> str:
+        """The tier currently (or finally) serving this stream — updated
+        if mid-stream failover switched tiers."""
+        return self._state["device"]
 
     def _fire(self, ok: bool) -> None:
         if not self._fired:
             self._fired = True
-            self._on_done(self._handle.result, ok)
+            self._on_done(ok)
 
     def __iter__(self):
-        try:
-            for delta in self._handle:
+        emitted_chars = 0
+        it = iter(self._state["handle"])
+        while True:
+            try:
+                delta = next(it)
+            except StopIteration:
+                break
+            except BaseException as exc:   # producer (engine/stream) death
+                if self._resume is not None and not self._resumed:
+                    self._resumed = True   # one-shot, like setup failover
+                    alt = None
+                    try:
+                        alt = self._resume(emitted_chars, exc)
+                    except Exception:
+                        logger.exception("mid-stream failover hook failed")
+                    if alt is not None:
+                        it = alt
+                        continue
+                self._fire(False)
+                raise
+            try:
                 yield delta
-        except GeneratorExit:
-            # Consumer abandoned the stream (client disconnect) — the TIER
-            # was healthy as far as it was consumed; an ok=False sample
-            # here would let disconnecting clients poison the perf
-            # strategy against a healthy tier.
-            self._fire(True)
-            raise
-        except BaseException:        # real engine/stream failure
-            self._fire(False)
-            raise
+            except GeneratorExit:
+                # Consumer abandoned the stream (client disconnect) — the
+                # TIER was healthy as far as it was consumed; an ok=False
+                # sample here would let disconnecting clients poison the
+                # perf strategy against a healthy tier.
+                self._fire(True)
+                raise
+            emitted_chars += len(delta)
         self._fire(True)
 
     @property
     def result(self):
-        return self._handle.result
+        return self._state["handle"].result

@@ -274,6 +274,14 @@ class TierClient:
                                  f"after {timeout:.0f}s"}
         return box.get("out", {"error": "Request failed: worker died"})
 
+    def _maybe_break_stream(self, handle):
+        """Apply a scripted mid-stream kill (FaultInjector.
+        fail_stream_after): the returned stream dies after N chunks —
+        the wedge-after-first-token failure mode the Router's mid-stream
+        failover exists for.  No kill scheduled → the handle unchanged."""
+        from ..utils.faults import maybe_break_stream
+        return maybe_break_stream(self.faults, self.name, handle)
+
     def _engine_concurrent_safe(self) -> bool:
         """Best-effort concurrent_safe probe: abandoned workers only
         serialize engines that assume serialized callers."""
@@ -391,7 +399,8 @@ class TierClient:
                     engine.generate_stream(history),
                     prime_drain_chars=PRIME_DRAIN_CHARS)
                 handle_box["handle"] = clipped
-                return _PrimedStream(clipped, release=finish_admission)
+                return _PrimedStream(self._maybe_break_stream(clipped),
+                                     release=finish_admission)
             timeout = self.tier.request_timeout_s
             acquired = (self._engine_lock.acquire(timeout=timeout)
                         if timeout is not None
@@ -413,7 +422,8 @@ class TierClient:
                     engine.generate_stream(history),
                     prime_drain_chars=PRIME_DRAIN_CHARS)
                 handle_box["handle"] = clipped
-                return _PrimedStream(clipped, release=release_all)
+                return _PrimedStream(self._maybe_break_stream(clipped),
+                                     release=release_all)
             except BaseException:
                 self._engine_lock.release()
                 raise

@@ -89,6 +89,31 @@ def create_tier_app(tier_name: str,
 
     @app.route("/health", methods=["GET"])
     def health():
+        """Liveness contract {"ok": true} (reference nano_api.py) — a
+        LAZY not-yet-started engine is healthy (readiness polling after
+        spawn depends on it), but a WEDGED decode loop (stalled step
+        progress past the tier's watchdog deadline, engine/batching.py)
+        reports ok=false so a remote router's HealthMonitor can revive
+        this process instead of probing a zombie forever.  Deliberately
+        LOCK-FREE (plain attribute reads, not manager.health()): the
+        manager's lifecycle lock is held for minutes through an engine
+        build/warmup, and a blocked /health would make a merely-
+        compiling tier read as dead to the remote prober."""
+        try:
+            engine = getattr(manager, "_engine", None)
+            stall_fn = getattr(engine, "progress_stall_s", None)
+            deadline = getattr(getattr(manager, "tier", None),
+                               "watchdog_stall_s", None)
+            if callable(stall_fn) and deadline is not None:
+                stall_s = float(stall_fn())
+                if stall_s > deadline:
+                    return jsonify({
+                        "ok": False, "wedged": True,
+                        "error": (f"decode watchdog: no step progress "
+                                  f"for {stall_s:.1f}s (deadline "
+                                  f"{deadline:.0f}s)")}), 200
+        except Exception:
+            pass
         return jsonify({"ok": True}), 200
 
     @app.route("/query", methods=["POST"])

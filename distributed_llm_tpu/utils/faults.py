@@ -8,17 +8,25 @@ fail, so failover, the perf strategy's fail-penalty, and the health plumbing
 need a fault model to stay testable (SURVEY.md §7 hard part 5).
 
 ``FaultInjector`` scripts failures per tier: one-shot error queues, sticky
-outage flags, and artificial latency.  Error payload shapes mirror the
-reference client exactly so `Router._is_error` and failover behave
-identically.
+outage flags, artificial latency, transient (retryable) error shapes, and
+mid-stream kills (``fail_stream_after`` — the stream dies after N delivered
+chunks, exercising the Router's mid-stream failover).  Error payload shapes
+mirror the reference client exactly so `Router._is_error` and failover
+behave identically.
+
+``FaultSchedule`` layers scripted TIMELINES on top — flaps, sticky
+outages, latency spikes, mid-stream kills at chosen offsets — driven on a
+background thread while load runs.  The bench's chaos leg and the chaos
+soak tests both build their scenarios from it.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import defaultdict, deque
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
 class FaultInjector:
@@ -27,6 +35,7 @@ class FaultInjector:
         self._one_shot: Dict[str, deque] = defaultdict(deque)
         self._down: Dict[str, Optional[Dict[str, Any]]] = {}
         self._delay_s: Dict[str, float] = {}
+        self._stream_kills: Dict[str, deque] = defaultdict(deque)
 
     # -- scripting ---------------------------------------------------------
 
@@ -42,6 +51,14 @@ class FaultInjector:
             tier, f"Request timed out on {tier.capitalize()} "
                   "(model cold start / slow inference).")
 
+    def fail_transient(self, tier: str) -> None:
+        """One-shot TRANSIENT failure — an error shape the Router's
+        bounded retry recognizes as retryable (connection-level, not a
+        budget-consuming timeout)."""
+        self.fail_next(
+            tier, f"Request failed: connection reset by peer on "
+                  f"{tier} (transient)")
+
     def set_down(self, tier: str, error: str = "tier offline") -> None:
         """Sticky outage until ``restore``."""
         with self._lock:
@@ -52,13 +69,23 @@ class FaultInjector:
             self._down.pop(tier, None)
             self._one_shot.pop(tier, None)
             self._delay_s.pop(tier, None)
+            self._stream_kills.pop(tier, None)
 
     def add_latency(self, tier: str, seconds: float) -> None:
         """Artificial per-request latency (perf-strategy steering tests)."""
         with self._lock:
             self._delay_s[tier] = seconds
 
-    # -- hook called by TierClient ----------------------------------------
+    def fail_stream_after(self, tier: str, n_chunks: int,
+                          error: str = "injected mid-stream fault") -> None:
+        """Queue a one-shot MID-STREAM kill: the next stream started on
+        ``tier`` dies (raises) after delivering ``n_chunks`` deltas —
+        the decode-loop-death-after-first-token scenario that setup-time
+        failover can never catch.  ``restore`` clears pending kills."""
+        with self._lock:
+            self._stream_kills[tier].append((max(0, int(n_chunks)), error))
+
+    # -- hooks called by TierClient ----------------------------------------
 
     def intercept(self, tier: str) -> Optional[Dict[str, Any]]:
         """Return an error payload to short-circuit the request, else None.
@@ -74,3 +101,171 @@ class FaultInjector:
         if shot is not None:
             return shot
         return None
+
+    def stream_kill(self, tier: str) -> Optional[Tuple[int, str]]:
+        """Pop the next scheduled mid-stream kill for ``tier`` (one-shot):
+        (chunks to deliver before dying, error message), or None."""
+        with self._lock:
+            kills = self._stream_kills.get(tier)
+            return kills.popleft() if kills else None
+
+
+def maybe_break_stream(faults: Optional["FaultInjector"], tier: str,
+                       handle):
+    """Apply a scripted mid-stream kill to a freshly-built stream handle
+    (shared by the local and remote tier clients): pops the next
+    ``fail_stream_after`` entry for ``tier`` and wraps the handle so it
+    dies after that many chunks.  No injector / no kill scheduled → the
+    handle unchanged."""
+    if faults is None:
+        return handle
+    kill = faults.stream_kill(tier)
+    if kill is None:
+        return handle
+    n, err = kill
+    logging.getLogger(__name__).warning(
+        "tier %s: scripted mid-stream kill after %d chunks", tier, n)
+    return BrokenStream(handle, n, err)
+
+
+class BrokenStream:
+    """Stream wrapper that dies after ``n_chunks`` deltas — what a chip
+    wedging mid-decode looks like to the consumer.  Keeps the wrapped
+    handle's ``.result`` surface (None until/unless the underlying stream
+    finished, which a killed one never does)."""
+
+    def __init__(self, handle, n_chunks: int, error: str):
+        self._handle = handle
+        self._n = n_chunks
+        self._error = error
+
+    def __iter__(self):
+        served = 0
+        it = iter(self._handle)
+        while True:
+            if served >= self._n:
+                close = getattr(self._handle, "close", None)
+                if callable(close):
+                    close()
+                raise RuntimeError(self._error)
+            try:
+                delta = next(it)
+            except StopIteration:
+                return                    # shorter than the kill point
+            served += 1
+            yield delta
+
+    @property
+    def result(self):
+        return getattr(self._handle, "result", None)
+
+
+class FaultSchedule:
+    """A scripted fault timeline over a FaultInjector, driven on a
+    background thread: the chaos harness's scenario language.
+
+    Events are (offset_s, fn, args) applied relative to ``start()``;
+    convenience builders cover the common shapes.  ``stop()`` halts the
+    driver and restores every tier it ever touched, so a schedule can
+    never leak a sticky outage past its run.
+    """
+
+    def __init__(self, injector: FaultInjector):
+        self.injector = injector
+        self._events: List[Tuple[float, str, Callable[[], None]]] = []
+        self._tiers: set = set()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.applied: List[Tuple[float, str]] = []   # (offset_s, label)
+        self._lock = threading.Lock()
+
+    # -- builders -----------------------------------------------------------
+
+    def at(self, offset_s: float, label: str,
+           fn: Callable[[], None], tier: Optional[str] = None
+           ) -> "FaultSchedule":
+        self._events.append((float(offset_s), label, fn))
+        if tier:
+            self._tiers.add(tier)
+        return self
+
+    def outage(self, tier: str, start_s: float, end_s: float,
+               error: str = "tier offline (scheduled outage)"
+               ) -> "FaultSchedule":
+        """Sticky down from start_s to end_s."""
+        self.at(start_s, f"down:{tier}",
+                lambda: self.injector.set_down(tier, error), tier)
+        self.at(end_s, f"up:{tier}",
+                lambda: self.injector.restore(tier), tier)
+        return self
+
+    def flaps(self, tier: str, n: int, period_s: float, down_s: float,
+              start_s: float = 0.0) -> "FaultSchedule":
+        """n down/up cycles: down for down_s out of every period_s."""
+        for i in range(n):
+            t0 = start_s + i * period_s
+            self.outage(tier, t0, t0 + down_s,
+                        error=f"tier offline (flap {i + 1}/{n})")
+        return self
+
+    def latency_spike(self, tier: str, start_s: float, end_s: float,
+                      seconds: float) -> "FaultSchedule":
+        self.at(start_s, f"lag:{tier}",
+                lambda: self.injector.add_latency(tier, seconds), tier)
+        self.at(end_s, f"unlag:{tier}",
+                lambda: self.injector.add_latency(tier, 0.0), tier)
+        return self
+
+    def kill_stream(self, tier: str, at_s: float, after_chunks: int
+                    ) -> "FaultSchedule":
+        self.at(at_s, f"streamkill:{tier}",
+                lambda: self.injector.fail_stream_after(
+                    tier, after_chunks,
+                    error="scheduled mid-stream kill"), tier)
+        return self
+
+    # -- driver -------------------------------------------------------------
+
+    def duration_s(self) -> float:
+        return max((t for t, _, _ in self._events), default=0.0)
+
+    def start(self) -> "FaultSchedule":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        events = sorted(self._events, key=lambda e: e[0])
+        t0 = time.monotonic()
+
+        def drive():
+            for offset, label, fn in events:
+                wait = offset - (time.monotonic() - t0)
+                if wait > 0 and self._stop.wait(wait):
+                    return
+                if self._stop.is_set():
+                    return
+                try:
+                    fn()
+                except Exception:
+                    pass
+                with self._lock:
+                    self.applied.append(
+                        (round(time.monotonic() - t0, 3), label))
+
+        self._thread = threading.Thread(target=drive, daemon=True,
+                                        name="fault-schedule")
+        self._thread.start()
+        return self
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def stop(self) -> None:
+        """Halt the driver and restore every touched tier (no schedule
+        may leak a sticky outage past its run)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        for tier in self._tiers:
+            self.injector.restore(tier)

@@ -48,3 +48,16 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    # Tier-1 runs `-m 'not slow'` (ROADMAP.md): slow marks the soak/chaos
+    # legs excluded from it; chaos marks scripted-fault harness scenarios
+    # (run them alone with `-m chaos`).  Registered here because the repo
+    # has no pytest.ini.
+    config.addinivalue_line(
+        "markers", "slow: long-running soak/chaos legs, excluded from "
+                   "tier-1 (-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: scripted-fault chaos-soak scenarios "
+                   "(utils/faults.py FaultSchedule)")
