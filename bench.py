@@ -189,6 +189,30 @@ def _aggregate_strategy(records, ttfts) -> dict:
     return out
 
 
+def _trace_quantiles(obs, strategies) -> dict:
+    """Per-strategy TTFT/TBT percentiles read from the sweep router's own
+    metric registry (obs/metrics.py histograms, fed by the request span
+    trees) — the self-instrumented counterpart of the wall-clock columns.
+    Covers every request the router served under that strategy label
+    (sequential + concurrent legs, and perf's warm pass); quantiles are
+    log-bucket-interpolated, so they carry bucket-width precision."""
+    out: dict = {}
+    for metric, prefix in (("dllm_ttft_ms", "ttft"), ("dllm_tbt_ms", "tbt")):
+        fam = obs.metrics.get(metric)
+        if fam is None:
+            continue
+        children = fam.children()
+        for strategy in strategies:
+            hist = children.get((strategy,))
+            if hist is None or not hist.count:
+                continue
+            entry = out.setdefault(strategy, {})
+            entry[f"trace_p50_{prefix}_ms"] = round(hist.quantile(0.5), 2)
+            entry[f"trace_p95_{prefix}_ms"] = round(hist.quantile(0.95), 2)
+            entry[f"trace_{prefix}_n"] = hist.count
+    return out
+
+
 def compact(result: dict) -> dict:
     """The FINAL printed line, sized for the driver's tail capture.
 
@@ -220,11 +244,17 @@ def compact(result: dict) -> dict:
                          "scaled": bool(bud.get("scaled"))}
     strategies = result.get("per_strategy")
     if isinstance(strategies, dict):
+        # t50/t95 = trace-derived p50/p95 TTFT, tbt50 = trace-derived
+        # p50 time-between-tokens (registry histograms, ISSUE 3) — the
+        # self-instrumented columns next to the wall-clock ones.
         out["per_strategy"] = {
             name: {k: v for k, v in {
                 "req_per_s": entry.get("req_per_s"),
                 "spd": entry.get("concurrent_speedup"),
                 "acc": entry.get("routing_accuracy"),
+                "t50": entry.get("trace_p50_ttft_ms"),
+                "t95": entry.get("trace_p95_ttft_ms"),
+                "tbt50": entry.get("trace_p50_tbt_ms"),
             }.items() if v is not None}
             for name, entry in strategies.items()
             if isinstance(entry, dict)}
@@ -1159,8 +1189,16 @@ def run(progress: "Progress" = None, budget: "Budget" = None) -> dict:
     # the unit suite's default Routers must keep the tiny tiers.
     from distributed_llm_tpu.serving.router import default_cluster
     cluster = default_cluster(cpu_bench=True) if backend == "cpu" else None
+    # Fresh observability bundle for the headline router (obs/): its
+    # registry sees ONLY this sweep's requests, so the trace-derived
+    # per-strategy TTFT/TBT percentiles read below are self-instrumented
+    # ground truth for exactly the traffic the wall-clock numbers
+    # describe — not polluted by warmup, trend, or chaos legs on the
+    # process-global registry.
+    from distributed_llm_tpu.obs import Observability
+    sweep_obs = Observability(slow_ms=None)
     router = Router(strategy=STRATEGIES[0], benchmark_mode=True,
-                    cluster=cluster)
+                    cluster=cluster, observability=sweep_obs)
     cluster_served = {t: getattr(router.cluster, t).model_preset
                       for t in ("nano", "orin")}
     progress.section("cluster", cluster_served)
@@ -1358,6 +1396,12 @@ def run(progress: "Progress" = None, budget: "Budget" = None) -> dict:
                   "sweep budget share exhausted", file=sys.stderr,
                   flush=True)
             break
+    # Trace-derived per-strategy latency columns (ISSUE 3): the router's
+    # own span trees → registry histograms → p50/p95 TTFT and TBT, so
+    # the north-star metric is self-instrumented rather than inferred
+    # from bench-side wall-clock deltas alone.
+    for strategy, extra in _trace_quantiles(sweep_obs, STRATEGIES).items():
+        per_strategy.setdefault(strategy, {}).update(extra)
     progress.section("per_strategy", dict(per_strategy))
 
     # Per-tier phase attribution (tokenize/prefill/decode/detok), roofline

@@ -34,9 +34,13 @@ import time
 DISPATCH_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "ab_dispatch.json")
 
-# Every case class micro_ab can measure (--kinds validates against this).
-ALL_KINDS = frozenset({"prefill", "decode", "decode_q8", "chunk",
-                       "chunk_q8", "paged_decode", "paged_decode_q8"})
+# Every case class micro_ab can measure (--kinds validates against it) —
+# derived from the serving ops' own dispatch-kind registry so the A/B
+# grid and the dispatching wrappers can never cover different kernel
+# sets (tests/test_kernel_dispatch.py pins the equality).
+from ..ops.attention import DISPATCH_KINDS
+
+ALL_KINDS = frozenset(DISPATCH_KINDS)
 
 
 def _time_fn(fn, args, repeat: int):
@@ -256,6 +260,26 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
                        (q, kq, vq, ksc, vsc, tables, pos),
                        PA.paged_decode_attention_q8,
                        (q, kq, vq, ksc, vsc, tables, pos), {"batch": b})
+
+        # paged chunk prefill (prefix-reuse admissions — engine/paged_kv.
+        # chunk_prefill_paged): one 128-token suffix attending through a
+        # slot's block table over a window of this length.
+        if want("paged_chunk") and s >= 128 and s % bs == 0:
+            sc = 128
+            nb = s // bs
+            kp = jax.random.normal(key, (nkv, nb + 1, bs, d), bf16)
+            vp = jax.random.normal(key, (nkv, nb + 1, bs, d), bf16)
+            table = jnp.arange(nb, dtype=jnp.int32)
+            start = jnp.asarray([s - sc], jnp.int32)
+            qpos = (jnp.arange(sc, dtype=jnp.int32) + (s - sc))[None]
+            q = jax.random.normal(key, (1, sc, nq, d), bf16)
+            record("paged_chunk", s,
+                   lambda *a, s=s: A.paged_chunk(a[0], a[1], a[2], a[3],
+                                                 a[4], a[5], s, impl="xla"),
+                   (q, kp, vp, table, start, qpos),
+                   lambda *a, s=s: PA.paged_chunk_attention(
+                       a[0], a[1], a[2], a[3], a[4], s),
+                   (q, kp, vp, table, start, qpos), {"chunk": sc})
 
     # Dispatch decision: pallas must win (or tie) at EVERY tested batch of
     # a (kind, length) to own it — robust beats optimal.  Each kind also

@@ -524,9 +524,23 @@ def flagship_cluster(n_devices: Optional[int] = None) -> ClusterConfig:
                           max_new_tokens=128, decode_batch=4,
                           prefill_buckets=(256, 1024, 2048))
     else:
+        # int8 WEIGHTS are a fit requirement here (14 GB bf16 weights
+        # alone overflow the 16 GB chip — tests/test_flagship.py); int8
+        # KV is a PERF knob, and the measurements say it doesn't pay:
+        # r4 measured kv-int8 0.53× the bf16-KV rate, and the r5
+        # re-measure on real-trained tiers landed ~break-even
+        # (0.99×/0.95× — BENCHMARKS.md, bench/tuning.json evidence), so
+        # it defaults OFF like everywhere else (VERDICT r5 #4: no
+        # on-chip tuning table exists to justify it).  Opt back in with
+        # DLLM_FLAGSHIP_KV_INT8=1 (the A/B flag) or a measured TPU
+        # tuning.json; the HBM budget fits with bf16 KV (the budget
+        # test pins it).
+        import os
+        kv = ("int8" if os.environ.get("DLLM_FLAGSHIP_KV_INT8") == "1"
+              else "none")
         orin = TierConfig(name="orin", model_preset="orin_8b", tp=1,
                           max_new_tokens=128, quantize="int8",
-                          kv_quantize="int8", decode_batch=4,
+                          kv_quantize=kv, decode_batch=4,
                           prefill_buckets=(256, 1024, 2048))
     return ClusterConfig(nano=nano, orin=orin)
 

@@ -35,6 +35,7 @@ import numpy as np
 from ..config import TierConfig
 from .. import models
 from ..models import transformer
+from ..obs import spans as obs_spans
 from .inference import (GenerationResult, prepare_prompt, trim_at_eos,
                         upgrade_attention_impl)
 from .paged_kv import (BlockAllocator, PagedConfig, TRASH_BLOCK,
@@ -66,6 +67,10 @@ class _Request:
     # Streaming: when set, every accepted token id is pushed here as it is
     # produced; None terminates the stream (see generate_stream).
     token_queue: Optional["queue.Queue"] = None
+    # The submitting request's span tree (obs/spans.py), captured at
+    # submit() because the scheduler thread has no request context of
+    # its own.  None (direct engine use, tests) disables tracing.
+    trace: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -358,6 +363,11 @@ class ContinuousBatchingEngine:
         return blocks
 
     def _admit(self, req: _Request, slot_ix: int) -> bool:
+        # Submit-to-slot wait (the admission queue + any KV-pressure
+        # requeues): the trace's queue_wait_ms and the registry's
+        # queue-wait histogram both read this one stamp.
+        obs_spans.annotate(req.trace, queue_wait_ms=round(
+            (time.perf_counter() - req.t_submit) * 1000.0, 3))
         ids, bucket = prepare_prompt(self.tokenizer, req.history,
                                      self.tier.prefill_buckets,
                                      self.cfg.max_seq_len,
@@ -404,7 +414,9 @@ class ContinuousBatchingEngine:
                 tokens[0, :len(suffix)] = suffix
                 window = next(w for w in self._chunk_windows
                               if w >= m + sb)
-                with self.phases.phase("prefill"):
+                with obs_spans.span(req.trace, "prefill", reused_tokens=m,
+                                    suffix_bucket=sb), \
+                        self.phases.phase("prefill"):
                     first, self.pool = self._chunk_prefill_fn(sb, window)(
                         self.params, self.pool, jnp.asarray(tokens),
                         jnp.asarray([m], np.int32), jnp.asarray([n], np.int32),
@@ -426,7 +438,8 @@ class ContinuousBatchingEngine:
                 tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
                 tokens[0, :n] = ids
 
-                with self.phases.phase("prefill"):
+                with obs_spans.span(req.trace, "prefill", bucket=bucket), \
+                        self.phases.phase("prefill"):
                     first, k_all, v_all = self._prefill_fn(bucket)(
                         self.params, jnp.asarray(tokens),
                         jnp.asarray([n], np.int32), rng, jnp.float32(temp))
@@ -446,6 +459,7 @@ class ContinuousBatchingEngine:
         slot = _Slot(request=req, blocks=blocks, prompt_len=n, budget=budget,
                      temperature=temp, ttft_ms=ttft_ms, tokens=[first],
                      prompt_ids=tuple(ids))
+        obs_spans.add_token(req.trace)       # the prefill's primed token
         if req.token_queue is not None:
             req.token_queue.put(first)
         self._slots[slot_ix] = slot
@@ -462,8 +476,10 @@ class ContinuousBatchingEngine:
         gen_ids = trim_at_eos(slot.tokens, self.tokenizer.eos_id,
                               self.tokenizer.pad_id)
         req = slot.request
+        with obs_spans.span(req.trace, "detokenize", tokens=len(gen_ids)):
+            text = self.tokenizer.decode(gen_ids)
         req.result = GenerationResult(
-            text=self.tokenizer.decode(gen_ids),
+            text=text,
             token_ids=gen_ids,
             prompt_tokens=slot.prompt_len,
             gen_tokens=len(gen_ids),
@@ -585,6 +601,11 @@ class ContinuousBatchingEngine:
                         continue             # finished at an earlier t
                     tok = int(toks[t, ix])
                     slot.tokens.append(tok)
+                    # Tick-granular decode timeline: a tick's T tokens
+                    # stamp together because that is when they become
+                    # observable (one device call per tick).  One list
+                    # append per token — no span objects on this path.
+                    obs_spans.add_token(slot.request.trace)
                     if slot.request.token_queue is not None:
                         slot.request.token_queue.put(tok)
                     self._pos[ix] += 1
@@ -641,7 +662,8 @@ class ContinuousBatchingEngine:
                token_queue: Optional["queue.Queue"] = None) -> _Request:
         self.start()
         req = _Request(history=history, max_new_tokens=max_new_tokens,
-                       temperature=temperature, token_queue=token_queue)
+                       temperature=temperature, token_queue=token_queue,
+                       trace=obs_spans.current_trace())
         self._queue.put(req)
         self._wake.set()
         return req

@@ -21,6 +21,7 @@ from typing import Any, Dict, Optional, Sequence
 import jax
 
 from ..config import TierConfig
+from ..obs import spans as obs_spans
 from .inference import InferenceEngine
 
 logger = logging.getLogger(__name__)
@@ -43,6 +44,14 @@ class EngineManager:
         self._engine: Optional[InferenceEngine] = None
         self._lock = threading.RLock()
         self._started_at: Optional[float] = None
+        # Watchdog-wedge edge detector: health() counts CLOSED→WEDGED
+        # transitions (not every probe of a wedged engine) into the
+        # global registry's dllm_watchdog_wedged_total.  Own lock: the
+        # stall check deliberately runs OUTSIDE the lifecycle lock, and
+        # concurrent health() callers (HealthMonitor probe + /stats)
+        # must not double-count one wedge.
+        self._wedged_seen = False
+        self._wedged_lock = threading.Lock()
 
     # -- lifecycle (ServerManager surface) ---------------------------------
 
@@ -123,6 +132,8 @@ class EngineManager:
                 stop()                      # batching engine: join its loop
             self._engine = None
             self._started_at = None
+            with self._wedged_lock:
+                self._wedged_seen = False
 
     def is_server_running(self) -> bool:
         with self._lock:
@@ -186,6 +197,23 @@ class EngineManager:
                 entry["error"] = (f"decode watchdog: no step progress for "
                                   f"{stall_s:.1f}s (deadline "
                                   f"{deadline:.0f}s)")
+                with self._wedged_lock:
+                    rising = not self._wedged_seen
+                    self._wedged_seen = True
+                if rising:
+                    # Rising edge only: the wedge COUNT must mean "times
+                    # this engine wedged", not "times health() looked".
+                    # The manager has no injection path, so this lands
+                    # in the process-global registry (obs/__init__.py).
+                    try:
+                        from ..obs import get_observability
+                        get_observability().m.watchdog_wedged.labels(
+                            self.tier.name).inc()
+                    except Exception:
+                        pass
+            else:
+                with self._wedged_lock:
+                    self._wedged_seen = False
         admission = getattr(self, "admission", None)
         if admission is not None:
             adm = admission.snapshot()

@@ -1,0 +1,77 @@
+"""Request-level observability: span traces, metrics, flight recorder.
+
+The bundle class ``Observability`` ties the three surfaces together:
+
+- ``obs.trace(...)`` — a fresh per-request span tree (obs/spans.py),
+  created by the router at request entry and threaded through tiers and
+  engines (``spans.use_trace`` / ``spans.current_trace``).
+- ``obs.m`` — the standard serving metric set (obs/metrics.py
+  ServingMetrics) over ``obs.metrics``, rendered at ``GET /metrics``.
+- ``obs.recorder`` — the failed/degraded/slow flight recorder
+  (obs/recorder.py), dumped at ``GET /stats?debug=1``.
+
+One process-global default instance (``get_observability()``) backs the
+serving entry points and everything that lacks an injection path (the
+engine managers' wedge counter, breaker hooks on default routers); the
+Router takes an ``observability=`` override so tests and bench legs can
+read from a registry no other traffic writes to.  ``DLLM_OBS_SLOW_MS``
+tunes the global recorder's slow threshold (ms; empty/unset = 30000;
+``0`` or ``off`` disables the slow trigger — failed/degraded requests
+still record).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from . import metrics, recorder, spans                       # noqa: F401
+from .metrics import MetricsRegistry, ServingMetrics
+from .recorder import FlightRecorder
+from .spans import RequestTrace, current_trace, use_trace    # noqa: F401
+
+
+class Observability:
+    """One registry + metric set + recorder + trace factory."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 flight: Optional[FlightRecorder] = None,
+                 slow_ms: Optional[float] = 30000.0):
+        self.metrics = registry if registry is not None else MetricsRegistry()
+        self.m = ServingMetrics(self.metrics)
+        self.recorder = (flight if flight is not None
+                         else FlightRecorder(slow_ms=slow_ms))
+
+    def trace(self, name: str = "request", **attrs) -> RequestTrace:
+        return RequestTrace(name, **attrs)
+
+
+_GLOBAL: Optional[Observability] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_observability() -> Observability:
+    """The process-global default bundle (created on first use)."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                raw = os.environ.get("DLLM_OBS_SLOW_MS", "").strip().lower()
+                slow_ms: Optional[float] = 30000.0
+                if raw in ("off", "none"):
+                    slow_ms = None
+                elif raw:
+                    try:
+                        slow_ms = float(raw)
+                    except ValueError:
+                        slow_ms = 30000.0
+                    else:
+                        # 0-disables, matching the repo's convention
+                        # (breaker_failures=0 etc.) — a zero threshold
+                        # would otherwise record EVERY request and evict
+                        # the post-mortems the ring exists to keep.
+                        if slow_ms <= 0:
+                            slow_ms = None
+                _GLOBAL = Observability(slow_ms=slow_ms)
+    return _GLOBAL

@@ -1,0 +1,326 @@
+"""Metrics registry: counters, gauges, log-bucketed histograms, and a
+Prometheus text exposition — stdlib only.
+
+The serving stack's internal signals (queue depth, breaker state, wedge
+flags, admission rejects — PRs 1 and 2) previously surfaced only as an
+untyped ``GET /stats`` dict; this registry gives them a typed, scrapeable
+shape, served as Prometheus exposition text at ``GET /metrics``
+(serving/app.py) and read programmatically by bench.py for the
+trace-derived headline columns.
+
+Shape notes:
+
+- A metric is a FAMILY (name + help + label names) of children keyed by
+  label values: ``reg.counter("x_total", "…", ("tier",)).labels("nano")``.
+  A label-less family is its own single child (``.inc()`` directly).
+- Histograms use a fixed LOG-SPACED millisecond bucket ladder
+  (sub-ms to minutes): latencies span 4+ orders of magnitude between the
+  tiny CPU tiers and a wedged chip's timeout, and log buckets hold the
+  relative quantile error roughly constant across that range where
+  linear buckets would collapse one end or the other.
+- ``Histogram.quantile`` interpolates within the winning bucket
+  (the same estimate PromQL's histogram_quantile makes) — good to the
+  bucket's width, which is the honest precision of any bucketed store.
+- Thread-safety: one lock per registry guards family/child creation;
+  each child then updates under its own lock.  Hot-path cost is one
+  dict lookup + one lock + a float add (see the overhead test in
+  tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Log-spaced ms ladder: 1-2-5 per decade from 0.5 ms to 120 s.
+DEFAULT_BUCKETS_MS: Tuple[float, ...] = (
+    0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500,
+    1000, 2000, 5000, 10000, 20000, 60000, 120000)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample formatting: integers without a trailing .0."""
+    if v == int(v):
+        return str(int(v))
+    return repr(float(v))
+
+
+def _label_str(names: Sequence[str], values: Sequence[str]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{_escape(v)}"' for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+def _escape(v: Any) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
+        "\n", r"\n")
+
+
+class Counter:
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    __slots__ = ("_lock", "buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS_MS):
+        self._lock = threading.Lock()
+        self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
+        self.counts: List[int] = [0] * (len(self.buckets) + 1)  # +Inf tail
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        ix = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self.counts[ix] += 1
+            self.sum += v
+            self.count += 1
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile estimate (None when empty).
+        Matches PromQL histogram_quantile: linear within the winning
+        bucket; the +Inf bucket clamps to the highest finite bound."""
+        with self._lock:
+            total = self.count
+            counts = list(self.counts)
+        if total == 0:
+            return None
+        rank = q * total
+        cum = 0.0
+        for ix, c in enumerate(counts):
+            cum += c
+            if cum >= rank and c > 0:
+                if ix >= len(self.buckets):          # +Inf bucket
+                    return self.buckets[-1]
+                lo = self.buckets[ix - 1] if ix > 0 else 0.0
+                hi = self.buckets[ix]
+                frac = (rank - (cum - c)) / c
+                return lo + (hi - lo) * frac
+        return self.buckets[-1]
+
+
+class _Family:
+    """One metric family: kind + help + label names + children."""
+
+    def __init__(self, name: str, help_: str, kind: str,
+                 label_names: Sequence[str],
+                 buckets: Sequence[float] = DEFAULT_BUCKETS_MS):
+        self.name = name
+        self.help = help_
+        self.kind = kind                     # "counter" | "gauge" | "histogram"
+        self.label_names = tuple(label_names)
+        self._buckets = tuple(buckets)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], Any] = {}
+        if not self.label_names:
+            self._default = self._make()
+            self._children[()] = self._default
+
+    def _make(self):
+        if self.kind == "counter":
+            return Counter()
+        if self.kind == "gauge":
+            return Gauge()
+        return Histogram(self._buckets)
+
+    def labels(self, *values: Any):
+        """The child for these label values (created on first use)."""
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, "
+                f"got {values!r}")
+        key = tuple(str(v) for v in values)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(key, self._make())
+        return child
+
+    # Label-less convenience: the family IS its single child.
+    def inc(self, n: float = 1.0) -> None:
+        self._children[()].inc(n)
+
+    def set(self, v: float) -> None:
+        self._children[()].set(v)
+
+    def observe(self, v: float) -> None:
+        self._children[()].observe(v)
+
+    @property
+    def value(self) -> float:
+        return self._children[()].value
+
+    def children(self) -> Dict[Tuple[str, ...], Any]:
+        with self._lock:
+            return dict(self._children)
+
+
+class MetricsRegistry:
+    """Named families; renders the whole set as Prometheus text."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def _family(self, name: str, help_: str, kind: str,
+                labels: Sequence[str],
+                buckets: Sequence[float] = DEFAULT_BUCKETS_MS) -> _Family:
+        fam = self._families.get(name)
+        if fam is not None:
+            if fam.kind != kind or fam.label_names != tuple(labels):
+                raise ValueError(
+                    f"metric {name!r} re-registered as {kind}{tuple(labels)} "
+                    f"(was {fam.kind}{fam.label_names})")
+            return fam
+        with self._lock:
+            return self._families.setdefault(
+                name, _Family(name, help_, kind, labels, buckets))
+
+    def counter(self, name: str, help_: str = "",
+                labels: Sequence[str] = ()) -> _Family:
+        return self._family(name, help_, "counter", labels)
+
+    def gauge(self, name: str, help_: str = "",
+              labels: Sequence[str] = ()) -> _Family:
+        return self._family(name, help_, "gauge", labels)
+
+    def histogram(self, name: str, help_: str = "",
+                  labels: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS_MS) -> _Family:
+        return self._family(name, help_, "histogram", labels, buckets)
+
+    def get(self, name: str) -> Optional[_Family]:
+        return self._families.get(name)
+
+    # -- exposition --------------------------------------------------------
+
+    def render(self) -> str:
+        """Prometheus text exposition (text/plain; version=0.0.4)."""
+        lines: List[str] = []
+        with self._lock:
+            families = sorted(self._families.values(), key=lambda f: f.name)
+        for fam in families:
+            lines.append(f"# HELP {fam.name} {_escape(fam.help)}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            for key, child in sorted(fam.children().items()):
+                if fam.kind == "histogram":
+                    cum = 0
+                    for ix, bound in enumerate(child.buckets):
+                        cum += child.counts[ix]
+                        labels = _label_str(
+                            fam.label_names + ("le",),
+                            key + (_fmt(bound),))
+                        lines.append(f"{fam.name}_bucket{labels} {cum}")
+                    labels = _label_str(fam.label_names + ("le",),
+                                        key + ("+Inf",))
+                    lines.append(f"{fam.name}_bucket{labels} {child.count}")
+                    base = _label_str(fam.label_names, key)
+                    lines.append(f"{fam.name}_sum{base} {_fmt(child.sum)}")
+                    lines.append(f"{fam.name}_count{base} {child.count}")
+                else:
+                    labels = _label_str(fam.label_names, key)
+                    lines.append(f"{fam.name}{labels} {_fmt(child.value)}")
+        return "\n".join(lines) + "\n"
+
+
+class ServingMetrics:
+    """The serving stack's standard metric set, declared once so the
+    router, breaker hooks, engine managers, /metrics, and bench.py all
+    read/write the same families (one assembler, no name drift)."""
+
+    def __init__(self, registry: MetricsRegistry):
+        self.registry = registry
+        self.requests = registry.counter(
+            "dllm_requests_total",
+            "Requests completed, by strategy/tier/outcome (outcome: "
+            "ok|error|degraded)", ("strategy", "tier", "outcome"))
+        self.ttft_ms = registry.histogram(
+            "dllm_ttft_ms", "Time to first token per request (engine-true "
+            "when reported, else first observed token)", ("strategy",))
+        self.tbt_ms = registry.histogram(
+            "dllm_tbt_ms", "Mean time between tokens per request",
+            ("strategy",))
+        self.queue_wait_ms = registry.histogram(
+            "dllm_queue_wait_ms", "Submit-to-batch-slot-admission wait in "
+            "the tier's engine", ("tier",))
+        self.request_ms = registry.histogram(
+            "dllm_request_ms", "End-to-end routed request wall time",
+            ("strategy",))
+        self.admission_rejected = registry.counter(
+            "dllm_admission_rejected_total",
+            "Requests shed by tier admission control", ("tier",))
+        self.retries = registry.counter(
+            "dllm_retries_total", "Same-tier transient-error retries",
+            ("tier",))
+        self.failovers = registry.counter(
+            "dllm_failovers_total",
+            "Tier failovers, by failed tier and kind (sync|stream_setup|"
+            "mid_stream)", ("tier", "kind"))
+        self.breaker_transitions = registry.counter(
+            "dllm_breaker_transitions_total",
+            "Circuit-breaker state transitions, by tier and target state",
+            ("tier", "to"))
+        self.breaker_state = registry.gauge(
+            "dllm_breaker_state",
+            "Circuit state per tier (0=closed, 1=half_open, 2=open)",
+            ("tier",))
+        self.watchdog_wedged = registry.counter(
+            "dllm_watchdog_wedged_total",
+            "Decode-watchdog wedge declarations (health flips ok=False)",
+            ("tier",))
+        self.cache_hits = registry.counter(
+            "dllm_cache_hits_total",
+            "Cache hits by tier of cache (response|response_degraded|"
+            "routing|prefix_affinity)", ("cache",))
+        self.degraded = registry.counter(
+            "dllm_degraded_total",
+            "Requests served by the degraded path (all circuits open)")
+        self.flight_records = registry.counter(
+            "dllm_flight_records_total",
+            "Flight-recorder captures by reason (error|degraded|slow)",
+            ("reason",))
+
+
+_BREAKER_STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}
+
+
+def breaker_state_value(state: str) -> int:
+    return _BREAKER_STATE_VALUE.get(state, 0)

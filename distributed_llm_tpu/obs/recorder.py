@@ -1,0 +1,75 @@
+"""Flight recorder: the last N interesting requests, in full.
+
+Aggregates (obs/metrics.py) answer "how often / how slow"; the flight
+recorder answers "what exactly happened to THAT request": a bounded ring
+buffer retaining the complete span tree plus the serving stack's state
+snapshot (tier load, breaker states) for the last ``capacity``
+failed / degraded / slow requests.  Retrieval: ``GET /stats?debug=1``
+(serving/app.py) — the post-mortem surface for a request that timed out
+or got degraded service hours ago on a box nobody was watching.
+
+Healthy-fast requests are deliberately NOT retained: at serving rates
+the interesting requests are a trickle and the boring ones are a flood;
+recording everything would evict the post-mortem material the recorder
+exists to keep.  The ``slow_ms`` threshold marks "slow" (None disables
+the slow trigger; failed/degraded always record).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .spans import RequestTrace
+
+DEFAULT_CAPACITY = 32
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 slow_ms: Optional[float] = 30000.0):
+        self.capacity = max(1, int(capacity))
+        self.slow_ms = slow_ms
+        self._lock = threading.Lock()
+        self._ring: "deque[Dict[str, Any]]" = deque(maxlen=self.capacity)
+        self.recorded_total = 0
+
+    def classify(self, ok: bool, degraded: bool,
+                 duration_ms: Optional[float]) -> Optional[str]:
+        """The capture reason for a finished request, or None (don't
+        record).  Degraded outranks error (it carries more state worth
+        keeping); slow only applies to otherwise-healthy requests."""
+        if degraded:
+            return "degraded"
+        if not ok:
+            return "error"
+        if (self.slow_ms is not None and duration_ms is not None
+                and duration_ms >= self.slow_ms):
+            return "slow"
+        return None
+
+    def record(self, reason: str, trace: RequestTrace,
+               snapshot: Optional[Dict[str, Any]] = None) -> None:
+        """Retain one request (trace serialized NOW — span objects must
+        not outlive this call's view of them)."""
+        entry = {
+            "ts": round(time.time(), 3),
+            "reason": reason,
+            "trace": trace.to_dict(),
+        }
+        if snapshot:
+            entry["state"] = snapshot
+        with self._lock:
+            self._ring.append(entry)
+            self.recorded_total += 1
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """Most-recent-first copy of the ring (the /stats?debug=1 body)."""
+        with self._lock:
+            return list(reversed(self._ring))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)

@@ -234,10 +234,23 @@ def create_app(router: Optional[Router] = None,
     for route in ui_files:
         app.route(route, methods=["GET"])(_make_ui_view(route))
 
+    @app.route("/metrics", methods=["GET"])
+    def metrics():
+        """Prometheus text exposition of the serving metric registry
+        (obs/metrics.py): TTFT/TBT/queue-wait histograms, admission
+        rejects, breaker transitions + state, watchdog wedges, cache
+        hits, degraded count.  Scrape-friendly twin of GET /stats."""
+        body = state["router"].obs.metrics.render().encode("utf-8")
+        return static_response(
+            body, "text/plain; version=0.0.4; charset=utf-8")
+
     @app.route("/stats", methods=["GET"])
     def stats():
         """Observability snapshot (SURVEY.md §5.5): routing-cache health,
-        per-tier engine state + phase timings, device memory."""
+        per-tier engine state + phase timings, device memory.  With
+        ``?debug=1``: the flight recorder's ring — full span trees +
+        serving-state snapshots of the last N failed/degraded/slow
+        requests (obs/recorder.py) — for post-mortems."""
         from ..utils.telemetry import device_memory_snapshot
         with state_lock:
             router_ = state["router"]
@@ -285,7 +298,7 @@ def create_app(router: Optional[Router] = None,
                                     else "none")
         except Exception:
             provenance["tuning"] = "none"
-        return jsonify({
+        payload = {
             "strategy": strategy,
             "sessions": sessions,
             "cache": cache_stats,
@@ -300,7 +313,14 @@ def create_app(router: Optional[Router] = None,
                         if getattr(router_, "breaker", None) is not None
                         else None),
             "degraded_served": getattr(router_, "degraded_served", 0),
-        })
+        }
+        if request.args.get("debug") == "1":
+            obs = getattr(router_, "obs", None)
+            if obs is not None:
+                payload["flight_recorder"] = obs.recorder.snapshot()
+                payload["flight_recorded_total"] = \
+                    obs.recorder.recorded_total
+        return jsonify(payload)
 
     @app.route("/history", methods=["GET"])
     def get_history():

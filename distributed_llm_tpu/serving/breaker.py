@@ -44,10 +44,18 @@ class CircuitBreaker:
     """One state machine per tier, keyed by tier name."""
 
     def __init__(self, tiers: Iterable[str], failure_threshold: int = 5,
-                 cooldown_s: float = 30.0, clock=time.monotonic):
+                 cooldown_s: float = 30.0, clock=time.monotonic,
+                 on_transition=None):
+        """``on_transition(tier, old_state, new_state)`` fires on every
+        state change (the Router wires the obs/ transition counter and
+        state gauge through it).  Called WHILE HOLDING the breaker lock,
+        so implementations must be cheap and must never call back into
+        the breaker; exceptions are swallowed (observability must not
+        change breaker behavior)."""
         self.failure_threshold = int(failure_threshold)
         self.cooldown_s = float(cooldown_s)
         self._clock = clock
+        self._on_transition = on_transition
         self._lock = threading.Lock()
         names = list(tiers)
         self._state: Dict[str, str] = {t: CLOSED for t in names}
@@ -68,6 +76,19 @@ class CircuitBreaker:
     def enabled(self) -> bool:
         return self.failure_threshold > 0
 
+    def _set_state(self, tier: str, new: str) -> None:
+        """State write + transition notification (caller holds the lock).
+        No-op (and no notification) when the state doesn't change."""
+        old = self._state[tier]
+        if old == new:
+            return
+        self._state[tier] = new
+        if self._on_transition is not None:
+            try:
+                self._on_transition(tier, old, new)
+            except Exception:
+                pass
+
     # -- routing-time consultation ----------------------------------------
 
     def allow(self, tier: str) -> bool:
@@ -85,7 +106,7 @@ class CircuitBreaker:
                 opened = self._opened_at.get(tier, 0.0)
                 if self._clock() - opened < self.cooldown_s:
                     return False
-                self._state[tier] = HALF_OPEN
+                self._set_state(tier, HALF_OPEN)
                 self._probe_inflight[tier] = True
                 self._probe_started[tier] = self._clock()
                 logger.info("breaker %s: cooldown expired -> half-open "
@@ -125,7 +146,7 @@ class CircuitBreaker:
             if ok:
                 if self._state[tier] != CLOSED:
                     logger.info("breaker %s: probe succeeded -> closed", tier)
-                self._state[tier] = CLOSED
+                self._set_state(tier, CLOSED)
                 self._consecutive[tier] = 0
                 return
             self._consecutive[tier] += 1
@@ -138,7 +159,7 @@ class CircuitBreaker:
                         "breaker %s: OPEN after %d consecutive failures "
                         "(cooldown %.1fs)", tier, self._consecutive[tier],
                         self.cooldown_s)
-                self._state[tier] = OPEN
+                self._set_state(tier, OPEN)
                 self._opened_at[tier] = self._clock()
 
     def note_probe(self, tier: str, healthy: bool) -> None:
@@ -154,7 +175,7 @@ class CircuitBreaker:
             if (healthy and self._state[tier] == OPEN
                     and self._clock() - self._opened_at.get(tier, 0.0)
                     >= self.cooldown_s):
-                self._state[tier] = HALF_OPEN
+                self._set_state(tier, HALF_OPEN)
                 self._probe_inflight[tier] = False
                 logger.info("breaker %s: healthy liveness probe past "
                             "cooldown -> half-open", tier)
@@ -175,7 +196,7 @@ class CircuitBreaker:
         if tier not in self._state:
             return
         with self._lock:
-            self._state[tier] = CLOSED
+            self._set_state(tier, CLOSED)
             self._consecutive[tier] = 0
             self._probe_inflight[tier] = False
 

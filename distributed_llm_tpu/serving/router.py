@@ -31,6 +31,10 @@ import jax
 
 from ..config import (ClusterConfig, bench_cluster, resolve_config,
                       tiny_cluster)
+from ..obs import Observability, get_observability
+from ..obs import spans as obs_spans
+from ..obs.metrics import breaker_state_value
+from ..obs.spans import current_trace, use_trace
 from ..routing.engine import QueryRouter
 from ..routing.token_counter import TokenCounter
 from ..utils.faults import FaultInjector
@@ -93,11 +97,17 @@ class Router:
         cluster: Optional[ClusterConfig] = None,
         devices: Optional[Sequence[jax.Device]] = None,
         fault_injector: Optional[FaultInjector] = None,
+        observability: Optional[Observability] = None,
     ):
         """strategy: "token" | "semantic" | "heuristic" | "hybrid" | "perf"
         benchmark_mode: True → BENCHMARK_CFG (cache off), False →
-        PRODUCTION_CFG, unless ``config`` overrides (src/router.py:37-40)."""
+        PRODUCTION_CFG, unless ``config`` overrides (src/router.py:37-40).
+        observability: metric/trace/flight-recorder bundle (obs/); None =
+        the process-global default — injectable so bench legs and tests
+        read registries no other traffic writes to."""
         self.token_counter = TokenCounter()
+        self.obs = (observability if observability is not None
+                    else get_observability())
         self.threshold_fallback = threshold_fallback
         self.benchmark_mode = benchmark_mode
         self.config = resolve_config(config, benchmark_mode)
@@ -123,7 +133,14 @@ class Router:
             self.breaker = CircuitBreaker(
                 [t.name for t in self.cluster.tiers()],
                 failure_threshold=self.cluster.breaker_failures,
-                cooldown_s=self.cluster.breaker_cooldown_s)
+                cooldown_s=self.cluster.breaker_cooldown_s,
+                on_transition=self._obs_breaker_transition)
+            # Export a closed (0) state sample per tier up front: a
+            # dashboard must read 0 for a healthy breaker, not "no
+            # series" — absence would be indistinguishable from the
+            # breaker being disabled.
+            for t in self.cluster.tiers():
+                self.obs.m.breaker_state.labels(t.name).set(0)
         # Bounded retry for transient error shapes (_TRANSIENT_MARKERS):
         # budgeted against the dispatching tier's request_timeout_s so
         # retry + failover never exceed the reference's per-request cap.
@@ -169,6 +186,73 @@ class Router:
 
     def set_threshold(self, threshold: int) -> None:
         self.threshold_fallback = threshold
+
+    # -- observability plumbing (obs/) -------------------------------------
+
+    def _obs_breaker_transition(self, tier: str, old: str, new: str) -> None:
+        """Breaker state changes → transition counter + state gauge."""
+        m = self.obs.m
+        m.breaker_transitions.labels(tier, new).inc()
+        m.breaker_state.labels(tier).set(breaker_state_value(new))
+
+    def _obs_state_snapshot(self) -> Dict[str, Any]:
+        """Cheap serving-state snapshot attached to flight-recorder
+        entries: per-tier load counters + breaker states.  Deliberately
+        NOT manager.health() — that takes the lifecycle lock, which a
+        mid-compile engine can hold for minutes."""
+        snap: Dict[str, Any] = {}
+        try:
+            tiers: Dict[str, Any] = {}
+            for name, tier in self.tiers.items():
+                fn = getattr(tier, "load_snapshot", None)
+                if fn is not None:
+                    tiers[name] = fn()
+            snap["tiers"] = tiers
+            if self.breaker is not None:
+                snap["breaker"] = self.breaker.snapshot()
+            snap["degraded_served"] = self.degraded_served
+        except Exception:                 # snapshot must never kill a reply
+            pass
+        return snap
+
+    def _finish_request(self, trace, which: Optional[str], ok: bool,
+                        degraded: bool = False, raw: Any = None) -> None:
+        """Close a request trace and derive its metrics + (for failed /
+        degraded / slow requests) its flight-recorder entry.  Called
+        exactly once per request, on every exit path of both pipelines."""
+        trace.finish(ok=ok)
+        m = self.obs.m
+        strategy = trace.attrs.get("strategy") or "unknown"
+        outcome = "degraded" if degraded else ("ok" if ok else "error")
+        m.requests.labels(strategy, which or "none", outcome).inc()
+        dur = trace.duration_ms
+        if dur is not None:
+            m.request_ms.labels(strategy).observe(dur)
+        # Engine-true per-request timing rides in the raw dict (additive
+        # keys, serving/tiers.py).  Cache hits skip the latency
+        # histograms: a cached reply's raw carries the ORIGINAL
+        # generation's timings, and its own TTFT is ~0 — both would
+        # poison the engine-latency distributions.
+        if not trace.attrs.get("cache_hit"):
+            if isinstance(raw, dict):
+                for key in ("ttft_ms", "total_ms", "gen_tokens"):
+                    val = raw.get(key)
+                    if val is not None:
+                        trace.annotate(**{key: val})
+            ttft = trace.ttft_ms()
+            if ttft is not None:
+                m.ttft_ms.labels(strategy).observe(ttft)
+            tbt = trace.tbt_ms()
+            if tbt is not None:
+                m.tbt_ms.labels(strategy).observe(tbt)
+        qw = trace.attrs.get("queue_wait_ms")
+        if qw is not None and which:
+            m.queue_wait_ms.labels(which).observe(float(qw))
+        reason = self.obs.recorder.classify(ok, degraded, dur)
+        if reason is not None:
+            m.flight_records.labels(reason).inc()
+            self.obs.recorder.record(reason, trace,
+                                     self._obs_state_snapshot())
 
     # -- helpers -----------------------------------------------------------
 
@@ -231,6 +315,9 @@ class Router:
                          f"conversation (decision was {device} at "
                          f"confidence {confidence:.2f}); {reasoning}")
             self.prefix_affinity_overrides += 1
+            self.obs.m.cache_hits.labels("prefix_affinity").inc()
+            obs_spans.event(current_trace(), "prefix_affinity_override",
+                            to=best, match_tokens=scores[best])
             return best, f"{method}+prefix_affinity", reasoning
         return device, method, reasoning
 
@@ -362,7 +449,10 @@ class Router:
         tier = self.tiers.get(device, self.nano)
         logger.info("Processing query on %s", tier.name)
         t0 = time.perf_counter()
-        raw = tier.process(history)
+        with obs_spans.span(current_trace(), "dispatch", tier=tier.name):
+            raw = tier.process(history)
+        if self._is_admission_rejection(raw):
+            self.obs.m.admission_rejected.labels(tier.name).inc()
         return raw, tier.name, (time.perf_counter() - t0) * 1000.0
 
     def _run_device_retrying(self, device: str, history: List[Dict[str, Any]],
@@ -392,6 +482,9 @@ class Router:
             logger.warning("%s transient error (%.80s) — retry %d/%d after "
                            "%.0fms", which, raw.get("error", ""),
                            attempt + 1, self.retry_attempts, backoff * 1000)
+            self.obs.m.retries.labels(which).inc()
+            obs_spans.event(current_trace(), "retry", tier=which,
+                            attempt=attempt + 1)
             time.sleep(backoff)
             raw2, _, lat2 = self._run_device(device, history)
             lat_ms += lat2
@@ -424,6 +517,10 @@ class Router:
             tokens = self.token_counter.count_tokens(
                 {"role": "assistant", "content": text})
             self.degraded_served += 1
+            self.obs.m.degraded.inc()
+            self.obs.m.cache_hits.labels("response_degraded").inc()
+            obs_spans.annotate(current_trace(), degraded=True,
+                               cache_hit="response_degraded")
             return {
                 "response": text,
                 "raw": cached.get("raw"),
@@ -444,6 +541,10 @@ class Router:
         tokens = self.token_counter.count_tokens(
             {"role": "assistant", "content": text})
         self.degraded_served += 1
+        self.obs.m.degraded.inc()
+        obs_spans.event(current_trace(), "degraded_fail_fast",
+                        retry_after_s=round(retry_after, 2))
+        obs_spans.annotate(current_trace(), degraded=True)
         logger.warning("degraded fail-fast: all circuits open "
                        "(retry_after=%.1fs)", retry_after)
         return {
@@ -501,45 +602,79 @@ class Router:
         fallback on engine failure (src/router.py:258-270).  Returns
         (device, method, confidence, reasoning, cache_hit, overhead_ms)."""
         t0 = time.perf_counter()
-        self._feed_perf_load()
-        device = "nano"
-        method, confidence, reasoning = "unknown", 0.0, ""
-        cache_hit = False
-        try:
-            decision = self.query_router.route_query(
-                query=query, context=context, context_key=ctx_hash)
-            device = decision.device
-            method = decision.method
-            confidence = float(decision.confidence)
-            reasoning = decision.reasoning
-            cache_hit = bool(decision.cache_hit)
-            logger.info("[%s] routing: %s | method=%s conf=%.3f",
-                        "BENCH" if self.benchmark_mode else "PROD",
-                        device.upper(), method, confidence)
-        except Exception as exc:
-            ctx_size = self.token_counter.get_context_size(history)
-            device = "orin" if ctx_size > self.threshold_fallback else "nano"
-            method = "fallback_ctx_size"
-            confidence = 0.2
-            reasoning = (f"router failed: {exc}; ctx_size={ctx_size}, "
-                         f"threshold_fallback={self.threshold_fallback}")
-            logger.warning("routing failed (%s); ctx fallback -> %s", exc, device)
+        with obs_spans.span(current_trace(), "route") as route_sp:
+            self._feed_perf_load()
+            device = "nano"
+            method, confidence, reasoning = "unknown", 0.0, ""
+            cache_hit = False
+            try:
+                decision = self.query_router.route_query(
+                    query=query, context=context, context_key=ctx_hash)
+                device = decision.device
+                method = decision.method
+                confidence = float(decision.confidence)
+                reasoning = decision.reasoning
+                cache_hit = bool(decision.cache_hit)
+                logger.info("[%s] routing: %s | method=%s conf=%.3f",
+                            "BENCH" if self.benchmark_mode else "PROD",
+                            device.upper(), method, confidence)
+            except Exception as exc:
+                ctx_size = self.token_counter.get_context_size(history)
+                device = ("orin" if ctx_size > self.threshold_fallback
+                          else "nano")
+                method = "fallback_ctx_size"
+                confidence = 0.2
+                reasoning = (f"router failed: {exc}; ctx_size={ctx_size}, "
+                             f"threshold_fallback={self.threshold_fallback}")
+                logger.warning("routing failed (%s); ctx fallback -> %s",
+                               exc, device)
+            route_sp.annotate(device=device, method=method,
+                              confidence=round(confidence, 4))
+            if cache_hit:
+                self.obs.m.cache_hits.labels("routing").inc()
         overhead_ms = (time.perf_counter() - t0) * 1000.0
         return device, method, confidence, reasoning, cache_hit, overhead_ms
 
     def route_query(self, history: List[Dict[str, Any]]
                     ) -> Tuple[Dict[str, Any], int, str]:
+        """Instrumented entry: creates the request's span tree (obs/),
+        binds it for this thread (tiers/engines pick it up via
+        ``current_trace``), runs the pipeline, then derives the
+        request's metrics and — when failed/degraded/slow — its flight-
+        recorder entry.  The pipeline itself is ``_route_query_inner``;
+        the reference contract (return shape, error semantics) is
+        untouched."""
+        trace = self.obs.trace(strategy=self.query_router.strategy)
+        with use_trace(trace):
+            try:
+                response, tokens, which = self._route_query_inner(
+                    trace, history)
+            except BaseException as exc:
+                trace.annotate(error=f"{type(exc).__name__}: {exc}"[:200])
+                self._finish_request(trace, None, ok=False)
+                raise
+        self._finish_request(trace, which,
+                             ok=bool(response.get("ok", True)),
+                             degraded=bool(response.get("degraded")),
+                             raw=response.get("raw"))
+        return response, tokens, which
+
+    def _route_query_inner(self, trace, history: List[Dict[str, Any]]
+                           ) -> Tuple[Dict[str, Any], int, str]:
         query, context, ctx_hash = self._history_to_query_and_context(history)
 
         # 0) response cache
         if self.enable_response_cache:
-            cached = self._response_store.get(
-                self._response_cache_key(ctx_hash, query))
+            with trace.span("cache_lookup"):
+                cached = self._response_store.get(
+                    self._response_cache_key(ctx_hash, query))
             if cached is not None:
                 text = cached.get("text", "")
                 which = cached.get("device", "nano")
                 tokens = self.token_counter.count_tokens(
                     {"role": "assistant", "content": text})
+                self.obs.m.cache_hits.labels("response").inc()
+                trace.annotate(cache_hit="response")
                 return {
                     "response": text,
                     "raw": cached.get("raw"),
@@ -568,6 +703,7 @@ class Router:
                 reasoning = (f"circuit open on {device} -> rerouted to "
                              f"{other}; {reasoning}")
                 method = f"{method}+breaker"
+                trace.event("breaker_veto", vetoed=device, to=other)
                 device = other
             else:
                 return self._degraded_response(query, ctx_hash, method,
@@ -607,6 +743,8 @@ class Router:
             # Only an open circuit on the survivor suppresses failover.
             if self.breaker is None or self.breaker.allow(other):
                 logger.warning("%s failed — failing over to %s", which, other)
+                self.obs.m.failovers.labels(which, "sync").inc()
+                trace.event("failover", failed=which, to=other)
                 raw2, which2, lat2 = self._run_device_retrying(
                     other, history, deadline)
                 self._breaker_record(which2, not self._is_error(raw2), raw2)
@@ -662,6 +800,20 @@ class Router:
         is produced.  Raises RuntimeError if no tier can start a stream
         (message carries a retry-after hint when every circuit is
         open)."""
+        trace = self.obs.trace(strategy=self.query_router.strategy,
+                               stream=True)
+        with use_trace(trace):
+            try:
+                return self._route_stream_inner(trace, history)
+            except BaseException as exc:
+                trace.annotate(error=f"{type(exc).__name__}: {exc}"[:200])
+                self._finish_request(trace, None, ok=False,
+                                     degraded=bool(
+                                         trace.attrs.get("degraded")))
+                raise
+
+    def _route_stream_inner(self, trace,
+                            history: List[Dict[str, Any]]) -> "RoutedStream":
         query, context, ctx_hash = self._history_to_query_and_context(history)
         (device, method, confidence, reasoning,
          cache_hit, overhead_ms) = self._decide(query, context, ctx_hash,
@@ -677,17 +829,25 @@ class Router:
                 reasoning = (f"circuit open on {device} -> rerouted to "
                              f"{other}; {reasoning}")
                 method = f"{method}+breaker"
+                trace.event("breaker_veto", vetoed=device, to=other)
                 device = other
             else:
                 self.degraded_served += 1
+                self.obs.m.degraded.inc()
+                trace.annotate(degraded=True)
                 raise RuntimeError(
                     "Request failed: all tiers unavailable (circuit "
                     f"open); retry in {self.breaker.retry_after_s():.1f}s")
 
         t0 = time.perf_counter()
         tier = self.tiers.get(device, self.nano)
-        handle = tier.process_stream(history)
+        # Stream setup primes the first token (prefill runs inside), so
+        # this span IS the stream's TTFT-critical section.
+        with trace.span("stream_setup", tier=tier.name):
+            handle = tier.process_stream(history)
         which = tier.name
+        if self._is_admission_rejection(handle):
+            self.obs.m.admission_rejected.labels(which).inc()
         self._breaker_record_stream_setup(which, handle)
         if self._is_error(handle) and self.enable_failover:
             other = self._other(which)
@@ -701,7 +861,13 @@ class Router:
             except Exception:
                 pass
             if self.breaker is None or self.breaker.allow(other):
-                alt = self.tiers[other].process_stream(history)
+                self.obs.m.failovers.labels(which, "stream_setup").inc()
+                trace.event("failover", failed=which, to=other,
+                            kind="stream_setup")
+                with trace.span("stream_setup", tier=other):
+                    alt = self.tiers[other].process_stream(history)
+                if self._is_admission_rejection(alt):
+                    self.obs.m.admission_rejected.labels(other).inc()
                 self._breaker_record_stream_setup(other, alt)
                 if not self._is_error(alt):
                     handle, which = alt, other
@@ -733,6 +899,14 @@ class Router:
                                               tokens, ok=ok)
             except Exception:
                 pass
+            # Trace completion: engine-true timings preferred (token-
+            # timeline stamps are the fallback for engines that report
+            # no GenerationResult).  Fires exactly once via _fire.
+            if result is not None:
+                trace.annotate(ttft_ms=result.ttft_ms,
+                               total_ms=result.total_ms,
+                               gen_tokens=result.gen_tokens)
+            self._finish_request(trace, state["device"], ok=ok)
 
         def resume_mid_stream(emitted_chars: int, exc: BaseException):
             """Mid-stream failover: the live stream died after emitting
@@ -760,7 +934,18 @@ class Router:
             # stream death and trip the breaker at half its threshold.
             if self.breaker is not None and not self.breaker.allow(other):
                 return None
-            alt = self.tiers[other].process_stream(history)
+            # Counted at the ATTEMPT, like the sync and stream_setup
+            # kinds — a takeover whose survivor also fails must not be
+            # invisible in the failover rate.
+            self.obs.m.failovers.labels(dying, "mid_stream").inc()
+            trace.event("mid_stream_failover", failed=dying, to=other,
+                        replayed_chars=emitted_chars)
+            # The resume hook runs on the CONSUMER's thread (SSE drain),
+            # outside the request's original context — re-bind the trace
+            # so the replacement setup's spans land in the same tree.
+            with use_trace(trace), \
+                    trace.span("stream_setup", tier=other, resume=True):
+                alt = self.tiers[other].process_stream(history)
             self._breaker_record_stream_setup(other, alt)
             if self._is_error(alt):
                 logger.warning("mid-stream failover target %s also failed "

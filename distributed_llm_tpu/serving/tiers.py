@@ -24,6 +24,8 @@ import jax
 from ..config import ClusterConfig, TierConfig
 from ..engine.inference import GenerationResult
 from ..engine.manager import EngineManager
+from ..obs import spans as obs_spans
+from ..obs.spans import current_trace, use_trace
 from ..parallel.mesh import carve_tier_meshes
 from ..utils.faults import FaultInjector
 from .turns import ClippedStream, clip_turn
@@ -34,9 +36,17 @@ History = Union[str, List[Dict[str, Any]]]
 
 # Chars a fully-clipped stream may silently drain during _PrimedStream's
 # eager first-delta pull before ClippedStream releases the primer with an
-# empty delta (worst case documented on ClippedStream): small enough that
-# priming never stalls ~a whole generation, large enough that ordinary
-# clipped turns finish their drain inside the prime.
+# empty delta: small enough that priming never stalls ~a whole
+# generation, large enough that ordinary clipped turns finish their
+# drain inside the prime.  WORST-CASE PRIME-DRAIN BOUND (ADVICE r5
+# tiers.py:204): a stream whose model emits a role marker from token one
+# drains at most THIS many characters — ≈ PRIME_DRAIN_CHARS / 3.5 ≈ 74
+# BPE tokens of decoding (~3.5 chars/token on the bench sets) — inside
+# ``process_stream`` while holding a sequential engine's lock, before
+# the "" sentinel releases the primer; without the cap the same prime
+# blocked for the full max_new_tokens decode budget (48-128 tokens on
+# the shipped clusters, up to 256 on the dataclass default).  See
+# ClippedStream (serving/turns.py) for the mechanism.
 PRIME_DRAIN_CHARS = 256
 
 
@@ -204,7 +214,11 @@ class TierClient:
         full waiting line or a predicted wait past the timeout returns
         the reference error shape in microseconds instead of blocking a
         serving thread for the full cap (AdmissionController)."""
-        admit_err = self.admission.try_admit()
+        trace = current_trace()
+        with obs_spans.span(trace, "admission", tier=self.name) as adm_sp:
+            admit_err = self.admission.try_admit()
+            if admit_err is not None:
+                adm_sp.annotate(rejected=admit_err)
         if admit_err is not None:
             logger.warning("tier %s admission rejected a request: %s",
                            self.name, admit_err)
@@ -240,7 +254,11 @@ class TierClient:
             result = None
             t0 = time.perf_counter()
             try:
-                resp, result = self._process_body(history)
+                # Context vars don't cross thread spawns: re-bind the
+                # request's trace so the engine's spans/timeline attach
+                # to the right tree (obs/spans.py propagation contract).
+                with use_trace(trace):
+                    resp, result = self._process_body(history)
             finally:
                 # Atomic with the caller's abandon decision: either
                 # done is set HERE first (caller sees the result) or the
@@ -270,6 +288,8 @@ class TierClient:
                 logger.warning("tier %s request exceeded %.0fs — abandoning "
                                "the device call and reporting failure",
                                self.name, timeout)
+                obs_spans.event(trace, "timeout_abandoned", tier=self.name,
+                                timeout_s=timeout)
                 return {"error": f"Request failed: {self.name} timed out "
                                  f"after {timeout:.0f}s"}
         return box.get("out", {"error": "Request failed: worker died"})
@@ -301,7 +321,9 @@ class TierClient:
         try:
             if not self.server_manager.is_server_running():
                 logger.info("No running %s engine found, starting...", self.name)
-                self.server_manager.start_server()
+                with obs_spans.span(current_trace(), "engine_start",
+                                    tier=self.name):
+                    self.server_manager.start_server()
             engine = self.server_manager.engine()
             if getattr(engine, "concurrent_safe", False):
                 result = engine.generate(history)
@@ -365,7 +387,11 @@ class TierClient:
         (wall drain time is dominated by client read pace, and feeding
         it to the EWMA would let slow readers poison the predictive
         fail-fast against an idle engine)."""
-        admit_err = self.admission.try_admit()
+        trace = current_trace()
+        with obs_spans.span(trace, "admission", tier=self.name) as adm_sp:
+            admit_err = self.admission.try_admit()
+            if admit_err is not None:
+                adm_sp.annotate(rejected=admit_err)
         if admit_err is not None:
             logger.warning("tier %s admission rejected a stream: %s",
                            self.name, admit_err)
@@ -388,7 +414,8 @@ class TierClient:
                     return fault
             if not self.server_manager.is_server_running():
                 logger.info("No running %s engine found, starting...", self.name)
-                self.server_manager.start_server()
+                with obs_spans.span(trace, "engine_start", tier=self.name):
+                    self.server_manager.start_server()
             engine = self.server_manager.engine()
             if not hasattr(engine, "generate_stream"):
                 self.admission.release()
@@ -402,9 +429,12 @@ class TierClient:
                 return _PrimedStream(self._maybe_break_stream(clipped),
                                      release=finish_admission)
             timeout = self.tier.request_timeout_s
-            acquired = (self._engine_lock.acquire(timeout=timeout)
-                        if timeout is not None
-                        else self._engine_lock.acquire())
+            # A sequential engine's lock IS its queue: the wait here is
+            # the streaming twin of the batching engine's queue_wait.
+            with obs_spans.span(trace, "engine_lock_wait", tier=self.name):
+                acquired = (self._engine_lock.acquire(timeout=timeout)
+                            if timeout is not None
+                            else self._engine_lock.acquire())
             if not acquired:
                 self.admission.release()
                 logger.warning("tier %s stream setup could not take the "

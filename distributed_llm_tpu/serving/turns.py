@@ -18,7 +18,17 @@ from typing import Iterator, Optional
 # Role labels as they appear in the training corpus / prompt format
 # (engine/tokenizer.py format_history): "role: content" lines.
 _ROLES = ("user:", "assistant:", "system:")
-# Longest text a marker can span, for the streaming hold-back.
+# Longest text a marker can span, for the streaming hold-back (11 chars:
+# "assistant:" + newline).  WORST CASE of the hold-back (ADVICE r5
+# tiers.py:204): nothing is emitted until >HOLDBACK chars accumulate,
+# and a stream whose model emits a role marker from token one NEVER
+# emits — ClippedStream then silently drains the rest of the generation
+# for its result/lock, so an eager first-delta primer
+# (serving/tiers.py _PrimedStream) would block a serving thread for the
+# whole decode budget.  ClippedStream's ``prime_drain_chars`` bounds
+# that drain (the primer is released with one "" sentinel after at most
+# PRIME_DRAIN_CHARS drained chars ≈ 74 BPE tokens at ~3.5 chars/token —
+# see the constant's definition in serving/tiers.py).
 HOLDBACK = max(len(r) for r in _ROLES) + 1          # +1 for the newline
 
 

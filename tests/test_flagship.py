@@ -41,14 +41,27 @@ def test_orin_8b_bf16_does_not_fit_one_chip():
 
 
 def test_orin_8b_int8_fits_the_single_bench_chip():
-    """The single-chip bench mode: int8 weights (~7 GB) + int8 KV + two
+    """The single-chip bench mode: int8 weights (~7 GB) + bf16 KV + two
     parked prefix caches fit 16 GB — this is the leg flagship_phase
-    actually measures on the bench box."""
+    actually measures on the bench box.  KV stays bf16 by DEFAULT:
+    int8 weights are a fit requirement, int8 KV is a perf knob the
+    measurements don't justify (r4 0.53×, r5 ~break-even — VERDICT r5
+    #4), so it is opt-in via DLLM_FLAGSHIP_KV_INT8=1."""
     tier = flagship_cluster(n_devices=1).orin
     assert tier.quantize == "int8"
+    assert tier.kv_quantize == "none"
     b = tier_hbm_budget(tier)
     assert 6.0 <= b["params_gb_per_chip"] <= 9.0, b
     assert b["fits"], b
+
+
+def test_flagship_kv_int8_opt_in(monkeypatch):
+    """The A/B flag still arms int8 KV (halving decode's KV read traffic
+    for a measured re-run) — off-by-default must not mean gone."""
+    monkeypatch.setenv("DLLM_FLAGSHIP_KV_INT8", "1")
+    tier = flagship_cluster(n_devices=1).orin
+    assert tier.kv_quantize == "int8"
+    assert tier_hbm_budget(tier)["fits"]
 
 
 def test_moe_8x1b_fits_a_tp4_submesh():

@@ -1,0 +1,415 @@
+"""Request-level observability (ISSUE 3): span trees, the metrics
+registry + Prometheus exposition, the flight recorder, the /metrics and
+/stats?debug=1 surfaces, and the span-discipline static pass.
+
+Router-integration tests inject a fresh ``Observability`` with
+``slow_ms=0.0`` so EVERY request lands in the flight recorder — the
+trace assertions then read the recorder's serialized trees, which is
+also what a production post-mortem reads."""
+
+import dataclasses
+import json
+import time
+
+import pytest
+
+from distributed_llm_tpu.config import tiny_cluster
+from distributed_llm_tpu.obs import Observability
+from distributed_llm_tpu.obs.metrics import MetricsRegistry
+from distributed_llm_tpu.obs.recorder import FlightRecorder
+from distributed_llm_tpu.obs.spans import (RequestTrace, current_trace,
+                                           use_trace)
+from distributed_llm_tpu.serving.router import Router
+from distributed_llm_tpu.utils.faults import FaultInjector
+
+HIST = [{"role": "user", "content": "What is the capital of France"}]
+
+
+def _obs():
+    return Observability(slow_ms=0.0)      # record every request
+
+
+def _cluster(**kw):
+    return dataclasses.replace(tiny_cluster(), breaker_failures=2,
+                               breaker_cooldown_s=30.0, **kw)
+
+
+def _stop(router):
+    for tier in router.tiers.values():
+        tier.server_manager.stop_server()
+
+
+def _span_names(trace_dict):
+    """Flat name list of a serialized span tree (depth-first)."""
+    out = []
+
+    def walk(node):
+        out.append(node["name"])
+        for child in node.get("children", ()):
+            walk(child)
+
+    walk(trace_dict["spans"])
+    return out
+
+
+# -- spans -------------------------------------------------------------------
+
+def test_span_tree_shape_and_serialization():
+    tr = RequestTrace(strategy="hybrid")
+    with tr.span("route") as sp:
+        sp.annotate(device="nano")
+    with tr.span("dispatch", tier="nano") as d:
+        with d.span("prefill", bucket=64):
+            pass
+        d.event("retry", attempt=1)
+    tr.add_token()
+    tr.add_token()
+    tr.finish(ok=True)
+    d1 = tr.to_dict()
+    assert _span_names(d1) == ["request", "route", "dispatch", "prefill",
+                               "retry"]
+    assert d1["attrs"]["ok"] is True and d1["tokens"] == 2
+    assert d1["spans"]["duration_ms"] >= 0
+    # finish() is idempotent: the first close pins the duration.
+    dur = tr.root.t1
+    tr.finish(ok=False)
+    assert tr.root.t1 == dur and tr.attrs["ok"] is True
+
+
+def test_span_exit_on_raise_annotates_error():
+    tr = RequestTrace()
+    with pytest.raises(ValueError):
+        with tr.span("dispatch") as sp:
+            raise ValueError("boom")
+    assert sp.t1 is not None                    # exited on the raise path
+    assert "ValueError" in sp.attrs["error"]
+
+
+def test_trace_contextvar_propagation_and_none_tolerance():
+    from distributed_llm_tpu.obs import spans as S
+    assert current_trace() is None
+    tr = RequestTrace()
+    with use_trace(tr):
+        assert current_trace() is tr
+        with use_trace(None):                   # nested rebind
+            assert current_trace() is None
+        assert current_trace() is tr
+    assert current_trace() is None
+    # None-tolerant helpers must be no-ops, not raises.
+    with S.span(None, "x"):
+        pass
+    S.event(None, "x")
+    S.annotate(None, a=1)
+    S.add_token(None)
+
+
+def test_ttft_tbt_derivation_prefers_engine_truth():
+    tr = RequestTrace()
+    tr.add_token()
+    tr.add_token()
+    time.sleep(0.002)
+    tr.add_token()
+    tr.finish()
+    assert tr.ttft_ms() is not None and tr.tbt_ms() >= 0
+    # Engine-reported numbers win over the observed timeline.
+    tr.annotate(ttft_ms=5.0, total_ms=25.0, gen_tokens=11)
+    assert tr.ttft_ms() == 5.0
+    assert tr.tbt_ms() == pytest.approx(2.0)
+
+
+# -- metrics registry --------------------------------------------------------
+
+def test_histogram_log_bucketing_and_quantiles():
+    from distributed_llm_tpu.obs.metrics import Histogram
+    h = Histogram(buckets=(1, 10, 100, 1000))
+    assert h.quantile(0.5) is None              # empty
+    for v in (0.4, 5, 5, 50, 5000):
+        h.observe(v)
+    assert h.counts == [1, 2, 1, 0, 1]          # last = +Inf overflow
+    assert h.count == 5 and h.sum == pytest.approx(5060.4)
+    q50 = h.quantile(0.5)
+    assert 1 <= q50 <= 10                       # median sits in (1, 10]
+    assert h.quantile(1.0) == 1000              # +Inf clamps to top bound
+
+
+def test_prometheus_exposition_format():
+    reg = MetricsRegistry()
+    reg.counter("dllm_x_total", "things", ("tier",)).labels("nano").inc(3)
+    reg.gauge("dllm_g", "a gauge").set(2.5)
+    h = reg.histogram("dllm_h_ms", "latency", ("strategy",),
+                      buckets=(1, 10))
+    h.labels("hybrid").observe(0.5)
+    h.labels("hybrid").observe(7)
+    text = reg.render()
+    assert "# HELP dllm_x_total things" in text
+    assert "# TYPE dllm_x_total counter" in text
+    assert 'dllm_x_total{tier="nano"} 3' in text
+    assert "dllm_g 2.5" in text
+    assert '# TYPE dllm_h_ms histogram' in text
+    assert 'dllm_h_ms_bucket{strategy="hybrid",le="1"} 1' in text
+    assert 'dllm_h_ms_bucket{strategy="hybrid",le="10"} 2' in text
+    assert 'dllm_h_ms_bucket{strategy="hybrid",le="+Inf"} 2' in text
+    assert 'dllm_h_ms_sum{strategy="hybrid"} 7.5' in text
+    assert 'dllm_h_ms_count{strategy="hybrid"} 2' in text
+
+
+def test_registry_rejects_kind_conflicts():
+    reg = MetricsRegistry()
+    reg.counter("dllm_x_total", "c")
+    with pytest.raises(ValueError, match="re-registered"):
+        reg.gauge("dllm_x_total", "g")
+    with pytest.raises(ValueError, match="expected labels"):
+        reg.counter("dllm_y_total", "c", ("a", "b")).labels("only-one")
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def test_recorder_ring_and_classify():
+    rec = FlightRecorder(capacity=2, slow_ms=100.0)
+    assert rec.classify(True, False, 5.0) is None
+    assert rec.classify(True, False, 150.0) == "slow"
+    assert rec.classify(False, False, 5.0) == "error"
+    assert rec.classify(False, True, 5.0) == "degraded"
+    for i in range(3):
+        tr = RequestTrace(i=i)
+        tr.finish()
+        rec.record("error", tr)
+    snap = rec.snapshot()
+    assert len(snap) == 2 and rec.recorded_total == 3
+    # Most recent first; oldest evicted.
+    assert snap[0]["trace"]["attrs"]["i"] == 2
+    assert snap[1]["trace"]["attrs"]["i"] == 1
+
+
+# -- router integration ------------------------------------------------------
+
+def test_request_span_tree_covers_pipeline_stages():
+    obs = _obs()
+    r = Router(strategy="heuristic", benchmark_mode=True,
+               cluster=_cluster(), observability=obs)
+    try:
+        resp, _, dev = r.route_query(HIST)
+        assert resp["ok"] is True
+        entry = obs.recorder.snapshot()[0]
+        assert entry["reason"] == "slow"        # slow_ms=0 records all
+        names = _span_names(entry["trace"])
+        assert names[0] == "request"
+        assert "route" in names and "dispatch" in names
+        assert "admission" in names
+        assert entry["trace"]["attrs"]["strategy"] == "heuristic"
+        assert "tiers" in entry["state"]
+        # Registry saw the same request.
+        fam = obs.metrics.get("dllm_requests_total")
+        assert fam.labels("heuristic", dev, "ok").value == 1
+        assert obs.metrics.get("dllm_ttft_ms").labels(
+            "heuristic").count == 1
+    finally:
+        _stop(r)
+
+
+def test_span_pairing_under_sync_failover():
+    """A failed-then-failed-over request's tree must show BOTH dispatch
+    spans (each closed) plus the failover event, and the failover metric
+    must attribute the failure to the dying tier."""
+    obs = _obs()
+    fi = FaultInjector()
+    r = Router(strategy="heuristic", benchmark_mode=True,
+               cluster=_cluster(), fault_injector=fi, observability=obs)
+    try:
+        fi.fail_next("nano", "boom")
+        resp, _, dev = r.route_query(HIST)
+        assert resp["ok"] is True and dev == "orin"
+        trace = obs.recorder.snapshot()[0]["trace"]
+        spans = trace["spans"]["children"]
+        dispatches = [s for s in spans if s["name"] == "dispatch"]
+        assert [d["attrs"]["tier"] for d in dispatches] == ["nano", "orin"]
+        assert all("duration_ms" in d for d in dispatches)  # both closed
+        events = [s for s in spans if s["name"] == "failover"]
+        assert events and events[0]["attrs"] == {"failed": "nano",
+                                                 "to": "orin"}
+        assert obs.metrics.get("dllm_failovers_total").labels(
+            "nano", "sync").value == 1
+    finally:
+        _stop(r)
+
+
+def test_span_pairing_under_mid_stream_replay():
+    """Mid-stream failover with prefix replay: one trace, the
+    mid_stream_failover event carrying the replayed char count, and the
+    completion attributed to the surviving tier."""
+    obs = _obs()
+    fi = FaultInjector()
+    r = Router(strategy="heuristic", benchmark_mode=True,
+               cluster=_cluster(), fault_injector=fi, observability=obs)
+    try:
+        fi.fail_stream_after("nano", 1)
+        routed = r.route_query_stream(HIST)
+        text = "".join(routed)
+        assert text and routed.device == "orin"
+        entry = obs.recorder.snapshot()[0]
+        trace = entry["trace"]
+        spans = trace["spans"]["children"]
+        ev = [s for s in spans if s["name"] == "mid_stream_failover"]
+        assert ev and ev[0]["attrs"]["failed"] == "nano"
+        assert ev[0]["attrs"]["to"] == "orin"
+        assert ev[0]["attrs"]["replayed_chars"] >= 1
+        setups = [s for s in spans if s["name"] == "stream_setup"]
+        assert len(setups) == 2 and all("duration_ms" in s for s in setups)
+        assert obs.metrics.get("dllm_failovers_total").labels(
+            "nano", "mid_stream").value == 1
+        # Completion credited to the survivor.
+        fam = obs.metrics.get("dllm_requests_total")
+        assert fam.labels("heuristic", "orin", "ok").value == 1
+    finally:
+        _stop(r)
+
+
+def test_flight_recorder_captures_degraded_request():
+    """The acceptance scenario: induce degraded service (both circuits
+    open), then read the FULL span tree of the degraded request back —
+    with the breaker snapshot that explains it — via the recorder."""
+    obs = _obs()
+    fi = FaultInjector()
+    r = Router(strategy="heuristic", benchmark_mode=True,
+               cluster=_cluster(), fault_injector=fi, observability=obs)
+    try:
+        fi.set_down("nano", "nano down")
+        fi.set_down("orin", "orin down")
+        for _ in range(3):
+            r.route_query(HIST)
+        assert r.breaker.all_open()
+        resp, _, _ = r.route_query(HIST)
+        assert resp["degraded"] is True
+        entry = obs.recorder.snapshot()[0]
+        assert entry["reason"] == "degraded"
+        names = _span_names(entry["trace"])
+        assert "route" in names and "degraded_fail_fast" in names
+        assert entry["trace"]["attrs"]["degraded"] is True
+        assert entry["state"]["breaker"]["nano"]["state"] == "open"
+        assert entry["state"]["breaker"]["orin"]["state"] == "open"
+        assert obs.metrics.get("dllm_degraded_total").value >= 1
+        # Breaker transition metrics fed through the on_transition hook.
+        fam = obs.metrics.get("dllm_breaker_transitions_total")
+        assert fam.labels("nano", "open").value == 1
+        assert obs.metrics.get("dllm_breaker_state").labels(
+            "nano").value == 2
+    finally:
+        _stop(r)
+
+
+# -- HTTP surfaces -----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def obs_client():
+    from distributed_llm_tpu.serving.app import create_app
+    obs = _obs()
+    fi = FaultInjector()
+    router = Router(strategy="heuristic", benchmark_mode=True,
+                    cluster=_cluster(), fault_injector=fi,
+                    observability=obs)
+    app = create_app(router=router)
+    client = app.test_client()
+    yield client, fi, router
+    _stop(router)
+
+
+def test_get_metrics_serves_prometheus_text(obs_client):
+    client, _fi, _router = obs_client
+    resp = client.post("/chat", json={"message": "hello there",
+                                      "strategy": "heuristic"})
+    assert resp.status_code == 200
+    resp = client.get("/metrics")
+    assert resp.status_code == 200
+    text = resp.text
+    # Required families (acceptance): TTFT, TBT, queue wait, admission
+    # rejects, breaker state, degraded count — histograms render their
+    # _bucket/_sum/_count triple.
+    for family in ("dllm_ttft_ms", "dllm_tbt_ms", "dllm_queue_wait_ms",
+                   "dllm_admission_rejected_total", "dllm_breaker_state",
+                   "dllm_degraded_total"):
+        assert f"# TYPE {family} " in text, family
+    assert 'dllm_ttft_ms_bucket{strategy="heuristic",le="+Inf"} 1' in text
+    assert 'dllm_requests_total{' in text
+
+
+def test_stats_debug_returns_flight_recorder(obs_client):
+    client, fi, router = obs_client
+    # Induce a degraded request through the HTTP surface.
+    fi.set_down("nano", "down")
+    fi.set_down("orin", "down")
+    for i in range(3):
+        client.post("/chat", json={"message": f"distinct question {i}",
+                                   "strategy": "heuristic"})
+    assert router.breaker.all_open()
+    client.post("/chat", json={"message": "the degraded one",
+                               "strategy": "heuristic"})
+    fi.restore("nano")
+    fi.restore("orin")
+    plain = client.get("/stats").get_json()
+    assert "flight_recorder" not in plain
+    debug = client.get("/stats?debug=1").get_json()
+    entries = debug["flight_recorder"]
+    assert entries and debug["flight_recorded_total"] >= len(entries)
+    degraded = [e for e in entries if e["reason"] == "degraded"]
+    assert degraded, [e["reason"] for e in entries]
+    assert "spans" in degraded[0]["trace"]
+    assert degraded[0]["state"]["breaker"]["nano"]["state"] == "open"
+
+
+# -- span discipline (satellite: CI static pass) -----------------------------
+
+def test_span_discipline_pass_is_clean():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "check_span_discipline",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts",
+            "check_span_discipline.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    violations = mod.check_tree()
+    assert violations == [], "\n".join(violations)
+    # The checker actually catches what it claims to catch.
+    bad = "def f(tr):\n    sp = tr.span('x')\n    return sp\n"
+    assert mod.check_source(bad, "bad.py")
+    bad2 = "def f(tr):\n    tr.start_span('x')\n"
+    assert mod.check_source(bad2, "bad2.py")
+    good = "def f(tr):\n    with tr.span('x') as sp:\n        pass\n"
+    assert mod.check_source(good, "good.py") == []
+
+
+# -- overhead budget ---------------------------------------------------------
+
+def test_instrumentation_overhead_under_budget():
+    """Acceptance: < 1 ms instrumentation per request.  Simulate a full
+    request's worth of tracing+metrics work (trace, 6 spans, 2 events,
+    64 token stamps, metric observations, classify) and bound the mean
+    over many iterations — pure dict/list work, comfortably sub-ms."""
+    obs = Observability(slow_ms=30000.0)
+    m = obs.m
+    n = 200
+    t0 = time.perf_counter()
+    for _ in range(n):
+        tr = obs.trace(strategy="hybrid")
+        with tr.span("route") as sp:
+            sp.annotate(device="nano", method="hybrid", confidence=0.9)
+        with tr.span("dispatch", tier="nano"):
+            with tr.span("admission", tier="nano"):
+                pass
+            with tr.span("prefill", bucket=64):
+                pass
+            for _t in range(64):
+                tr.add_token()
+            with tr.span("detokenize", tokens=64):
+                pass
+        tr.event("retry", attempt=1)
+        tr.annotate(ttft_ms=5.0, total_ms=90.0, gen_tokens=64)
+        tr.finish(ok=True)
+        m.requests.labels("hybrid", "nano", "ok").inc()
+        m.ttft_ms.labels("hybrid").observe(tr.ttft_ms())
+        m.tbt_ms.labels("hybrid").observe(tr.tbt_ms())
+        m.request_ms.labels("hybrid").observe(tr.duration_ms)
+        obs.recorder.classify(True, False, tr.duration_ms)
+    per_request_ms = (time.perf_counter() - t0) * 1000.0 / n
+    assert per_request_ms < 1.0, f"{per_request_ms:.3f} ms per request"
