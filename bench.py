@@ -24,6 +24,12 @@ import statistics
 import threading
 import time
 
+# Registered env reads (stdlib-only import, no jax): a typo'd DLLM_*
+# name raises at the read site instead of silently serving the default
+# forever — see CONFIG.md / distributed_llm_tpu/config_registry.py.
+from distributed_llm_tpu.config_registry import (env_flag, env_float,
+                                                 env_int)
+
 # Reference throughput on the same query set (see module docstring).
 BASELINE_REQ_PER_S = 12 / (922.2 + 176.0)
 
@@ -46,11 +52,7 @@ class Budget:
 
     def __init__(self, total_s: float = None):
         if total_s is None:
-            import os
-            try:
-                total_s = float(os.environ.get("DLLM_BENCH_BUDGET_S", "1200"))
-            except ValueError:
-                total_s = 1200.0
+            total_s = env_float("DLLM_BENCH_BUDGET_S", 1200.0)
         self.total_s = total_s
         self.t0 = time.monotonic()
 
@@ -1149,7 +1151,7 @@ def run(progress: "Progress" = None, budget: "Budget" = None) -> dict:
     # headline) and set DLLM_BENCH_NO_AB=1; this in-process path remains
     # for programmatic callers.
     import os as _os
-    if backend != "cpu" and _os.environ.get("DLLM_BENCH_NO_AB") != "1":
+    if backend != "cpu" and not env_flag("DLLM_BENCH_NO_AB"):
         try:
             from distributed_llm_tpu.bench import ab_kernels
             have = None
@@ -1213,14 +1215,10 @@ def run(progress: "Progress" = None, budget: "Budget" = None) -> dict:
     # N times (default 3) and the headline reports {median, iqr, n} so a
     # contended box's 2-5x run-to-run swing is visible in the artifact
     # instead of silently baked into a single-shot number.
-    try:
-        repeats = max(1, int(_os.environ.get("DLLM_BENCH_REPEATS", "3")))
-    except ValueError:                        # never lose the headline
-        repeats = 3
-    try:
-        n_clients = max(2, int(_os.environ.get("DLLM_BENCH_CLIENTS", "4")))
-    except ValueError:
-        n_clients = 4
+    # env_int falls back on garbage values itself — never lose the
+    # headline to a malformed knob.
+    repeats = max(1, env_int("DLLM_BENCH_REPEATS", 3))
+    n_clients = max(2, env_int("DLLM_BENCH_CLIENTS", 4))
     # Adaptive sweep scaling (VERDICT r5 #1): calibrate per-query cost
     # on the warm engines, then fit repeats (and, under a severely
     # halved budget, the query count) into the sweep's share of the
@@ -1738,12 +1736,12 @@ def run(progress: "Progress" = None, budget: "Budget" = None) -> dict:
     # identical, so re-measuring it would double the costliest phase's
     # chip time for the same numbers).
     import os
-    if os.environ.get("DLLM_BENCH_SPEC_ORIN") == "1":
+    if env_flag("DLLM_BENCH_SPEC_ORIN"):
         flagship = {"skipped": "spec A/B run — flagship identical to the "
                                "headline run's"}
     elif not budget.allows(240):
         flagship = {"skipped": budget.skip_stamp()}
-    elif backend != "cpu" or os.environ.get("DLLM_BENCH_FLAGSHIP") == "1":
+    elif backend != "cpu" or env_flag("DLLM_BENCH_FLAGSHIP"):
         flagship = flagship_phase(beat=progress.beat)
     else:
         flagship = {"skipped": "cpu fallback backend"}
@@ -1972,14 +1970,14 @@ if __name__ == "__main__":
         # attempts (wedges observed to clear on grant expiry, not
         # instantly).  Schedule is env-tunable for the driver.
         import os
-        attempts = int(os.environ.get("DLLM_BENCH_PROBE_ATTEMPTS", "4"))
+        attempts = env_int("DLLM_BENCH_PROBE_ATTEMPTS", 4)
         backoffs = [60.0, 180.0, 300.0]
         for attempt in range(attempts):
             if _accelerator_healthy():
                 # Measure the dispatch table out of process BEFORE this
                 # process claims the chip (see the function docstring),
                 # then keep run() from re-measuring in-process.
-                if os.environ.get("DLLM_BENCH_NO_AB") != "1":
+                if not env_flag("DLLM_BENCH_NO_AB"):
                     _measure_dispatch_out_of_process()
                     os.environ["DLLM_BENCH_NO_AB"] = "1"
                 break
@@ -2020,8 +2018,7 @@ if __name__ == "__main__":
             os._exit(4)
 
     signal.signal(signal.SIGTERM, _sigterm_flush)
-    start_watchdog(progress, float(os.environ.get("DLLM_BENCH_WATCHDOG_S",
-                                                  "900")))
+    start_watchdog(progress, env_float("DLLM_BENCH_WATCHDOG_S", 900.0))
     result = run(progress, budget=budget)
     progress.done.set()
     # Full detail on the first line (and in BENCH_partial.json); the

@@ -414,9 +414,8 @@ def bench_cluster() -> ClusterConfig:
     ~3 tokens instead of per token.  A/B'd by scripts/tpu_round.sh before
     any default flip.
     """
-    import os
-    draft = ("nano_bench"
-             if os.environ.get("DLLM_BENCH_SPEC_ORIN") == "1" else None)
+    from .config_registry import env_flag
+    draft = "nano_bench" if env_flag("DLLM_BENCH_SPEC_ORIN") else None
     cluster = ClusterConfig(
         nano=TierConfig(name="nano", model_preset="nano_bench", tp=1,
                         max_new_tokens=64, quantize="int8",
@@ -475,9 +474,8 @@ def cpu_bench_cluster() -> ClusterConfig:
     nano_bench (~130M, chip-pretrained, held-out loss 1.257) as the
     strong one.  Smaller decode caps keep the 1-core sweep bounded.
     """
-    import os
-    draft = ("mini_bench"
-             if os.environ.get("DLLM_BENCH_SPEC_ORIN") == "1" else None)
+    from .config_registry import env_flag
+    draft = "mini_bench" if env_flag("DLLM_BENCH_SPEC_ORIN") else None
     # Short bucket ladder: each bucket is a separate XLA program and the
     # 1-core box pays real compile time per program.  64 stays the
     # bottom rung — the benchmark sets' median query is ~10-40 tokens
@@ -535,9 +533,8 @@ def flagship_cluster(n_devices: Optional[int] = None) -> ClusterConfig:
         # DLLM_FLAGSHIP_KV_INT8=1 (the A/B flag) or a measured TPU
         # tuning.json; the HBM budget fits with bf16 KV (the budget
         # test pins it).
-        import os
-        kv = ("int8" if os.environ.get("DLLM_FLAGSHIP_KV_INT8") == "1"
-              else "none")
+        from .config_registry import env_flag
+        kv = "int8" if env_flag("DLLM_FLAGSHIP_KV_INT8") else "none"
         orin = TierConfig(name="orin", model_preset="orin_8b", tp=1,
                           max_new_tokens=128, quantize="int8",
                           kv_quantize=kv, decode_batch=4,

@@ -1,0 +1,268 @@
+"""Central registry of every configuration surface the repo exposes.
+
+Two kinds of drift kept hitting review: a ``DLLM_*`` env var would grow a
+new reader with its own inline default (bench.py at one point carried
+three different fallbacks for the same knob), and ``TierConfig`` /
+``ClusterConfig`` fields would gain semantics documented only in a commit
+message.  This module is the single source of truth for both:
+
+- ``ENV_VARS``: every ``DLLM_*`` environment variable — default, the
+  module that consumes it, and one-line semantics.  The typed accessors
+  (``env_str`` / ``env_int`` / ``env_float`` / ``env_flag``) raise
+  ``UnknownConfigError`` on any name not registered here, so a typo'd
+  var name fails loudly at the read site instead of silently serving the
+  default forever.
+- ``CONFIG_FIELDS``: every ``TierConfig`` / ``ClusterConfig`` dataclass
+  field with a one-line summary (the full rationale lives at the field's
+  declaration in config.py).
+
+``distributed_llm_tpu/lint`` checker ``config-drift`` enforces both
+directions statically: an env read or dataclass field missing here — or
+a registry entry whose variable/field no longer exists in code — fails
+tier-1.  ``CONFIG.md`` is generated from this module
+(``python -m distributed_llm_tpu.config_registry``) and pinned in sync
+by tests/test_lint.py.
+
+Deliberately stdlib-only (no jax, no package imports): tests/conftest.py
+reads it before jax may be imported, and the lint CLI runs on CPU-only
+boxes without the accelerator stack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional
+
+
+class UnknownConfigError(KeyError):
+    """An env accessor was asked for a name not in ENV_VARS (typo guard)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    name: str
+    # The DOCUMENTED default — what the consumer does when the var is
+    # unset, rendered into CONFIG.md.  Always a literal value string or
+    # None (never prose): the typed accessors take their authoritative
+    # fallback at the call site, and ``env_str`` falls back to this
+    # value, so a non-literal here would leak into behavior.
+    default: Optional[str]
+    consumer: str                   # module that reads it
+    doc: str                        # one-line semantics
+
+
+def _e(name: str, default: Optional[str], consumer: str, doc: str) -> EnvVar:
+    return EnvVar(name=name, default=default, consumer=consumer, doc=doc)
+
+
+ENV_VARS: Dict[str, EnvVar] = {v.name: v for v in (
+    _e("DLLM_ATTENTION", None, "ops/attention.py",
+       "Explicit attention-kernel override ('pallas' / 'xla'); unset = "
+       "the measured dispatch table (bench/ab_dispatch.json) decides per "
+       "kind."),
+    _e("DLLM_NATIVE", None, "native/__init__.py",
+       "'0' disables the g++-built native tokenizer/counter helpers; "
+       "behavior is bit-identical to the pure-Python fallback."),
+    _e("DLLM_CHIP", "tpu_v5e", "utils/roofline.py",
+       "Chip name stamped into roofline/MFU accounting."),
+    _e("DLLM_PEAK_FLOPS", None, "utils/roofline.py",
+       "Peak accelerator FLOP/s for roofline accounting (float); unset "
+       "= the v5e peak constant in utils/roofline.py."),
+    _e("DLLM_PEAK_HBM", None, "utils/roofline.py",
+       "Peak HBM bytes/s for roofline accounting (float); unset = the "
+       "v5e peak constant in utils/roofline.py."),
+    _e("DLLM_OBS_SLOW_MS", "30000", "obs/__init__.py",
+       "Global flight-recorder slow-request threshold in ms; '0'/'off' "
+       "disables the slow trigger (failed/degraded still record)."),
+    _e("DLLM_FLAGSHIP_KV_INT8", None, "config.py",
+       "'1' opts the single-chip flagship orin tier into int8 KV cache "
+       "(measured ~break-even r5; default off, VERDICT r5 #4)."),
+    _e("DLLM_TEST_COMPILE_CACHE", None, "tests/conftest.py",
+       "Suite-local XLA compile-cache dir override (wins over any global "
+       "JAX_COMPILATION_CACHE_DIR)."),
+    _e("DLLM_BENCH_BUDGET_S", "1200", "bench.py",
+       "Wall-clock budget for the whole bench run (s); phases are skipped "
+       "with a stamped reason once it runs dry."),
+    _e("DLLM_BENCH_WATCHDOG_S", "900", "bench.py",
+       "Bench idle watchdog (s): no liveness beat for this long flushes "
+       "the partial artifact and exits (wedged-chip insurance)."),
+    _e("DLLM_BENCH_NO_AB", None, "bench.py",
+       "'1' skips the in-process kernel A/B (set by __main__ after the "
+       "out-of-process dispatch measurement already ran)."),
+    _e("DLLM_BENCH_REPEATS", "3", "bench.py",
+       "Headline sweep repeats; the artifact reports {median, iqr, n}."),
+    _e("DLLM_BENCH_CLIENTS", "4", "bench.py",
+       "Closed-loop concurrent clients for the headline leg (min 2)."),
+    _e("DLLM_BENCH_SPEC_ORIN", None, "config.py, bench/tune.py, bench.py",
+       "'1' serves the orin tier speculatively (nano-class draft) for the "
+       "spec A/B leg; wins over the tuning table's verdict."),
+    _e("DLLM_BENCH_FLAGSHIP", None, "bench.py",
+       "'1' forces the flagship phase on the CPU fallback backend "
+       "(normally skipped: a 1B model on one host core is not a "
+       "measurement)."),
+    _e("DLLM_BENCH_PROBE_ATTEMPTS", "4", "bench.py",
+       "Accelerator-health probe attempts (with backoff) before the bench "
+       "surrenders the headline run to CPU."),
+)}
+
+
+# One-line summaries; authoritative rationale lives at each field's
+# declaration in config.py (the lint checker pins NAME coverage both
+# ways, not prose).
+CONFIG_FIELDS: Dict[str, str] = {
+    # -- TierConfig --------------------------------------------------------
+    "TierConfig.name": "Tier identity ('nano' | 'orin' | ...).",
+    "TierConfig.model_preset": "Key into MODEL_PRESETS for this tier's "
+                               "architecture.",
+    "TierConfig.tp": "Tensor-parallel degree (submesh size).",
+    "TierConfig.sp": "Sequence-parallel degree for prefill (ring "
+                     "attention over the 'sp' axis; dense only).",
+    "TierConfig.ep": "Expert-parallel degree for MoE tiers (whole experts "
+                     "sharded over 'ep').",
+    "TierConfig.max_new_tokens": "Decode cap per request (reference "
+                                 "num_predict).",
+    "TierConfig.temperature": "Sampling temperature; 0 = greedy "
+                              "(reference default).",
+    "TierConfig.prefill_buckets": "Padded prompt-length rungs, one "
+                                  "compiled program each.",
+    "TierConfig.decode_batch": ">1 serves through the continuous-batching "
+                               "engine with that many concurrent slots.",
+    "TierConfig.kv_block_size": "Paged KV pool block granularity "
+                                "(engine/paged_kv.py).",
+    "TierConfig.decode_steps_per_tick": "Sequential decode steps fused "
+                                        "into one device call per tick.",
+    "TierConfig.admission_max_queue": "Max requests waiting beyond the "
+                                      "slots before fail-fast; None "
+                                      "disables admission control.",
+    "TierConfig.checkpoint_path": "Orbax dir to serve trained weights "
+                                  "from; None = deterministic random "
+                                  "init.",
+    "TierConfig.draft_preset": "Draft model preset for speculative "
+                               "decoding; None = plain decoding.",
+    "TierConfig.speculative_gamma": "Draft tokens proposed per "
+                                    "speculative round.",
+    "TierConfig.enable_prefix_cache": "Park finished requests' KV for "
+                                      "suffix-only re-prefill "
+                                      "(multi-turn chats).",
+    "TierConfig.prefix_cache_entries": "Parked KV prefixes kept per tier "
+                                       "(each pins HBM).",
+    "TierConfig.quantize": "Weight-only serving quantization ('none' | "
+                           "'int8').",
+    "TierConfig.kv_quantize": "KV-cache quantization ('none' | 'int8'); "
+                              "dense family only.",
+    "TierConfig.endpoint": "Base URL of a cross-host tpu_api server; "
+                           "set = no local engine is built.",
+    "TierConfig.spawn_cmd": "Supervisor argv that (re)starts the remote "
+                            "tier process (must kill-then-start).",
+    "TierConfig.request_timeout_s": "Per-request wall cap; past it the "
+                                    "reference error shape returns and "
+                                    "the worker is abandoned.",
+    "TierConfig.watchdog_stall_s": "Decode-watchdog deadline: pending "
+                                   "work with no step progress for this "
+                                   "long reads as wedged.",
+    # -- ClusterConfig -----------------------------------------------------
+    "ClusterConfig.nano": "The weak/cheap tier's TierConfig.",
+    "ClusterConfig.orin": "The strong/costly tier's TierConfig.",
+    "ClusterConfig.seed": "Deterministic init seed shared by both tiers.",
+    "ClusterConfig.breaker_failures": "Consecutive error-shaped results "
+                                      "that open a tier's circuit; 0 "
+                                      "disables the breaker.",
+    "ClusterConfig.breaker_cooldown_s": "Open-circuit cooldown before a "
+                                        "half-open canary.",
+    "ClusterConfig.retry_attempts": "Bounded same-tier retries for "
+                                    "transient error shapes.",
+    "ClusterConfig.retry_backoff_s": "Initial jittered backoff between "
+                                     "transient retries.",
+}
+
+
+# -- typed env accessors (the loud-failure path) ------------------------------
+
+def _entry(name: str) -> EnvVar:
+    try:
+        return ENV_VARS[name]
+    except KeyError:
+        raise UnknownConfigError(
+            f"env var {name!r} is not in config_registry.ENV_VARS — "
+            f"register it (with a docstring) or fix the typo") from None
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Raw registered read; ``default`` overrides the registry default
+    for call sites whose fallback is contextual."""
+    entry = _entry(name)
+    if default is None:
+        default = entry.default
+    return os.environ.get(name, default)
+
+
+def env_flag(name: str) -> bool:
+    """Boolean convention used across the repo: set to '1' = on."""
+    return os.environ.get(_entry(name).name) == "1"
+
+
+def env_float(name: str, default: float) -> float:
+    """Float read that never throws on garbage (bench convention: a bad
+    value must not lose the run — fall back and keep going)."""
+    raw = os.environ.get(_entry(name).name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    raw = os.environ.get(_entry(name).name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+# -- CONFIG.md generation -----------------------------------------------------
+
+def render_markdown() -> str:
+    """The CONFIG.md body (pinned in sync by tests/test_lint.py)."""
+    lines = [
+        "# Configuration registry",
+        "",
+        "Generated from `distributed_llm_tpu/config_registry.py` "
+        "(`python -m distributed_llm_tpu.config_registry > CONFIG.md`).",
+        "The `config-drift` lint checker fails tier-1 when code and this "
+        "registry disagree in either direction.",
+        "",
+        "## Environment variables (`DLLM_*`)",
+        "",
+        "| Variable | Default | Consumer | Semantics |",
+        "|---|---|---|---|",
+    ]
+    def cell(text: str) -> str:
+        return text.replace("|", "\\|")     # keep table cells intact
+
+    for v in sorted(ENV_VARS.values(), key=lambda v: v.name):
+        default = "(unset)" if v.default is None else f"`{v.default}`"
+        lines.append(f"| `{v.name}` | {default} | {cell(v.consumer)} "
+                     f"| {cell(v.doc)} |")
+    lines += [
+        "",
+        "## Config dataclass fields",
+        "",
+        "One-line summaries; the authoritative rationale lives at each "
+        "field's declaration in `distributed_llm_tpu/config.py`.",
+        "",
+        "| Field | Semantics |",
+        "|---|---|",
+    ]
+    for field in sorted(CONFIG_FIELDS):
+        lines.append(f"| `{field}` | {cell(CONFIG_FIELDS[field])} |")
+    return "\n".join(lines) + "\n"
+
+
+if __name__ == "__main__":
+    import sys
+    sys.stdout.write(render_markdown())

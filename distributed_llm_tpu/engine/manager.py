@@ -59,7 +59,15 @@ class EngineManager:
         """Idempotent: build the engine and compile/warm the hot paths.
         ``beat`` (optional liveness callback) is forwarded to the
         engine's warmup — on chip a full warmup is many multi-10s
-        compiles, longer than bench.py's wedge watchdog window."""
+        compiles, longer than bench.py's wedge watchdog window.
+
+        The lifecycle lock is held through the whole build/compile ON
+        PURPOSE: it exists to serialize start/stop, and concurrent
+        lazy-starts must collapse into one build.  The liveness surface
+        (``health``/``is_server_running``) and the ``engine()`` fast
+        path deliberately do NOT take it — a probe blocking here through
+        a multi-minute compile would read as a dead tier (the PR 2 bug
+        the lock-discipline lint now guards)."""
         with self._lock:
             if self._engine is not None:
                 return
@@ -67,7 +75,7 @@ class EngineManager:
             params = None
             if self.tier.checkpoint_path:
                 from ..utils.checkpoint import load_params_for_tier
-                params = load_params_for_tier(
+                params = load_params_for_tier(  # dllm-lint: disable=lock-blocking-call -- lifecycle lock intentionally held through the build; all liveness readers are lock-free (see docstring)
                     self.tier.checkpoint_path, self.tier.model(),
                     mesh=self.mesh, devices=self.devices)
                 if beat is not None:
@@ -115,9 +123,12 @@ class EngineManager:
                     self.tier, seed=self.seed, mesh=self.mesh,
                     devices=self.devices, params=params)
             if self.warmup_on_start:
-                engine.warmup(beat=beat)
-            self._engine = engine
+                engine.warmup(beat=beat)  # dllm-lint: disable=lock-blocking-call -- lifecycle lock intentionally held through warmup; all liveness readers are lock-free (see docstring)
+            # _started_at first: health() reads both lock-free, and an
+            # engine visible before its timestamp would compute uptime
+            # from None.
             self._started_at = time.time()
+            self._engine = engine
             logger.info("tier %s up in %.1fs (model=%s, devices=%s)",
                         self.tier.name, time.perf_counter() - t0,
                         self.tier.model_preset,
@@ -136,15 +147,28 @@ class EngineManager:
                 self._wedged_seen = False
 
     def is_server_running(self) -> bool:
-        with self._lock:
-            return self._engine is not None
+        """LOCK-FREE: a single GIL-atomic attribute read.  Taking the
+        lifecycle lock here would block every health probe through a
+        multi-minute start_server compile and read as a dead tier (the
+        PR 2 failure shape; the remote twin already reports lock-free,
+        serving/tpu_api.py)."""
+        return self._engine is not None
 
     def engine(self) -> InferenceEngine:
         """Lazy-start accessor (reference: Nano.process auto-start,
-        src/models/nano.py:19-21)."""
+        src/models/nano.py:19-21).  Lock-free FAST path (the common
+        case: engine already up); the cold-start slow path holds the
+        lifecycle lock across check+start+read so a concurrent
+        stop_server/restart can never make this return None or a
+        just-stopped engine mid-handoff.  Only the probe surface
+        (health/is_server_running) must never wait here — request
+        dispatch waiting out a cold start is the correct behavior."""
+        engine = self._engine
+        if engine is not None:
+            return engine
         with self._lock:
             if self._engine is None:
-                self.start_server()
+                self.start_server()  # dllm-lint: disable=lock-blocking-call -- cold-start serialization is exactly what the lifecycle lock is for; probes read lock-free, and a dispatcher must wait for the engine it asked for
             return self._engine
 
     # -- health (device-server GET /health surface) ------------------------
@@ -158,19 +182,27 @@ class EngineManager:
         allgather read one assembler (the TierClient registers its
         AdmissionController on ``self.admission``; batching engines
         expose ``queue_depth``/``slot_stats``)."""
-        with self._lock:
-            running = self._engine is not None
-            entry: Dict[str, Any] = {
-                "ok": running,
-                "tier": self.tier.name,
-                "model": self.tier.model_preset,
-                "uptime_s": (time.time() - self._started_at) if running else 0.0,
-                "devices": ([d.id for d in self.mesh.devices.flat]
-                            if self.mesh is not None else None),
-            }
-            engine = self._engine
-        # Load/occupancy outside the lifecycle lock: counters are plain
-        # ints guarded by their own locks (or GIL-safe reads).
+        # LOCK-FREE on purpose: health() is the probe surface, and the
+        # lifecycle lock is held through minutes of compile during a
+        # (re)start — a probe waiting on it would read a merely-starting
+        # tier as dead (PR 2; the remote /health twin already reports
+        # lock-free).  start_server orders _started_at before _engine so
+        # this unlocked snapshot never sees an engine without its
+        # timestamp.
+        engine = self._engine
+        started_at = self._started_at
+        running = engine is not None
+        entry: Dict[str, Any] = {
+            "ok": running,
+            "tier": self.tier.name,
+            "model": self.tier.model_preset,
+            "uptime_s": ((time.time() - started_at)
+                         if running and started_at is not None else 0.0),
+            "devices": ([d.id for d in self.mesh.devices.flat]
+                        if self.mesh is not None else None),
+        }
+        # Load/occupancy counters are plain ints guarded by their own
+        # locks (or GIL-safe reads).
         slots = getattr(engine, "slot_stats", None)
         if callable(slots):
             try:

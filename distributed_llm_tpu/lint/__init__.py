@@ -1,0 +1,38 @@
+"""dllm-lint: the repo's AST-based static-analysis suite.
+
+``python -m distributed_llm_tpu.lint`` runs five checkers over the
+project (no jax import, CPU-only, sub-second):
+
+- ``locks``            lock-discipline / race detector (PR 2's bug class)
+- ``jit_purity``       host impurity inside jit/pjit/shard_map traces
+- ``error_shape``      reference error-dict conformance (parity surface)
+- ``config_drift``     DLLM_* env vars + config fields vs the registry
+- ``span_discipline``  span enter/exit pairing (PR 3, migrated from
+                       scripts/check_span_discipline.py)
+
+Suppression: ``# dllm-lint: disable=<rule> -- <justification>`` (line or
+file scope via ``disable-file``); the justification is mandatory and
+enforced.  Wired into tier-1 by tests/test_lint.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from .checkers import all_checkers
+from .core import (DEFAULT_TARGETS, Checker, Finding, LintResult, Module,
+                   Project, load_project, repo_root, run_checkers)
+
+__all__ = [
+    "Checker", "Finding", "LintResult", "Module", "Project",
+    "DEFAULT_TARGETS", "all_checkers", "load_project", "repo_root",
+    "run_checkers", "run_lint",
+]
+
+
+def run_lint(root: Optional[str] = None,
+             targets: Optional[Sequence[str]] = None,
+             rules: Optional[Sequence[str]] = None) -> LintResult:
+    """One-call entry point: load the project and run every checker."""
+    project = load_project(root or repo_root(), targets)
+    return run_checkers(project, all_checkers(), rules=rules)

@@ -1,0 +1,57 @@
+"""CLI: ``python -m distributed_llm_tpu.lint [targets...] [options]``.
+
+Exit 0 = zero unsuppressed findings; 1 = findings; 2 = usage error.
+Runs without jax (pure AST passes) so it is safe on any CPU box and
+cheap enough for tier-1 (tests/test_lint.py) and pre-commit hooks
+(scripts/lint.sh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import all_checkers, run_lint
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m distributed_llm_tpu.lint",
+        description="dllm-lint: repo static-analysis suite")
+    parser.add_argument("targets", nargs="*",
+                        help="files/dirs relative to the repo root "
+                             "(default: the standard project set)")
+    parser.add_argument("--rule", action="append", dest="rules",
+                        metavar="RULE",
+                        help="only report these rule ids (repeatable)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="list checkers and rule ids, then exit")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print suppressed findings")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for checker in all_checkers():
+            print(f"{checker.name}:")
+            for rule in checker.rules:
+                print(f"  {rule}")
+            print(f"  scope: {', '.join(checker.scope)}")
+        return 0
+
+    try:
+        result = run_lint(targets=args.targets or None, rules=args.rules)
+    except FileNotFoundError as exc:
+        print(f"dllm-lint: {exc}", file=sys.stderr)
+        return 2
+    for finding in result.findings:
+        print(finding.render())
+    if args.show_suppressed:
+        for finding, kind in result.suppressed:
+            print(f"[suppressed:{kind}] {finding.render()}")
+    n, s = len(result.findings), len(result.suppressed)
+    print(f"dllm-lint: {n} finding(s), {s} suppressed")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,28 @@
+"""Checker registry: add a checker by importing and listing it here.
+
+Each checker is a ``core.Checker`` subclass with a unique ``name``,
+a ``rules`` tuple (the ids suppression comments reference), and a
+``scope`` of path prefixes.  See DESIGN.md "Static analysis" for the
+how-to-add walkthrough.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Checker
+from .config_drift import ConfigDriftChecker
+from .error_shape import ErrorShapeChecker
+from .jit_purity import JitPurityChecker
+from .locks import LockChecker
+from .span_discipline import SpanDisciplineChecker
+
+
+def all_checkers() -> List[Checker]:
+    return [
+        LockChecker(),
+        JitPurityChecker(),
+        ErrorShapeChecker(),
+        ConfigDriftChecker(),
+        SpanDisciplineChecker(),
+    ]

@@ -1,0 +1,243 @@
+"""JIT-purity lint: host-side impurity inside traced computations.
+
+A function is a JIT ROOT when it is decorated with ``jax.jit`` /
+``pjit`` / ``shard_map`` (directly or through ``partial``), or passed
+to one of those as the function argument (``jax.jit(run)``,
+``shard_map(step, mesh=...)``, ``jax.jit(partial(init, cfg))``,
+``jax.jit(lambda: ...)``).  The checker walks roots plus every
+module-local function they transitively call (cross-module callees are
+out of static reach and skipped — keep traced helpers in the module
+that jits them, or lint them where they live).
+
+Rules:
+
+- ``jit-host-impurity``: ``time.*``, ``print``, Python/NumPy RNG
+  (``random.*`` / ``np.random.*`` — host randomness baked in at trace
+  time; use ``jax.random`` with explicit keys), ``open(...)`` and
+  ``.block_until_ready()`` (a host sync point has no meaning inside a
+  traced function) anywhere in a jit-reachable body.  ``jax.debug.*``
+  and ``jax.random.*`` are exempt by construction (matched by module
+  root).
+- ``jit-traced-concretization``: on the root function itself,
+  ``bool()`` / ``int()`` / ``float()`` / ``len()`` over an expression
+  mentioning a traced parameter, or ``.item()`` / ``.tolist()`` on one
+  — Python branching/iteration on traced values, the
+  compile-time-explosion / ConcretizationError class (HybridGen's
+  mixed host/accelerator pitfall: the bug hides until compile).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Checker, Finding, Project
+from ..symbols import attr_chain, call_name, symbols_for
+
+JIT_WRAPPERS = {"jit", "pjit", "shard_map"}
+CONCRETIZERS = {"bool", "int", "float", "len"}
+CONCRETIZE_METHODS = {"item", "tolist"}
+
+
+def _wrapper_leaf(node: ast.expr) -> Optional[str]:
+    """'jit' for jax.jit / jit, 'shard_map' for jax.shard_map, etc."""
+    chain = attr_chain(node)
+    if chain is None:
+        return None
+    leaf = chain.rsplit(".", 1)[-1]
+    return leaf if leaf in JIT_WRAPPERS else None
+
+
+def _unwrap_partial(node: ast.expr) -> ast.expr:
+    """partial(f, ...) -> f (functools.partial / partial)."""
+    if isinstance(node, ast.Call):
+        leaf = attr_chain(node.func)
+        if leaf is not None and leaf.rsplit(".", 1)[-1] == "partial":
+            if node.args:
+                return node.args[0]
+    return node
+
+
+class _ImportMap(ast.NodeVisitor):
+    """name -> source module for top-level imports, to tell stdlib
+    ``random`` apart from ``jax.random`` and ``np`` from anything
+    else."""
+
+    def __init__(self, tree: ast.Module):
+        self.modules: Dict[str, str] = {}
+        self.visit(tree)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            self.modules[alias.asname or alias.name.split(".")[0]] = \
+                alias.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        for alias in node.names:
+            base = node.module or ""
+            self.modules[alias.asname or alias.name] = \
+                f"{base}.{alias.name}".lstrip(".")
+
+
+class JitPurityChecker(Checker):
+    name = "jit_purity"
+    rules = ("jit-host-impurity", "jit-traced-concretization")
+    scope = ("distributed_llm_tpu",)
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in project.in_dirs(self.scope):
+            syms = symbols_for(mod)
+            if syms is None:
+                continue
+            findings.extend(self._check_module(mod, syms))
+        return findings
+
+    def _check_module(self, mod, syms) -> List[Finding]:
+        imports = _ImportMap(mod.tree)
+        roots: Set[str] = set()
+        lambda_roots: List[ast.Lambda] = []
+
+        # Decorator roots.
+        for qual, info in syms.functions.items():
+            node = info.node
+            for deco in getattr(node, "decorator_list", []):
+                target = deco
+                if isinstance(deco, ast.Call):
+                    if _wrapper_leaf(deco.func) is not None:
+                        roots.add(qual)
+                        continue
+                    # @partial(jax.jit, ...) / @partial(shard_map, ...)
+                    chain = attr_chain(deco.func)
+                    if (chain is not None
+                            and chain.rsplit(".", 1)[-1] == "partial"
+                            and deco.args
+                            and _wrapper_leaf(deco.args[0]) is not None):
+                        roots.add(qual)
+                        continue
+                if _wrapper_leaf(target) is not None:
+                    roots.add(qual)
+
+        # Call-site roots: jax.jit(X, ...), shard_map(X, mesh=...).
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _wrapper_leaf(node.func) is None or not node.args:
+                continue
+            target = _unwrap_partial(node.args[0])
+            if isinstance(target, ast.Lambda):
+                lambda_roots.append(target)
+            elif isinstance(target, ast.Name):
+                for qual, info in syms.functions.items():
+                    if (qual == target.id
+                            or qual.endswith(f"<locals>.{target.id}")):
+                        roots.add(qual)
+
+        if not roots and not lambda_roots:
+            return []
+
+        reachable = syms.local_closure(roots)
+        findings: List[Finding] = []
+        for qual in sorted(reachable):
+            info = syms.functions[qual]
+            findings.extend(self._scan_body(
+                mod, imports, info.node, is_root=(qual in roots)))
+        for lam in lambda_roots:
+            # A lambda passed to jit IS a root: its params are traced,
+            # so the concretization rules apply to it too.
+            findings.extend(self._scan_body(mod, imports, lam,
+                                            is_root=True))
+        return findings
+
+    # -- body scanning -----------------------------------------------------
+
+    def _scan_body(self, mod, imports: _ImportMap, func_node,
+                   is_root: bool) -> List[Finding]:
+        findings: List[Finding] = []
+        params: Set[str] = set()
+        if is_root and hasattr(func_node, "args"):
+            a = func_node.args
+            params = {p.arg for p in
+                      list(a.posonlyargs) + list(a.args)
+                      + list(a.kwonlyargs)}
+
+        body = (func_node.body if isinstance(func_node.body, list)
+                else [func_node.body])
+        # Skip nested def/lambda subtrees: they are their own entries in
+        # the reachable set when actually called from traced code.
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                findings.extend(self._check_call(mod, imports, node,
+                                                 params))
+            stack.extend(ast.iter_child_nodes(node))
+        return findings
+
+    def _check_call(self, mod, imports: _ImportMap, node: ast.Call,
+                    params: Set[str]) -> List[Finding]:
+        out: List[Finding] = []
+        chain = attr_chain(node.func) or ""
+        root = chain.split(".", 1)[0]
+        root_module = imports.modules.get(root, "")
+        name = call_name(node)
+
+        def flag(rule: str, msg: str) -> None:
+            out.append(Finding(rule, mod.relpath, node.lineno, msg))
+
+        # time.* inside a traced function.
+        if root_module == "time" or chain.startswith("time."):
+            flag("jit-host-impurity",
+                 f"`{chain}(...)` inside a jit-traced function runs at "
+                 f"TRACE time only — the compiled program never sees it")
+        # print() (jax.debug.print is an Attribute call, unaffected).
+        elif isinstance(node.func, ast.Name) and name == "print":
+            flag("jit-host-impurity",
+                 "`print(...)` inside a jit-traced function fires at "
+                 "trace time only — use jax.debug.print for runtime "
+                 "values")
+        # Host RNG: stdlib random (but not `from jax import random`)
+        # and numpy.random under any alias.
+        elif (chain.startswith("random.")
+              and imports.modules.get("random", "random") == "random"):
+            flag("jit-host-impurity",
+                 f"host RNG `{chain}(...)` is baked in at trace time — "
+                 f"use jax.random with an explicit key")
+        elif (".random." in f"{chain}." and root_module == "numpy"):
+            flag("jit-host-impurity",
+                 f"host RNG `{chain}(...)` is baked in at trace "
+                 f"time — use jax.random with an explicit key")
+        # File I/O.
+        elif isinstance(node.func, ast.Name) and name == "open":
+            flag("jit-host-impurity",
+                 "`open(...)` inside a jit-traced function is host I/O "
+                 "at trace time")
+        # Device sync inside traced code.
+        elif name == "block_until_ready":
+            flag("jit-host-impurity",
+                 "`.block_until_ready()` has no meaning inside a traced "
+                 "function — sync on the host after the jitted call")
+
+        # Concretization of traced parameters (root functions only:
+        # only there do we know which names are traced).
+        if params:
+            mentions = {n.id for n in ast.walk(node)
+                        if isinstance(n, ast.Name)} & params
+            if mentions:
+                if (isinstance(node.func, ast.Name)
+                        and name in CONCRETIZERS):
+                    flag("jit-traced-concretization",
+                         f"`{name}(...)` over traced parameter(s) "
+                         f"{sorted(mentions)} forces concretization at "
+                         f"trace time (Python branching on traced "
+                         f"values)")
+                elif (name in CONCRETIZE_METHODS
+                      and isinstance(node.func, ast.Attribute)):
+                    flag("jit-traced-concretization",
+                         f"`.{name}()` on traced parameter(s) "
+                         f"{sorted(mentions)} pulls the value to host "
+                         f"at trace time")
+        return out

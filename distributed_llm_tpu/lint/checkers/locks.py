@@ -1,0 +1,381 @@
+"""Lock-discipline / race detector (PR 2's bug class, made structural).
+
+Three rules over ``serving/`` + ``engine/`` + ``obs/``:
+
+- ``lock-blocking-call``: a blocking operation is reachable while a
+  ``threading`` lock is held.  Blocking = the repo's known long calls by
+  NAME (engine ``generate``/``generate_stream``/``warmup``,
+  ``start_server``/``stop_server``, checkpoint loads, ``time.sleep``,
+  socket/HTTP reads) plus the unbounded wait forms ``.join()`` /
+  ``.wait()`` / ``.get()`` / ``.acquire()`` called with no
+  timeout/arguments — propagated transitively through the module-local
+  call graph, so ``with self._lock: self.start_server()`` is flagged
+  even though the compile lives two calls down.  This is exactly the
+  PR 2 shape: a health probe blocking on the lifecycle lock through a
+  multi-minute warmup compile reads as a dead tier.
+- ``lock-order-inversion``: lock B acquired while A is held in one
+  place and A while B is held in another (static deadlock pair).
+  Acquisition-under-lock is collected transitively through resolvable
+  module-local calls.
+- ``lock-mixed-guard``: an instance attribute that is (a) written from
+  code reachable by a worker thread (``threading.Thread(target=...)``
+  entries and their module-local closure) and (b) guarded by a lock at
+  SOME access sites, but read or written bare at others — the
+  inconsistent-discipline race (the checker stays silent on attributes
+  never guarded anywhere: those are presumed single-writer by design,
+  e.g. a scheduler thread's private state with GIL-safe snapshot reads).
+
+Heuristics are deliberately name-based where cross-module types are
+unknowable statically; intended violations carry inline suppressions
+with justifications (see DESIGN.md "Static analysis").
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Checker, Finding, Project
+from ..symbols import ModuleSymbols, attr_chain, call_name, symbols_for
+
+# Long-running by name, wherever they are called (receiver-insensitive:
+# cross-module receivers cannot be typed statically).
+BLOCKING_NAMES = {
+    "sleep",                    # time.sleep
+    "generate", "generate_stream",   # engine device calls (minutes on a
+    "warmup",                        # wedged chip)
+    "start_server", "stop_server",   # lifecycle: build + compile + warm
+    "load_params_for_tier",          # checkpoint restore
+    "urlopen", "getresponse", "recv", "accept",   # socket/HTTP
+}
+
+# Zero-argument forms of these are unbounded waits.
+UNBOUNDED_WAIT_NAMES = {"join", "wait", "get", "acquire"}
+
+
+def _is_blocking_call(node: ast.Call) -> Optional[str]:
+    name = call_name(node)
+    if name in BLOCKING_NAMES:
+        return f"`{name}(...)`"
+    if (name in UNBOUNDED_WAIT_NAMES and not node.args
+            and not node.keywords):
+        return f"unbounded `{name}()` (no timeout)"
+    return None
+
+
+class _FuncScan(ast.NodeVisitor):
+    """One function body: blocking calls, lock events with held context,
+    and plain self-attribute accesses.  Nested defs are skipped (they
+    are separate functions that run later, on their own thread/stack)."""
+
+    def __init__(self, syms: ModuleSymbols, func_qual: str,
+                 class_name: Optional[str]):
+        self.syms = syms
+        self.func_qual = func_qual
+        self.class_name = class_name
+        self.direct_blocking: List[Tuple[ast.Call, str]] = []
+        self.acquires: Set[str] = set()          # locks this func takes
+        # (held_lock, acquired_lock, node) ordered pairs seen directly
+        self.order_pairs: List[Tuple[str, str, ast.AST]] = []
+        # blocking candidates under a held lock:
+        #   (node, reason, held_lock, resolved_callee | None)
+        self.held_calls: List[Tuple[ast.Call, Optional[str], str,
+                                    Optional[str]]] = []
+        # plain self.X accesses: (attr, node, is_write, held_locks)
+        self.attr_accesses: List[Tuple[str, ast.AST, bool,
+                                       Tuple[str, ...]]] = []
+        self._held: List[str] = []
+        self._rest_held: Set[str] = set()   # .acquire() → rest of function
+        self._skip_root = None
+
+    def run(self, node) -> "_FuncScan":
+        self._skip_root = node
+        for stmt in node.body:
+            self.visit(stmt)
+        return self
+
+    # -- scope fences ------------------------------------------------------
+
+    def visit_FunctionDef(self, node):            # nested def: don't descend
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def _held_now(self) -> Tuple[str, ...]:
+        return tuple(self._held) + tuple(self._rest_held)
+
+    # -- with-blocks -------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            expr = item.context_expr
+            # ``with lock:`` and ``with lock.acquire_timeout(...)``-style
+            # wrappers: resolve the lock receiver.
+            target = expr
+            if isinstance(expr, ast.Call):
+                self.visit(expr)
+                continue
+            lock = self.syms.resolve_lock(target, self.func_qual,
+                                          self.class_name)
+            if lock is not None:
+                self._note_acquire(lock, node)
+                self._held.append(lock)
+                pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self._held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def _note_acquire(self, lock: str, node: ast.AST) -> None:
+        self.acquires.add(lock)
+        for held in self._held_now():
+            if held != lock:
+                self.order_pairs.append((held, lock, node))
+
+    # -- calls -------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = call_name(node)
+        if name == "release" and isinstance(node.func, ast.Attribute):
+            # Manual release ends the rest-of-function held region a
+            # manual acquire opened (source order — conservative both
+            # ways, and exact for the acquire/try/finally idiom).
+            lock = self.syms.resolve_lock(node.func.value, self.func_qual,
+                                          self.class_name)
+            if lock is not None:
+                self._rest_held.discard(lock)
+            self.generic_visit(node)
+            return
+        if (name in ("acquire",) and isinstance(node.func, ast.Attribute)):
+            lock = self.syms.resolve_lock(node.func.value, self.func_qual,
+                                          self.class_name)
+            if lock is not None:
+                self._note_acquire(lock, node)
+                bounded = bool(node.args or node.keywords)
+                held = self._held_now()
+                if held and not bounded and lock not in held:
+                    self.held_calls.append(
+                        (node, f"unbounded `{lock}.acquire()`",
+                         held[0], None))
+                # Held for the remainder of the function: a manual
+                # acquire has no structural exit.
+                self._rest_held.add(lock)
+                self.generic_visit(node)
+                return
+        reason = _is_blocking_call(node)
+        if reason is not None:
+            self.direct_blocking.append((node, reason))
+        held = self._held_now()
+        if held:
+            resolved = None
+            for callee, cname, cnode in self.syms.calls.get(
+                    self.func_qual, ()):
+                if cnode is node:
+                    resolved = callee
+                    break
+            if reason is not None or resolved is not None:
+                self.held_calls.append((node, reason, held[0], resolved))
+        self.generic_visit(node)
+
+    # -- attribute accesses ------------------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (isinstance(node.value, ast.Name) and node.value.id == "self"):
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self.attr_accesses.append(
+                (node.attr, node, is_write, self._held_now()))
+        self.generic_visit(node)
+
+
+def _plain_accesses(scan: _FuncScan, tree_parents: Dict[int, ast.AST]
+                    ) -> List[Tuple[str, ast.AST, bool, Tuple[str, ...]]]:
+    """Filter out method-call receivers (``self.x.m()``): calling a
+    method on a shared object is that object's own thread-safety story,
+    not a rebinding race on the attribute."""
+    out = []
+    for attr, node, is_write, held in scan.attr_accesses:
+        parent = tree_parents.get(id(node))
+        if (isinstance(parent, ast.Attribute)
+                and isinstance(tree_parents.get(id(parent)), ast.Call)
+                and tree_parents[id(parent)].func is parent):
+            continue
+        out.append((attr, node, is_write, held))
+    return out
+
+
+class LockChecker(Checker):
+    name = "locks"
+    rules = ("lock-blocking-call", "lock-order-inversion",
+             "lock-mixed-guard")
+    scope = ("distributed_llm_tpu/serving", "distributed_llm_tpu/engine",
+             "distributed_llm_tpu/obs")
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        # (relpath, lockA, lockB) -> first site, for inversion detection
+        pair_sites: Dict[Tuple[str, str, str], Tuple[str, int]] = {}
+
+        for mod in project.in_dirs(self.scope):
+            syms = symbols_for(mod)
+            if syms is None:
+                continue
+            findings.extend(self._check_module(mod, syms, pair_sites))
+
+        # Lock-order inversions across all collected pairs (locks are
+        # module-scoped, so pairs only collide within one module).
+        reported = set()
+        for (rel, a, b), (path, line) in sorted(pair_sites.items()):
+            if (rel, b, a) in pair_sites and (rel, b, a) not in reported:
+                other = pair_sites[(rel, b, a)]
+                reported.add((rel, a, b))
+                findings.append(Finding(
+                    "lock-order-inversion", path, line,
+                    f"lock order inversion: {b} acquired while holding "
+                    f"{a} here, but {a} acquired while holding {b} at "
+                    f"{other[0]}:{other[1]} — static deadlock pair"))
+        return findings
+
+    # -- per-module --------------------------------------------------------
+
+    def _check_module(self, mod, syms: ModuleSymbols,
+                      pair_sites) -> List[Finding]:
+        findings: List[Finding] = []
+        scans: Dict[str, _FuncScan] = {}
+        for qual, info in syms.functions.items():
+            if isinstance(info.node, ast.Lambda):
+                continue
+            scans[qual] = _FuncScan(syms, qual,
+                                    info.class_name).run(info.node)
+
+        # Transitive blocking + transitive lock acquisition (fixpoint
+        # over resolved module-local call edges).
+        blocking: Dict[str, str] = {}        # qual -> witness reason
+        acquires: Dict[str, Set[str]] = {q: set(s.acquires)
+                                         for q, s in scans.items()}
+        for qual, scan in scans.items():
+            if scan.direct_blocking:
+                node, reason = scan.direct_blocking[0]
+                blocking[qual] = f"{reason} at line {node.lineno}"
+        changed = True
+        while changed:
+            changed = False
+            for qual in scans:
+                for callee, _n, _c in syms.calls.get(qual, ()):
+                    if callee is None or callee not in scans:
+                        continue
+                    if callee in blocking and qual not in blocking:
+                        blocking[qual] = f"calls `{callee}` " \
+                                         f"({blocking[callee]})"
+                        changed = True
+                    extra = acquires[callee] - acquires[qual]
+                    if extra:
+                        acquires[qual] |= extra
+                        changed = True
+
+        # Rule: blocking under a held lock (direct or via local callee);
+        # plus transitive order pairs through local calls.
+        for qual, scan in scans.items():
+            for held, acquired, node in scan.order_pairs:
+                key = (mod.relpath, held, acquired)
+                pair_sites.setdefault(key, (mod.relpath, node.lineno))
+            for node, reason, held_lock, resolved in scan.held_calls:
+                if reason is not None:
+                    findings.append(Finding(
+                        "lock-blocking-call", mod.relpath, node.lineno,
+                        f"blocking {reason} while holding {held_lock}"))
+                elif resolved is not None and resolved in blocking:
+                    findings.append(Finding(
+                        "lock-blocking-call", mod.relpath, node.lineno,
+                        f"call to `{resolved}` while holding {held_lock} "
+                        f"— transitively blocking: {blocking[resolved]}"))
+                if resolved is not None:
+                    held = {held_lock}
+                    for lock in acquires.get(resolved, ()):
+                        for h in held:
+                            if h != lock:
+                                key = (mod.relpath, h, lock)
+                                pair_sites.setdefault(
+                                    key, (mod.relpath, node.lineno))
+
+        findings.extend(self._mixed_guard(mod, syms, scans))
+        return findings
+
+    # -- rule: mixed guard discipline --------------------------------------
+
+    def _mixed_guard(self, mod, syms: ModuleSymbols,
+                     scans: Dict[str, _FuncScan]) -> List[Finding]:
+        findings: List[Finding] = []
+        parents: Dict[int, ast.AST] = {}
+        for parent in ast.walk(mod.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[id(child)] = parent
+
+        # Worker entries: threading.Thread(target=X), resolved in the
+        # SPAWNING call's own scope — a Name target binds to a local def
+        # visible from the enclosing function chain, a `self.m` target
+        # to the spawning class's method.  Matching by bare name across
+        # the module would mark unrelated classes' same-named methods
+        # worker-reachable and manufacture mixed-guard findings there.
+        worker_roots: Set[str] = set()
+        for caller, edges in syms.calls.items():
+            info = syms.functions.get(caller)
+            for _callee, name, node in edges:
+                if name != "Thread":
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    target = kw.value
+                    if isinstance(target, ast.Name) and info is not None:
+                        scope: Optional[str] = caller
+                        while scope:
+                            cand = f"{scope}.<locals>.{target.id}"
+                            if cand in syms.functions:
+                                worker_roots.add(cand)
+                                break
+                            parent = syms.functions.get(scope)
+                            scope = parent.parent if parent else None
+                    elif (isinstance(target, ast.Attribute)
+                          and isinstance(target.value, ast.Name)
+                          and target.value.id == "self"
+                          and info is not None and info.class_name):
+                        cand = f"{info.class_name}.{target.attr}"
+                        if cand in syms.functions:
+                            worker_roots.add(cand)
+        if not worker_roots:
+            return findings
+        worker_funcs = syms.local_closure(worker_roots)
+
+        # Per class: guarded attrs, worker-side writes, bare accesses.
+        classes = {i.class_name for i in syms.functions.values()
+                   if i.class_name}
+        for cls in sorted(classes):
+            guarded: Dict[str, Set[str]] = {}
+            worker_writes: Set[str] = set()
+            bare: List[Tuple[str, ast.AST, str]] = []
+            for qual, scan in scans.items():
+                info = syms.functions[qual]
+                if info.class_name != cls:
+                    continue
+                is_init = qual.split(".")[-1] == "__init__"
+                for attr, node, is_write, held in _plain_accesses(
+                        scan, parents):
+                    if held:
+                        guarded.setdefault(attr, set()).update(held)
+                    if is_write and qual in worker_funcs and not is_init:
+                        worker_writes.add(attr)
+                    if not held and not is_init:
+                        bare.append((attr, node, qual))
+            for attr, node, qual in bare:
+                if attr in guarded and attr in worker_writes:
+                    locks = ", ".join(sorted(guarded[attr]))
+                    findings.append(Finding(
+                        "lock-mixed-guard", mod.relpath, node.lineno,
+                        f"`self.{attr}` is written from worker-thread "
+                        f"code and guarded by {locks} elsewhere, but "
+                        f"accessed here without any lock"))
+        return findings

@@ -1,0 +1,111 @@
+"""Span-discipline checker — migrated from scripts/check_span_discipline.py.
+
+Every span ENTER must have a matching EXIT on every return/raise path.
+obs/spans.py makes that structural — spans are context managers — so the
+discipline reduces to two statically checkable rules for the
+instrumented layers (serving/, engine/):
+
+- ``span-not-with``: every call to a ``span(...)`` factory (``trace.span``,
+  ``parent.span``, ``spans.span``) and to the PhaseTimer's ``phase(...)``
+  must appear ONLY as a ``with``-statement context item — a bare call
+  would open a span whose exit depends on later code reaching it.
+- ``span-manual-enter``: manual enter APIs (``start_span`` /
+  ``begin_span`` / explicit ``__enter__``) are forbidden outside obs/
+  itself; long-lived work that cannot be ``with``-scoped uses the token
+  timeline / completion-callback pattern instead (obs/spans.py).
+
+``check_source`` / ``check_tree`` keep the original script's string-list
+API so scripts/check_span_discipline.py stays a thin back-compat shim
+(tests/test_obs.py drives exactly that surface).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from ..core import Checker, Finding, Module, Project
+from ..symbols import call_name as _call_name
+
+# Context-manager factories that MUST be with-items.
+WITH_ONLY = {"span", "phase"}
+# Manual-enter APIs that must not appear at all in instrumented layers.
+FORBIDDEN = {"start_span", "begin_span", "__enter__"}
+
+
+def _findings_for_tree(tree: ast.Module, path: str) -> List[Finding]:
+    with_items = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    with_items.add(id(item.context_expr))
+
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name in FORBIDDEN:
+            out.append(Finding(
+                "span-manual-enter", path, node.lineno,
+                f"manual span enter `{name}(...)` — use "
+                f"`with ....span(...)` so the exit is structural"))
+        elif name in WITH_ONLY and id(node) not in with_items:
+            out.append(Finding(
+                "span-not-with", path, node.lineno,
+                f"`{name}(...)` called outside a `with` item — the "
+                f"span/phase would have no guaranteed exit on "
+                f"raise/return paths"))
+    return out
+
+
+class SpanDisciplineChecker(Checker):
+    name = "span_discipline"
+    rules = ("span-not-with", "span-manual-enter")
+    scope = ("distributed_llm_tpu/serving", "distributed_llm_tpu/engine")
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in project.in_dirs(self.scope):
+            if mod.tree is None:
+                continue
+            findings.extend(_findings_for_tree(mod.tree, mod.relpath))
+        return findings
+
+
+# -- legacy string-list API (scripts/check_span_discipline.py shim) ----------
+
+def check_source(src: str, path: str = "<string>") -> List[str]:
+    """Violation strings for one module's source (empty = clean).
+    Honors the framework's suppression comments, so the shim and the
+    checker agree on what "clean" means."""
+    mod = Module(path, src)
+    if mod.tree is None:
+        return [f"{path}: failed to parse: {mod.parse_error}"]
+    return [f"{f.path}:{f.line}: {f.message}"
+            for f in _findings_for_tree(mod.tree, path)
+            if not mod.suppressions.covers(f.rule, f.line)]
+
+
+def check_tree(dirs=None) -> List[str]:
+    """Violation strings over the instrumented layers (legacy surface)."""
+    from ..core import repo_root
+    root = repo_root()
+    if dirs is None:
+        dirs = (os.path.join(root, "distributed_llm_tpu", "serving"),
+                os.path.join(root, "distributed_llm_tpu", "engine"))
+    out: List[str] = []
+    for root_dir in dirs:
+        for dirpath, _dirnames, filenames in os.walk(root_dir):
+            if "__pycache__" in dirpath:
+                continue
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                with open(path, encoding="utf-8") as f:
+                    out.extend(check_source(f.read(),
+                                            os.path.relpath(path, root)))
+    return out

@@ -1,0 +1,208 @@
+"""Shared walker + symbol table for dllm-lint checkers.
+
+One pass over a module yields:
+
+- every function/method (nested defs included) with a stable qualname
+  (``Class.method``, ``Class.method.<locals>.worker``, ``func``),
+- declared locks (``self._x = threading.Lock()`` instance attrs,
+  module-level ``_lock = threading.Lock()``, and function-local
+  ``state_lock = threading.Lock()``), keyed so usage sites resolve to
+  the same identity,
+- a module-local call graph: edges a checker can actually trust —
+  ``name(...)`` to a local/module function, ``self.m(...)`` to a method
+  of the same class — plus the bare called-name for set-membership
+  heuristics (cross-module calls are matched by NAME, never resolved).
+
+Checkers layer semantics (blocking-ness, purity, guarded regions) on
+top; this module only answers "what functions exist and who calls whom".
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                  "BoundedSemaphore"}
+
+
+def call_name(node: ast.Call) -> str:
+    """The bare called name: ``f`` for ``f(...)``/``a.b.f(...)``."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def attr_chain(node: ast.expr) -> Optional[str]:
+    """Dotted source text for Name/Attribute chains (``self._lock``,
+    ``os.environ``); None for anything dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_lock_factory(value: ast.expr) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    chain = attr_chain(value.func)
+    if chain is None:
+        return False
+    leaf = chain.rsplit(".", 1)[-1]
+    return leaf in LOCK_FACTORIES
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qualname: str
+    node: ast.AST                   # FunctionDef | AsyncFunctionDef | Lambda
+    class_name: Optional[str]       # nearest enclosing class
+    parent: Optional[str]           # enclosing function qualname
+
+
+class ModuleSymbols(ast.NodeVisitor):
+    """One module's functions, locks, and call edges."""
+
+    def __init__(self, tree: ast.Module):
+        self.functions: Dict[str, FuncInfo] = {}
+        # lock id -> declaration line.  Ids:
+        #   "Class.self._x"  instance attr (any method of Class)
+        #   "<module>.name"  module-level
+        #   "<func qualname>.name"  function-local
+        self.locks: Dict[str, int] = {}
+        # call edges: caller qualname -> [(callee qualname | None,
+        #                                  bare name, Call node)]
+        self.calls: Dict[str, List[Tuple[Optional[str], str, ast.Call]]] = {}
+        self._class_stack: List[str] = []
+        self._func_stack: List[str] = []
+        self.visit(tree)
+
+    # -- scope bookkeeping -------------------------------------------------
+
+    def _qual(self, name: str) -> str:
+        if self._func_stack:
+            return f"{self._func_stack[-1]}.<locals>.{name}"
+        if self._class_stack:
+            return f"{self._class_stack[-1]}.{name}"
+        return name
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_func(self, node) -> None:
+        qual = self._qual(node.name)
+        self.functions[qual] = FuncInfo(
+            qualname=qual, node=node,
+            class_name=self._class_stack[-1] if self._class_stack else None,
+            parent=self._func_stack[-1] if self._func_stack else None)
+        self._func_stack.append(qual)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # -- locks -------------------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if _is_lock_factory(node.value):
+            for target in node.targets:
+                chain = attr_chain(target)
+                if chain is None:
+                    continue
+                if chain.startswith("self.") and self._class_stack:
+                    self.locks[f"{self._class_stack[-1]}.{chain}"] = \
+                        node.lineno
+                elif "." not in chain:
+                    if self._func_stack:
+                        self.locks[f"{self._func_stack[-1]}.{chain}"] = \
+                            node.lineno
+                    else:
+                        self.locks[f"<module>.{chain}"] = node.lineno
+        self.generic_visit(node)
+
+    # -- calls -------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        caller = self._func_stack[-1] if self._func_stack else "<module>"
+        callee = self._resolve(node)
+        self.calls.setdefault(caller, []).append(
+            (callee, call_name(node), node))
+        self.generic_visit(node)
+
+    def _resolve(self, node: ast.Call) -> Optional[str]:
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            # Nearest enclosing <locals> def, else module-level.
+            for enclosing in reversed(self._func_stack):
+                cand = f"{enclosing}.<locals>.{fn.id}"
+                if cand in self.functions:
+                    return cand
+            if fn.id in self.functions:
+                return fn.id
+            return None
+        if (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)
+                and fn.value.id == "self" and self._class_stack):
+            cand = f"{self._class_stack[-1]}.{fn.attr}"
+            if cand in self.functions:
+                return cand
+        return None
+
+    # -- queries -----------------------------------------------------------
+
+    def resolve_lock(self, expr: ast.expr, func_qual: str,
+                     class_name: Optional[str]) -> Optional[str]:
+        """Map a with-item / .acquire() receiver back to a declared lock
+        id, walking the enclosing-function chain for locals (closures)."""
+        chain = attr_chain(expr)
+        if chain is None:
+            return None
+        if chain.startswith("self.") and class_name:
+            cand = f"{class_name}.{chain}"
+            return cand if cand in self.locks else None
+        if "." in chain:
+            return None
+        scope: Optional[str] = func_qual
+        while scope:
+            cand = f"{scope}.{chain}"
+            if cand in self.locks:
+                return cand
+            info = self.functions.get(scope)
+            scope = info.parent if info else None
+        cand = f"<module>.{chain}"
+        return cand if cand in self.locks else None
+
+    def local_closure(self, roots: Set[str]) -> Set[str]:
+        """roots + every module-local function transitively reachable
+        through resolved call edges."""
+        seen = set(roots)
+        frontier = list(roots)
+        while frontier:
+            cur = frontier.pop()
+            for callee, _name, _node in self.calls.get(cur, ()):
+                if callee is not None and callee not in seen:
+                    seen.add(callee)
+                    frontier.append(callee)
+        return seen
+
+
+def symbols_for(module) -> Optional[ModuleSymbols]:
+    """ModuleSymbols for a core.Module (None when it failed to parse),
+    cached on the module object."""
+    if module.tree is None:
+        return None
+    cached = getattr(module, "_dllm_symbols", None)
+    if cached is None:
+        cached = ModuleSymbols(module.tree)
+        module._dllm_symbols = cached
+    return cached

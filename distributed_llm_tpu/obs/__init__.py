@@ -57,7 +57,9 @@ def get_observability() -> Observability:
     if _GLOBAL is None:
         with _GLOBAL_LOCK:
             if _GLOBAL is None:
-                raw = os.environ.get("DLLM_OBS_SLOW_MS", "").strip().lower()
+                from ..config_registry import env_str
+                raw = (env_str("DLLM_OBS_SLOW_MS", "") or "") \
+                    .strip().lower()
                 slow_ms: Optional[float] = 30000.0
                 if raw in ("off", "none"):
                     slow_ms = None

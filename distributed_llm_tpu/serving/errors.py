@@ -1,0 +1,44 @@
+"""The reference error-dict shape — single schema constant.
+
+The reference clients (src/models/nano.py:30-40) report every failure as
+``{"error": "<message>"}``; Router failover, ``_is_error``, the perf
+strategy's failure penalty, the circuit breaker, and the benchmark
+harness's parity with routing_chatbot_tester.py all key off exactly that
+shape.  PR 2 added one sanctioned extension: ``retry_after_s`` (numeric)
+on the degraded fail-fast path.
+
+This module is the one place the shape is defined.  Producers either
+call ``error_dict`` or write a literal that the ``error-shape`` lint
+checker (distributed_llm_tpu/lint) validates against these constants —
+so src/app.py parity can't silently drift.  Stdlib-only: the lint CLI
+imports it without pulling jax.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+# The discriminating key: a dict is error-shaped iff it carries it.
+ERROR_KEY = "error"
+
+# Every key an error dict may carry.  ``retry_after_s`` is the degraded
+# fail-fast hint (serving/router.py); anything else is drift.
+ALLOWED_KEYS = frozenset({ERROR_KEY, "retry_after_s"})
+
+# Keys with a typing contract the checker enforces on literals.
+NUMERIC_KEYS = frozenset({"retry_after_s"})
+
+
+def error_dict(message: str,
+               retry_after_s: Optional[float] = None) -> Dict[str, Any]:
+    """Construct a conforming reference error dict."""
+    out: Dict[str, Any] = {ERROR_KEY: message}
+    if retry_after_s is not None:
+        out["retry_after_s"] = round(float(retry_after_s), 2)
+    return out
+
+
+def is_error_shape(raw: Any) -> bool:
+    """The reference ``_is_error`` predicate (src/router.py:277-282):
+    any dict carrying the error key."""
+    return isinstance(raw, dict) and ERROR_KEY in raw

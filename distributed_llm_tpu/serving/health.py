@@ -86,7 +86,7 @@ class HealthMonitor:
             running = mgr.is_server_running()
             health = mgr.health()
         except Exception as exc:
-            return "failed", {"ok": False, "error": str(exc)}
+            return "failed", {"ok": False, "error": str(exc)}  # dllm-lint: disable=error-shape -- health-probe snapshot (GET /health surface: ok+error), not the tier error path
         if not running:
             # A DEAD remote is classified failed above (health() raises
             # into the except).  This branch covers the remote that still

@@ -38,6 +38,7 @@ from ..obs.spans import current_trace, use_trace
 from ..routing.engine import QueryRouter
 from ..routing.token_counter import TokenCounter
 from ..utils.faults import FaultInjector
+from .errors import is_error_shape
 from .tiers import TierClient, build_tiers
 
 logger = logging.getLogger(__name__)
@@ -382,7 +383,9 @@ class Router:
 
     @staticmethod
     def _is_error(raw: Any) -> bool:
-        return isinstance(raw, dict) and "error" in raw
+        # Delegates to the single error-shape schema (serving/errors.py)
+        # that the `error-shape` lint checker enforces on every literal.
+        return is_error_shape(raw)
 
     @staticmethod
     def _is_transient_error(raw: Any) -> bool:

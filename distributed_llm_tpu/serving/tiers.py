@@ -238,9 +238,16 @@ class TierClient:
             finally:
                 self.admission.release(time.perf_counter() - t0)
             if result is not None:
-                self.last_result = result
+                # Same lock as the timeout path's worker: last_result is
+                # read/written cross-thread once timeouts can abandon
+                # workers, so every rebind goes through _abandoned_lock
+                # (the lock-mixed-guard lint pins this discipline).
+                with self._abandoned_lock:
+                    self.last_result = result
             return resp
-        if self._abandoned and not self._engine_concurrent_safe():
+        with self._abandoned_lock:
+            abandoned_outstanding = self._abandoned
+        if abandoned_outstanding and not self._engine_concurrent_safe():
             self.admission.release()
             logger.warning("tier %s has an abandoned timed-out call "
                            "outstanding — failing fast", self.name)
@@ -329,7 +336,7 @@ class TierClient:
                 result = engine.generate(history)
             else:
                 with self._engine_lock:
-                    result = engine.generate(history)
+                    result = engine.generate(history)  # dllm-lint: disable=lock-blocking-call -- the engine lock IS the queue: sequential engines require serialized callers, and admission + request_timeout_s bound the wait
         except Exception as exc:   # engine failure → reference error shape
             return {"error": f"Request failed: {exc}"}, None
 
@@ -449,7 +456,7 @@ class TierClient:
 
             try:
                 clipped = ClippedStream(
-                    engine.generate_stream(history),
+                    engine.generate_stream(history),  # dllm-lint: disable=lock-blocking-call -- a sequential engine's stream must hold the engine lock for its whole life (released by _PrimedStream on exhaustion/close/GC); the acquire above is bounded by request_timeout_s
                     prime_drain_chars=PRIME_DRAIN_CHARS)
                 handle_box["handle"] = clipped
                 return _PrimedStream(self._maybe_break_stream(clipped),

@@ -107,6 +107,7 @@ def create_tier_app(tier_name: str,
             if callable(stall_fn) and deadline is not None:
                 stall_s = float(stall_fn())
                 if stall_s > deadline:
+                    # dllm-lint: disable=error-shape -- health-probe snapshot (GET /health surface: ok+wedged+error), not the tier error path
                     return jsonify({
                         "ok": False, "wedged": True,
                         "error": (f"decode watchdog: no step progress "

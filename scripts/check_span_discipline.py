@@ -1,101 +1,35 @@
 #!/usr/bin/env python3
-"""Static span-discipline pass over serving/ and engine/.
+"""DEPRECATED shim — span discipline moved into the lint framework.
 
-Every span ENTER must have a matching EXIT on every return/raise path.
-obs/spans.py makes that structural — spans are context managers — so the
-discipline reduces to two statically checkable rules for the
-instrumented layers (serving/, engine/):
-
-1. Every call to a ``span(...)`` method/function (``trace.span``,
-   ``parent.span``, ``spans.span``) and to the PhaseTimer's ``phase(...)``
-   must appear ONLY as a ``with``-statement context item — a bare call
-   would open a span whose exit depends on later code reaching it.
-2. Manual enter APIs (``start_span`` / ``begin_span`` / calling
-   ``__enter__`` explicitly) are forbidden outside obs/ itself: there is
-   no way to prove their exit statically.  Long-lived work that cannot
-   be ``with``-scoped (a stream outliving its opener) must use the token
-   timeline / completion-callback pattern instead (see obs/spans.py).
-
-Runs standalone (``python scripts/check_span_discipline.py``) and as a
-tier-1 test (tests/test_obs.py) so a violating span can't merge.
-Exit code 0 = clean; 1 = violations (one per line on stdout).
+The static span-discipline pass now lives at
+``distributed_llm_tpu/lint/checkers/span_discipline.py`` and runs with
+the rest of the suite via ``python -m distributed_llm_tpu.lint`` (or
+``scripts/lint.sh``).  This file survives only so existing wiring —
+tests/test_obs.py's back-compat pin and any external callers of
+``python scripts/check_span_discipline.py`` — keeps working; the
+``check_source`` / ``check_tree`` surface delegates to the framework
+checker and behaves identically (plus it now honors ``# dllm-lint:
+disable=span-*`` suppressions, which the standalone script predated).
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import List
 
-# Context-manager factories that MUST be with-items.
-WITH_ONLY = {"span", "phase"}
-# Manual-enter APIs that must not appear at all in instrumented layers.
-FORBIDDEN = {"start_span", "begin_span", "__enter__"}
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CHECKED_DIRS = (
-    os.path.join(REPO, "distributed_llm_tpu", "serving"),
-    os.path.join(REPO, "distributed_llm_tpu", "engine"),
-)
+from distributed_llm_tpu.lint.checkers.span_discipline import (  # noqa: E402
+    FORBIDDEN, WITH_ONLY, check_source, check_tree)
 
-
-def _call_name(node: ast.Call) -> str:
-    fn = node.func
-    if isinstance(fn, ast.Attribute):
-        return fn.attr
-    if isinstance(fn, ast.Name):
-        return fn.id
-    return ""
-
-
-def check_source(src: str, path: str = "<string>") -> List[str]:
-    """Violation strings for one module's source (empty = clean)."""
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as exc:
-        return [f"{path}: failed to parse: {exc}"]
-
-    # Calls appearing as a with-statement's context expression are the
-    # sanctioned form: __exit__ runs on every path out of the block.
-    with_items = set()
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.With, ast.AsyncWith)):
-            for item in node.items:
-                if isinstance(item.context_expr, ast.Call):
-                    with_items.add(id(item.context_expr))
-
-    out: List[str] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        name = _call_name(node)
-        if name in FORBIDDEN:
-            out.append(f"{path}:{node.lineno}: manual span enter "
-                       f"`{name}(...)` — use `with ....span(...)` so the "
-                       "exit is structural")
-        elif name in WITH_ONLY and id(node) not in with_items:
-            out.append(f"{path}:{node.lineno}: `{name}(...)` called "
-                       "outside a `with` item — the span/phase would "
-                       "have no guaranteed exit on raise/return paths")
-    return out
-
-
-def check_tree(dirs=CHECKED_DIRS) -> List[str]:
-    out: List[str] = []
-    for root_dir in dirs:
-        for dirpath, _dirnames, filenames in os.walk(root_dir):
-            for fname in sorted(filenames):
-                if not fname.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fname)
-                with open(path, encoding="utf-8") as f:
-                    out.extend(check_source(f.read(),
-                                            os.path.relpath(path, REPO)))
-    return out
+__all__ = ["FORBIDDEN", "WITH_ONLY", "check_source", "check_tree", "main"]
 
 
 def main(argv=None) -> int:
+    print("note: scripts/check_span_discipline.py is a deprecation shim; "
+          "use `python -m distributed_llm_tpu.lint` (rule span-*)",
+          file=sys.stderr)
     violations = check_tree()
     for v in violations:
         print(v)

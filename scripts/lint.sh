@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Local entry point for the repo's static-analysis suite (dllm-lint).
+#
+#   scripts/lint.sh                 # whole project (the tier-1 surface)
+#   scripts/lint.sh --list-rules    # checker/rule inventory
+#   scripts/lint.sh distributed_llm_tpu/serving --rule lock-blocking-call
+#
+# Pure AST passes: no jax import, CPU-only, sub-second — safe as a
+# pre-commit hook.  Exit 0 = clean, 1 = unsuppressed findings.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m distributed_llm_tpu.lint "$@"

@@ -9,6 +9,13 @@ TPU.  Must be set before jax is imported anywhere.
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Registered env reads only (stdlib-only module, safe before jax): a
+# typo'd DLLM_* name raises UnknownConfigError here instead of silently
+# serving the default forever (see CONFIG.md / config_registry.py).
+from distributed_llm_tpu.config_registry import env_str  # noqa: E402
+
 # Force (not setdefault): the dev/bench environment exports
 # JAX_PLATFORMS=axon globally, and the single tunneled TPU chip must never be
 # claimed by the test suite — concurrent claims wedge every python process.
@@ -28,11 +35,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 # Set via env (inherited by subprocess-based tests like
 # test_reference_unchanged.py, which recompile full engines) AND via
 # jax.config below (this process imported jax-adjacent state already).
-if "DLLM_TEST_COMPILE_CACHE" in os.environ:
-    # Explicit suite-local override always wins (even over a user-global
-    # JAX_COMPILATION_CACHE_DIR).
-    os.environ["JAX_COMPILATION_CACHE_DIR"] = \
-        os.environ["DLLM_TEST_COMPILE_CACHE"]
+_suite_cache = env_str("DLLM_TEST_COMPILE_CACHE")
+if _suite_cache is not None:
+    # Presence, not truthiness: the explicit suite-local override always
+    # wins (even over a user-global JAX_COMPILATION_CACHE_DIR — and even
+    # when set empty to neutralize one).
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = _suite_cache
 else:
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                           "/tmp/dllm_jax_test_cache")
@@ -46,8 +54,6 @@ jax.config.update("jax_compilation_cache_dir",
                   os.environ["JAX_COMPILATION_CACHE_DIR"])
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def pytest_configure(config):
