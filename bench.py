@@ -585,6 +585,179 @@ def chaos_phase(strategies=("heuristic", "hybrid", "perf"),
     return out
 
 
+def pressure_phase(n_clients: int = 4, beat=lambda: None) -> dict:
+    """Resource-pressure chaos leg (ISSUE 5): the concurrent closed-loop
+    load on the pinned tiny-batched config while a scripted
+    block-starvation schedule (utils/faults.py BlockStarver) repeatedly
+    confiscates the nano tier's free KV blocks.  KV-aware admission sheds
+    hopeless requests (Router failover keeps them ANSWERED on orin), and
+    nano slots that can no longer grow exercise mid-decode preemption.
+    Reports **availability** (same definition as the chaos leg),
+    **preemptions**, **KV admission rejects**, a **replay-identity**
+    sub-check (a preempted greedy request's text vs its unpreempted run,
+    on a dedicated 2-slot constrained-pool engine — deterministic, unlike
+    which load request gets preempted), and a **graceful-drain epilogue**
+    (SIGTERM semantics: in-flight requests finish, 0 mid-stream kills,
+    then admission 503s)."""
+    import dataclasses
+    import sys
+
+    from distributed_llm_tpu.config import tiny_batched_cluster
+    from distributed_llm_tpu.engine.batching import ContinuousBatchingEngine
+    from distributed_llm_tpu.serving.router import Router
+    from distributed_llm_tpu.utils.faults import FaultInjector, FaultSchedule
+
+    print("[bench] resource-pressure leg", file=sys.stderr, flush=True)
+    out: dict = {"clients": n_clients,
+                 "schedule": "nano pool starved every 0.15s for 1.5s "
+                             "(re-confiscating freed blocks)"}
+
+    # -- replay identity (deterministic preemption on a tiny pool) --------
+    tier = dataclasses.replace(tiny_batched_cluster().nano, decode_batch=2,
+                               max_new_tokens=24)
+    probe_a = "tell me about rivers and lakes and streams and oceans please"
+    probe_b = "what is the tallest mountain on the continent of asia today"
+    solo = ContinuousBatchingEngine(tier, seed=1)
+    try:
+        base_a = solo.generate(probe_a).text
+        base_b = solo.generate(probe_b).text
+    finally:
+        solo.stop()
+    beat()
+    tight = ContinuousBatchingEngine(
+        dataclasses.replace(tier, kv_pool_blocks=5,
+                            enable_prefix_cache=False), seed=1)
+    res: dict = {}
+    try:
+        threads = [threading.Thread(
+            target=lambda k, q: res.__setitem__(k, tight.generate(q)),
+            args=(k, q), daemon=True)
+            for k, q in (("a", probe_a), ("b", probe_b))]
+        threads[0].start()
+        time.sleep(0.02)
+        threads[1].start()
+        for t in threads:
+            t.join(timeout=120)
+        identical = (res.get("a") is not None and res.get("b") is not None
+                     and res["a"].text == base_a
+                     and res["b"].text == base_b)
+        out["replay_identity"] = {
+            "preemptions": tight.preempted_total,
+            "identical": bool(identical),
+            "pool_freed": tight.allocator.available
+            == tight.paged.num_blocks - 1,
+        }
+    finally:
+        tight.stop()
+    beat()
+
+    # -- closed-loop load under starvation --------------------------------
+    fi = FaultInjector()
+    router = Router(strategy="heuristic", benchmark_mode=True,
+                    cluster=tiny_batched_cluster(), fault_injector=fi)
+    sched = None
+    try:
+        for tc in router.tiers.values():
+            tc.server_manager.start_server(beat=beat)
+            beat()
+        router.route_query([{"role": "user",
+                             "content": "pressure warmup turn about "
+                                        "rivers and mountains please"}])
+        beat()
+        nano_engine = router.nano.server_manager.engine()
+        preempt_before = nano_engine.preempted_total
+        kv_rej_before = router.nano.admission.kv_rejected
+        sched = FaultSchedule(fi)
+        # Re-starve every 150 ms: blocks freed by finishing slots or
+        # prefix-cache evictions get re-confiscated, so growth keeps
+        # failing while the window is open and preemption must fire.
+        for i in range(10):
+            sched.starve_blocks(nano_engine.allocator,
+                                0.3 + 0.15 * i, 0.3 + 0.15 * (i + 1) - 0.01,
+                                10_000, tier="nano")
+        until = time.monotonic() + sched.duration_s() + 0.4
+        records: list = []
+        errors: list = []
+        sched.start()
+
+        def client(i, until=until):
+            turn = 0
+            try:
+                while time.monotonic() < until:
+                    resp, _, _dev = router.route_query(
+                        [{"role": "user",
+                          "content": f"pressure client {i} turn {turn}: "
+                                     f"tell me about rivers and lakes and "
+                                     f"topic {turn % 5} please"}])
+                    records.append(
+                        (time.monotonic(),
+                         bool(resp.get("ok")) or bool(resp.get("degraded"))))
+                    turn += 1
+            except BaseException as exc:      # never lose the leg
+                errors.append(repr(exc)[:80])
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    name=f"pressure-{i}", daemon=True)
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 120
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        hung = sum(1 for t in threads if t.is_alive())
+        sched.stop()
+        beat()
+
+        n = len(records)
+        out["load"] = {
+            "requests": n,
+            "availability": round(sum(1 for _, a in records if a)
+                                  / n, 4) if n else 0.0,
+            "errors": len(errors),
+            "hung_clients": hung,
+            "preemptions": nano_engine.preempted_total - preempt_before,
+            "kv_admission_rejected":
+                router.nano.admission.kv_rejected - kv_rej_before,
+        }
+
+        # -- graceful-drain epilogue (SIGTERM semantics) ------------------
+        drain_res: dict = {}
+
+        def late(i):
+            drain_res[i] = router.route_query(
+                [{"role": "user",
+                  "content": f"drain straggler {i}: one more question "
+                             f"about rivers please"}])[0]
+
+        stragglers = [threading.Thread(target=late, args=(i,), daemon=True)
+                      for i in range(2)]
+        for t in stragglers:
+            t.start()
+        time.sleep(0.05)                     # in flight when drain starts
+        summary = router.drain(timeout_s=20.0)
+        for t in stragglers:
+            t.join(timeout=30)
+        finished_ok = sum(1 for r in drain_res.values() if r.get("ok"))
+        post = router.route_query([{"role": "user",
+                                    "content": "after the drain"}])[0]
+        out["drain"] = {
+            "in_flight": len(stragglers),
+            "finished_ok": finished_ok,
+            "mid_stream_kills": len(stragglers) - len(drain_res),
+            "aborted": sum(int(s.get("aborted") or 0)
+                           for s in summary.values()
+                           if isinstance(s, dict)),
+            "post_drain_rejected": not post.get("ok"),
+        }
+        beat()
+    finally:
+        if sched is not None:
+            sched.stop()
+        for tc in router.tiers.values():
+            tc.server_manager.stop_server()
+    return out
+
+
 def concurrent_phase(cluster, n_requests: int = 12, n_sequential: int = 4,
                      slots: int = 4, max_new: int = 32, repeat: int = 3,
                      beat=lambda: None) -> dict:
@@ -1512,6 +1685,21 @@ def run(progress: "Progress" = None, budget: "Budget" = None) -> dict:
     progress.section("chaos", chaos)
     progress.flush_compact()
 
+    # Resource-pressure leg right after the fault chaos leg (same pinned
+    # tiny-batched family): availability + preemption + KV-admission
+    # shedding under scripted block starvation, byte-identical preempt→
+    # replay, and the graceful-drain epilogue (ISSUE 5; BENCHMARKS.md r9
+    # "pressure leg" semantics).
+    if budget.allows(45):
+        try:
+            pressure = pressure_phase(beat=progress.beat)
+        except Exception as exc:          # never lose the headline line
+            pressure = {"error": str(exc)[:200]}
+    else:
+        pressure = {"skipped": budget.skip_stamp()}
+    progress.section("pressure", pressure)
+    progress.flush_compact()
+
     # Tier answer-quality asymmetry (VERDICT r3 missing #2): held-out
     # per-token loss / next-token accuracy per tier over the SAME token
     # stream (training/evaluate.py), next to measured serving cost per
@@ -1772,6 +1960,7 @@ def run(progress: "Progress" = None, budget: "Budget" = None) -> dict:
         "trend": trend,
         "trend_req_per_s": trend.get("trend_req_per_s"),
         "chaos": chaos,
+        "pressure": pressure,
         "mfu_prefill": utilization.get("prefill", {}).get("mfu"),
         "hbm_util_decode": utilization.get("decode", {}).get("hbm_util"),
         "utilization": utilization,

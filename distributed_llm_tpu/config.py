@@ -275,6 +275,39 @@ class TierConfig:
     # failover and the perf fail penalty fire instead of the queue
     # growing unboundedly.  None disables admission control.
     admission_max_queue: Optional[int] = 16
+    # KV-pressure-aware admission (serving/tiers.py): before admitting, the
+    # controller projects the request's block demand (prompt bucket +
+    # decode budget, in kv_block_size blocks) against the batched engine's
+    # BlockAllocator free count plus the reclaimable parked-prefix blocks,
+    # and rejects — reference error shape + retry_after_s — a request that
+    # must starve (a fixed HBM block pool admits by blocks, not by slots).
+    # Slot-only admission would let such a request in to wait forever.
+    # False disables the gate (slot/queue admission still applies); tiers
+    # on the sequential engine have no block pool and ignore it.
+    kv_admission: bool = True
+    # Paged KV pool size override, in blocks (engine/paged_kv.py).  None =
+    # full residency (decode_batch × blocks-per-slot: every slot can hold
+    # max_seq_len simultaneously — no pressure possible).  Smaller values
+    # model the real fixed-HBM-pool regime: admission gates on projected
+    # demand and the engine preempts+replays when a running slot cannot
+    # grow.  Must cover at least the largest prefill bucket plus one
+    # decode tick for a single slot (validated at engine build).
+    kv_pool_blocks: Optional[int] = None
+    # Context-overflow policy at the serving edge (serving/router.py): a
+    # prompt whose estimated token count exceeds max_seq_len -
+    # max_new_tokens either fails fast with the reference error shape
+    # ("reject") or drops oldest history turns until it fits
+    # ("truncate_left" — the default, matching the engine's silent tail-
+    # keeping truncation but surfaced in the response as
+    # overflow_truncated).  Applied for the dispatching tier before
+    # inference, so the choice is explicit policy, not engine behavior.
+    overflow_policy: str = "truncate_left"
+    # Graceful-drain deadline (engine/manager.py drain()): on SIGTERM /
+    # EngineManager.drain the tier stops admitting (reference error shape
+    # + retry_after_s; health reports draining), in-flight requests get
+    # this long to finish, then the engine stops — stragglers past the
+    # deadline fail with the engine-stopped error shape.
+    drain_timeout_s: float = 30.0
     # Orbax checkpoint directory to serve trained weights from; None =
     # deterministic random init (utils/checkpoint.py load_params_for_tier).
     checkpoint_path: Optional[str] = None

@@ -135,6 +135,20 @@ CONFIG_FIELDS: Dict[str, str] = {
     "TierConfig.admission_max_queue": "Max requests waiting beyond the "
                                       "slots before fail-fast; None "
                                       "disables admission control.",
+    "TierConfig.kv_admission": "Gate admission on projected KV block "
+                               "demand vs free + reclaimable parked "
+                               "blocks; False = slot/queue admission "
+                               "only.",
+    "TierConfig.kv_pool_blocks": "Paged KV pool size override in blocks; "
+                                 "None = full per-slot residency (no "
+                                 "pressure possible).",
+    "TierConfig.overflow_policy": "Over-length prompt policy at the "
+                                  "router: 'reject' fails fast, "
+                                  "'truncate_left' drops oldest turns "
+                                  "(surfaced in the response).",
+    "TierConfig.drain_timeout_s": "Graceful-drain deadline: in-flight "
+                                  "requests get this long to finish "
+                                  "after admission stops.",
     "TierConfig.checkpoint_path": "Orbax dir to serve trained weights "
                                   "from; None = deterministic random "
                                   "init.",

@@ -25,6 +25,7 @@ import dataclasses
 import queue
 import threading
 import time
+from collections import deque
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -36,6 +37,7 @@ from ..config import TierConfig
 from .. import models
 from ..models import transformer
 from ..obs import spans as obs_spans
+from ..serving.errors import error_dict
 from .inference import (GenerationResult, prepare_prompt, trim_at_eos,
                         upgrade_attention_impl)
 from .paged_kv import (BlockAllocator, PagedConfig, TRASH_BLOCK,
@@ -44,6 +46,18 @@ from .paged_kv import (BlockAllocator, PagedConfig, TRASH_BLOCK,
 from .tokenizer import get_tokenizer
 
 History = Union[str, Sequence[Dict[str, Any]]]
+
+
+class EngineStoppedError(RuntimeError):
+    """A request was failed by ``stop()`` (shutdown or drain deadline)
+    while in flight or queued.  Carries the reference error-dict shape in
+    ``.shape`` so serving layers (serving/tiers.py) forward the exact
+    schema-validated dict to clients instead of re-stringifying a bare
+    RuntimeError."""
+
+    def __init__(self, shape: Dict[str, Any]):
+        super().__init__(str(shape.get("error", "engine stopped")))
+        self.shape = dict(shape)
 
 
 def _sample_batched(logits: jax.Array, rng: jax.Array,
@@ -71,6 +85,18 @@ class _Request:
     # submit() because the scheduler thread has no request context of
     # its own.  None (direct engine use, tests) disables tracing.
     trace: Optional[Any] = None
+    # Mid-decode preemption state: on preemption the slot's generated
+    # tokens (already emitted to any stream) park here and the request
+    # re-queues at the scheduler head; re-admission replays prompt +
+    # prefix through prefill so greedy output is byte-identical
+    # (_admit_replay).  The original TTFT survives the round trip.
+    replay_tokens: Optional[List[int]] = None
+    replay_ttft_ms: Optional[float] = None
+    preempt_count: int = 0
+    # First-admission order (monotonic): the preemption victim policy
+    # picks the YOUNGEST slot, and a replayed request keeps its original
+    # age so it is not immediately re-victimized.
+    admit_seq: int = -1
 
 
 @dataclasses.dataclass
@@ -85,6 +111,9 @@ class _Slot:
     # Prompt token ids, kept so the slot's prompt blocks can be parked for
     # prefix reuse when it finishes (engine/prefix_cache.py).
     prompt_ids: tuple = ()
+    # Growth cap in pool blocks (prompt bucket + decode budget): blocks
+    # are materialized lazily as the sequence grows, never past this.
+    max_blocks: int = 0
 
 
 class ContinuousBatchingEngine:
@@ -122,8 +151,20 @@ class ContinuousBatchingEngine:
 
         self.paged = PagedConfig(block_size=tier.kv_block_size,
                                  max_slots=tier.decode_batch,
-                                 max_seq_len=self.cfg.max_seq_len)
+                                 max_seq_len=self.cfg.max_seq_len,
+                                 pool_blocks=tier.kv_pool_blocks)
         self.steps_per_tick = max(1, tier.decode_steps_per_tick)
+        if tier.kv_pool_blocks is not None:
+            # A constrained pool must still fit ONE largest-bucket prefill
+            # plus a decode tick, or no request could ever admit.
+            min_blocks = (max(b for b in tier.prefill_buckets
+                              if b <= self.cfg.max_seq_len)
+                          // tier.kv_block_size + 1)
+            if tier.kv_pool_blocks < min_blocks:
+                raise ValueError(
+                    f"kv_pool_blocks={tier.kv_pool_blocks} cannot fit one "
+                    f"largest-bucket prefill plus a decode tick (needs "
+                    f">= {min_blocks} blocks of {tier.kv_block_size})")
         if params is None and tier.checkpoint_path:
             # Published tier weights win over random init (mirrors
             # InferenceEngine; EngineManager also pre-loads for its tiers).
@@ -205,6 +246,15 @@ class ContinuousBatchingEngine:
             if tier.enable_prefix_cache and tier.prefix_cache_entries > 0
             else None)
         self._queue: "queue.Queue[_Request]" = queue.Queue()
+        # Scheduler-head requeue lane: KV-pressure deferrals and preempted
+        # requests go back to the FRONT (appendleft), so a starved elder
+        # re-admits before newer arrivals.  Only the scheduler thread pops
+        # (GIL-safe deque ops; stop() drains it after joining the loop).
+        self._head: "deque[_Request]" = deque()
+        self._admit_seq = 0
+        # Mid-decode preemptions performed over this engine's life (the
+        # chaos leg and tests read it; the obs counter mirrors it).
+        self.preempted_total = 0
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -376,6 +426,11 @@ class ContinuousBatchingEngine:
         budget = self.tier.max_new_tokens
         if req.max_new_tokens and req.max_new_tokens > 0:
             budget = min(budget, req.max_new_tokens)
+        if req.admit_seq < 0:
+            req.admit_seq = self._admit_seq
+            self._admit_seq += 1
+        if req.replay_tokens:
+            return self._admit_replay(req, slot_ix, ids, n, budget)
 
         bs = self.paged.block_size
         max_seq = self.cfg.max_seq_len
@@ -428,8 +483,17 @@ class ContinuousBatchingEngine:
                 self.allocator.free(owned)   # don't leak pool blocks
                 raise
             blocks = owned
+            max_blocks = len(owned)          # fully materialized: no growth
         else:
-            need = -(-min(bucket + budget, max_seq) // bs)
+            max_blocks = -(-min(bucket + budget, max_seq) // bs)
+            # Lazy growth: materialize only the prefill bucket plus one
+            # decode tick NOW; the scheduler's pre-tick ensure allocates
+            # the rest block-by-block as the sequence actually grows
+            # (preempting the youngest slot when the pool runs dry), so a
+            # fixed pool admits by real demand, not by worst case.
+            need = min(max_blocks,
+                       max(bucket // bs,
+                           -(-min(n + self.steps_per_tick, max_seq) // bs)))
             blocks = self._alloc_evicting(need)
             if blocks is None:
                 return False                 # KV pressure: stay queued
@@ -458,7 +522,7 @@ class ContinuousBatchingEngine:
 
         slot = _Slot(request=req, blocks=blocks, prompt_len=n, budget=budget,
                      temperature=temp, ttft_ms=ttft_ms, tokens=[first],
-                     prompt_ids=tuple(ids))
+                     prompt_ids=tuple(ids), max_blocks=max_blocks)
         obs_spans.add_token(req.trace)       # the prefill's primed token
         if req.token_queue is not None:
             req.token_queue.put(first)
@@ -470,6 +534,164 @@ class ContinuousBatchingEngine:
         if first == self.tokenizer.eos_id or slot.budget <= 1:
             self._finish(slot_ix)
         return True
+
+    def _admit_replay(self, req: _Request, slot_ix: int, ids: List[int],
+                      n: int, budget: int) -> bool:
+        """Re-admission of a preempted request: replay prompt + generated
+        prefix through ONE cold prefill (rebuilding KV for every position
+        already consumed), then resume decoding from the last generated
+        token.  Nothing is re-sampled or re-emitted — the prefix was
+        already streamed — so under greedy decoding the continuation is
+        byte-identical to an unpreempted run.  Returns False (stay at the
+        scheduler head) while the pool still cannot hold the replay."""
+        bs = self.paged.block_size
+        max_seq = self.cfg.max_seq_len
+        gen = list(req.replay_tokens)
+        seq = list(ids) + gen[:-1]           # everything whose KV we need
+        bucket = next((b for b in self._buckets if b >= len(seq)), None)
+        if bucket is None:
+            # No prefill bucket covers prompt+prefix (deep preemption on a
+            # short bucket ladder): finish with what was already emitted —
+            # the stream saw exactly these tokens, and a truncated tail
+            # beats silently divergent text from an approximate replay.
+            gen_ids = trim_at_eos(gen, self.tokenizer.eos_id,
+                                  self.tokenizer.pad_id)
+            with obs_spans.span(req.trace, "detokenize",
+                                tokens=len(gen_ids)):
+                text = self.tokenizer.decode(gen_ids)
+            req.result = GenerationResult(
+                text=text, token_ids=gen_ids, prompt_tokens=n,
+                gen_tokens=len(gen_ids),
+                ttft_ms=req.replay_ttft_ms or 0.0,
+                total_ms=(time.perf_counter() - req.t_submit) * 1000.0)
+            obs_spans.event(req.trace, "replay_truncated",
+                            generated=len(gen_ids))
+            if req.token_queue is not None:
+                req.token_queue.put(None)
+            req.done.set()
+            return True
+        max_blocks = -(-min(max(bucket, n + budget), max_seq) // bs)
+        need = min(max_blocks,
+                   max(bucket // bs,
+                       -(-min(len(seq) + self.steps_per_tick, max_seq)
+                         // bs)))
+        blocks = self._alloc_evicting(need)
+        if blocks is None:
+            return False                     # still starved: stay at head
+        self._rng, rng = jax.random.split(self._rng)
+        temp = (self.tier.temperature if req.temperature is None
+                else req.temperature)
+        try:
+            tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
+            tokens[0, :len(seq)] = seq
+            with obs_spans.span(req.trace, "prefill", bucket=bucket,
+                                replayed_tokens=len(gen)), \
+                    self.phases.phase("prefill"):
+                first, k_all, v_all = self._prefill_fn(bucket)(
+                    self.params, jnp.asarray(tokens),
+                    jnp.asarray([len(seq)], np.int32), rng,
+                    jnp.float32(temp))
+                nb_prefill = bucket // bs
+                self.pool = self._writer_fn(nb_prefill)(
+                    self.pool, jnp.asarray(blocks[:nb_prefill], np.int32),
+                    k_all, v_all)
+                # The replay's sampled token is discarded: the last
+                # generated token was already emitted pre-preemption and
+                # decoding resumes FROM it, not after a fresh sample.
+                jax.block_until_ready(first)
+            from ..utils import roofline
+            self.phases.add_work("prefill", **roofline.prefill_work(
+                self.cfg, bucket, 0, wbytes=self._wbytes))
+        except BaseException:
+            self.allocator.free(blocks)      # don't leak pool blocks
+            raise
+        slot = _Slot(request=req, blocks=blocks, prompt_len=n,
+                     budget=budget, temperature=temp,
+                     ttft_ms=req.replay_ttft_ms or 0.0, tokens=gen,
+                     prompt_ids=tuple(ids), max_blocks=max_blocks)
+        req.replay_tokens = None
+        self._slots[slot_ix] = slot
+        self._tables[slot_ix] = self._table_row(blocks)
+        self._pos[slot_ix] = len(seq)        # the current token's position
+        self._cur[slot_ix] = gen[-1]
+        self._temps[slot_ix] = temp
+        obs_spans.event(req.trace, "replay", replayed_tokens=len(seq),
+                        generated=len(gen))
+        if (gen[-1] in (self.tokenizer.eos_id, self.tokenizer.pad_id)
+                or len(gen) >= budget):
+            self._finish(slot_ix)            # was already done (paranoia)
+        return True
+
+    def _preempt(self, slot_ix: int) -> None:
+        """Evict a RUNNING slot under block starvation: free its blocks,
+        park its generated tokens on the request, and re-queue it at the
+        scheduler head.  Its caller/stream sees a stall — no sentinel, no
+        error — and _admit_replay later resumes it byte-identically."""
+        slot = self._slots[slot_ix]
+        req = slot.request
+        req.replay_tokens = list(slot.tokens)
+        req.replay_ttft_ms = slot.ttft_ms
+        req.preempt_count += 1
+        self.preempted_total += 1
+        obs_spans.event(req.trace, "preempt", tier=self.tier.name,
+                        generated=len(slot.tokens),
+                        freed_blocks=len(slot.blocks))
+        try:
+            # No injection path on the engine (same pattern as the
+            # manager's wedge counter): the process-global registry.
+            from ..obs import get_observability
+            get_observability().m.preemptions.labels(self.tier.name).inc()
+        except Exception:
+            pass
+        self._release(slot_ix)               # free ALL blocks, no parking
+        self._head.appendleft(req)
+
+    def _ensure_growth(self, active: List[int]) -> None:
+        """Pre-tick lazy KV growth: every active slot's table must cover
+        the positions this tick will write (bounded by the slot's own
+        budget).  When the pool runs dry — even after evicting parked
+        prefixes — the YOUNGEST slot is preempted: freed blocks un-starve
+        the elders, and the victim replays on re-admission."""
+        bs = self.paged.block_size
+        for ix in active:
+            slot = self._slots[ix]
+            if slot is None:
+                continue                     # preempted earlier this pass
+            end = min(int(self._pos[ix]) + self.steps_per_tick,
+                      slot.prompt_len + slot.budget,
+                      self.cfg.max_seq_len)
+            need = min(slot.max_blocks, -(-end // bs))
+            while len(slot.blocks) < need:
+                extra = self._alloc_evicting(need - len(slot.blocks))
+                if extra is not None:
+                    slot.blocks.extend(extra)
+                    self._tables[ix] = self._table_row(slot.blocks)
+                    break
+                victims = [j for j in active if self._slots[j] is not None]
+                if victims == [ix]:
+                    # Sole occupant of a pool that cannot hold its next
+                    # block: preempting itself would replay straight into
+                    # the same wall (livelock).  Cap the generation here —
+                    # a short answer beats no answer.
+                    obs_spans.event(slot.request.trace, "kv_truncated",
+                                    generated=len(slot.tokens))
+                    self._finish(ix)
+                    break
+                victim = max(victims,
+                             key=lambda j: self._slots[j].request.admit_seq)
+                self._preempt(victim)
+                if victim == ix:
+                    break                    # the grower itself yielded
+
+    def _next_request(self) -> Optional[_Request]:
+        """Head lane (KV-pressure deferrals, preempted replays) first,
+        then the submission queue."""
+        if self._head:
+            return self._head.popleft()
+        try:
+            return self._queue.get_nowait()
+        except queue.Empty:
+            return None
 
     def _finish(self, slot_ix: int) -> None:
         slot = self._slots[slot_ix]
@@ -525,13 +747,14 @@ class ContinuousBatchingEngine:
             for ix in range(self.paged.max_slots):
                 if self._slots[ix] is not None:
                     continue
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
+                req = self._next_request()
+                if req is None:
                     break
                 try:
                     if not self._admit(req, ix):
-                        self._queue.put(req)     # no KV blocks yet
+                        # No KV blocks yet: back to the scheduler HEAD so
+                        # the starved elder re-admits before newer work.
+                        self._head.appendleft(req)
                         break
                     admitted_any = True
                     self._progress_t = time.monotonic()
@@ -542,6 +765,13 @@ class ContinuousBatchingEngine:
                     req.done.set()
 
             active = [ix for ix, s in enumerate(self._slots) if s is not None]
+            if active:
+                # Lazy KV growth (+ preemption under starvation) BEFORE
+                # the tick: every surviving slot's table covers the
+                # positions this tick writes.
+                self._ensure_growth(active)
+                active = [ix for ix, s in enumerate(self._slots)
+                          if s is not None]
             if not active:
                 if not admitted_any:
                     # Idle is trivially "progressing": the watchdog only
@@ -640,16 +870,20 @@ class ContinuousBatchingEngine:
                 self._wake.set()
                 self._thread.join(timeout=5)
                 self._thread = None
-            shutdown = RuntimeError(f"tier {self.tier.name}: engine stopped")
+            # Error-SHAPED shutdown (serving/errors.py): TierClient
+            # forwards ``.shape`` verbatim, so clients see the validated
+            # reference dict, never a stringified bare RuntimeError.
+            shutdown = EngineStoppedError(error_dict(
+                f"Request failed: tier {self.tier.name} engine stopped "
+                f"mid-flight"))
             if self.prefix_cache is not None:
                 self.prefix_cache.clear()    # parked blocks → free list
             for ix, slot in enumerate(self._slots):
                 if slot is not None:
                     self._fail_slot(ix, shutdown)
             while True:
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
+                req = self._next_request()   # head lane + queue
+                if req is None:
                     break
                 req.error = shutdown
                 if req.token_queue is not None:
@@ -710,8 +944,60 @@ class ContinuousBatchingEngine:
         return StreamHandle(deltas(), req)
 
     def queue_depth(self) -> int:
-        """Requests submitted but not yet admitted to a batch slot."""
-        return self._queue.qsize()
+        """Requests submitted but not yet admitted to a batch slot
+        (including KV-pressure deferrals and preempted replays waiting in
+        the head lane)."""
+        return self._queue.qsize() + len(self._head)
+
+    def pending_work(self) -> int:
+        """Queued + requeued + active requests — the drain loop's
+        completion signal (engine/manager.py drain())."""
+        return (self.queue_depth()
+                + sum(1 for s in self._slots if s is not None))
+
+    # -- KV pressure surface (serving/tiers.py admission gate) -------------
+
+    def kv_stats(self) -> Dict[str, int]:
+        """Block-pool pressure snapshot for KV-aware admission: free
+        blocks, blocks reclaimable by evicting parked prefix entries, and
+        pool geometry.  Advisory reads — the allocator and prefix store
+        guard their own state."""
+        reclaimable = (self.prefix_cache.reclaimable_blocks()
+                       if self.prefix_cache is not None else 0)
+        return {
+            "free_blocks": self.allocator.available,
+            "reclaimable_blocks": reclaimable,
+            "block_size": self.paged.block_size,
+            "total_blocks": self.paged.num_blocks - 1,   # minus trash
+            "preempted_total": self.preempted_total,
+        }
+
+    def max_demand_blocks(self) -> int:
+        """Worst-case per-request demand (largest prefill bucket + full
+        decode budget), tokenization-free: when free+reclaimable covers
+        this, the admission gate cannot fire and the serving thread skips
+        the per-request prompt tokenization entirely."""
+        bucket = max(self._buckets) if self._buckets else \
+            self.cfg.max_seq_len
+        return -(-min(bucket + self.tier.max_new_tokens,
+                      self.cfg.max_seq_len) // self.paged.block_size)
+
+    def projected_demand_blocks(self, history: History,
+                                max_new_tokens: Optional[int] = None
+                                ) -> int:
+        """Pool blocks this request needs at FULL decode budget (prompt
+        bucket + decode cap) — the demand side of the admission gate.
+        Tokenizes the history with the same prepare_prompt as _admit;
+        runs on the serving thread, before submit."""
+        _, bucket = prepare_prompt(self.tokenizer, history,
+                                   self.tier.prefill_buckets,
+                                   self.cfg.max_seq_len,
+                                   self.tier.max_new_tokens)
+        budget = self.tier.max_new_tokens
+        if max_new_tokens and max_new_tokens > 0:
+            budget = min(budget, max_new_tokens)
+        return -(-min(bucket + budget, self.cfg.max_seq_len)
+                 // self.paged.block_size)
 
     def progress_stall_s(self) -> float:
         """Seconds since the scheduler last completed a unit of progress
@@ -723,7 +1009,7 @@ class ContinuousBatchingEngine:
         see from outside."""
         if self._thread is None:
             return 0.0
-        has_work = (self._queue.qsize() > 0
+        has_work = (self.queue_depth() > 0
                     or any(s is not None for s in self._slots))
         if not has_work:
             return 0.0
@@ -739,10 +1025,11 @@ class ContinuousBatchingEngine:
         active = sum(1 for s in self._slots if s is not None)
         total = self.paged.max_slots
         return {
-            "queue_depth": self._queue.qsize(),
+            "queue_depth": self.queue_depth(),
             "active_slots": active,
             "max_slots": total,
             "slot_occupancy": round(active / max(1, total), 3),
+            "preempted_total": self.preempted_total,
         }
 
     def prefix_affinity(self, history) -> int:

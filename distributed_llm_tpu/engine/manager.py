@@ -44,6 +44,11 @@ class EngineManager:
         self._engine: Optional[InferenceEngine] = None
         self._lock = threading.RLock()
         self._started_at: Optional[float] = None
+        # Graceful drain (drain()): True from drain start until the next
+        # start_server.  Single-word flag read lock-free by health() and
+        # the admission gate; a draining tier is INTENTIONALLY shedding —
+        # HealthMonitor and the breaker must not treat it as failure.
+        self._draining = False
         # Watchdog-wedge edge detector: health() counts CLOSED→WEDGED
         # transitions (not every probe of a wedged engine) into the
         # global registry's dllm_watchdog_wedged_total.  Own lock: the
@@ -71,6 +76,14 @@ class EngineManager:
         with self._lock:
             if self._engine is not None:
                 return
+            # A restart re-opens a drained tier for traffic.
+            self._draining = False
+            admission = getattr(self, "admission", None)
+            if admission is not None:
+                try:
+                    admission.end_drain()
+                except Exception:
+                    pass                     # stub controllers in tests
             t0 = time.perf_counter()
             params = None
             if self.tier.checkpoint_path:
@@ -146,6 +159,78 @@ class EngineManager:
             with self._wedged_lock:
                 self._wedged_seen = False
 
+    def drain(self, timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """Graceful shutdown: stop admitting (the tier's admission gate
+        rejects with the reference error shape + ``retry_after_s``;
+        ``health()`` reports ``draining``), give in-flight requests up to
+        ``timeout_s`` (default ``tier.drain_timeout_s``) to finish, then
+        stop the engine — stragglers past the deadline fail with the
+        engine-stopped error shape.
+
+        MUST NOT be called under the lifecycle lock: it blocks for up to
+        the deadline and then calls ``stop_server`` (which takes that
+        lock) — the ``locks`` lint names ``drain`` a blocking call so the
+        inversion can't be reintroduced.  Idempotent; returns a summary
+        {draining_started, in_flight_at_start, drained, aborted,
+        waited_s}."""
+        timeout = (timeout_s if timeout_s is not None
+                   else self.tier.drain_timeout_s)
+        self._draining = True
+        admission = getattr(self, "admission", None)
+        if admission is not None:
+            try:
+                admission.start_drain(retry_after_s=timeout)
+            except Exception:
+                pass                         # stub controllers in tests
+        t0 = time.monotonic()
+        deadline = t0 + max(0.0, float(timeout))
+
+        def in_flight() -> int:
+            n = 0
+            if admission is not None:
+                try:
+                    n = int(admission.snapshot().get("inflight", 0))
+                except Exception:
+                    n = 0
+            engine = self._engine
+            pending = getattr(engine, "pending_work", None)
+            if callable(pending):
+                try:
+                    # The scheduler's view is sharper than admission's
+                    # (it also counts directly-submitted work).
+                    n = max(n, int(pending()))
+                except Exception:
+                    pass
+            return n
+
+        started = in_flight()
+        while time.monotonic() < deadline and in_flight() > 0:
+            time.sleep(0.02)
+        leftover = in_flight()
+        self.stop_server()
+        drained = max(0, started - leftover)
+        if drained:
+            try:
+                from ..obs import get_observability
+                get_observability().m.drained_requests.labels(
+                    self.tier.name).inc(drained)
+            except Exception:
+                pass
+        if leftover:
+            logger.warning("tier %s drain deadline (%.1fs) passed with %d "
+                           "request(s) still in flight — stopped",
+                           self.tier.name, timeout, leftover)
+        else:
+            logger.info("tier %s drained %d in-flight request(s) in %.2fs",
+                        self.tier.name, drained, time.monotonic() - t0)
+        return {"draining_started": True, "in_flight_at_start": started,
+                "drained": drained, "aborted": leftover,
+                "waited_s": round(time.monotonic() - t0, 3)}
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
     def is_server_running(self) -> bool:
         """LOCK-FREE: a single GIL-atomic attribute read.  Taking the
         lifecycle lock here would block every health probe through a
@@ -194,6 +279,9 @@ class EngineManager:
         running = engine is not None
         entry: Dict[str, Any] = {
             "ok": running,
+            # Intentional shutdown in progress (or completed): probes and
+            # the HealthMonitor must read this as policy, never failure.
+            "draining": self._draining,
             "tier": self.tier.name,
             "model": self.tier.model_preset,
             "uptime_s": ((time.time() - started_at)

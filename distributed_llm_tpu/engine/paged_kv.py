@@ -55,6 +55,10 @@ class PagedConfig:
     block_size: int = 64
     max_slots: int = 4
     max_seq_len: int = 2048
+    # Pool size override (TierConfig.kv_pool_blocks): a pool smaller than
+    # full residency is the regime where KV-aware admission and
+    # preemption-with-replay (engine/batching.py) actually bind.
+    pool_blocks: Optional[int] = None
 
     @property
     def blocks_per_slot(self) -> int:
@@ -62,6 +66,9 @@ class PagedConfig:
 
     @property
     def num_blocks(self) -> int:
+        if self.pool_blocks is not None:
+            # Explicit pool budget, plus the reserved trash block.
+            return self.pool_blocks + 1
         # Full residency for every slot, plus the reserved trash block.
         return self.max_slots * self.blocks_per_slot + 1
 

@@ -225,6 +225,20 @@ class PrefixCache:
             self.on_evict(entry)
         return entry
 
+    def reclaimable_blocks(self) -> int:
+        """Total pool blocks held by parked entries — the eviction
+        headroom KV-aware admission (serving/tiers.py) may promise.
+        Paged engines park ``{"blocks": [...]}`` caches; the contiguous
+        engine's HBM-array entries hold no pool blocks and count 0."""
+        with self._lock:
+            total = 0
+            for e in self._entries:
+                blocks = (e.cache.get("blocks")
+                          if isinstance(e.cache, dict) else None)
+                if blocks:
+                    total += len(blocks)
+            return total
+
     def stats(self) -> dict:
         with self._lock:
             return {

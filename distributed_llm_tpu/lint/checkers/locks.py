@@ -45,6 +45,9 @@ BLOCKING_NAMES = {
     "generate", "generate_stream",   # engine device calls (minutes on a
     "warmup",                        # wedged chip)
     "start_server", "stop_server",   # lifecycle: build + compile + warm
+    "drain",                         # graceful drain: waits out in-flight
+                                     # work, then calls stop_server — under
+                                     # the lifecycle lock it deadlocks
     "load_params_for_tier",          # checkpoint restore
     "urlopen", "getresponse", "recv", "accept",   # socket/HTTP
 }

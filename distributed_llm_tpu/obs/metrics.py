@@ -317,6 +317,24 @@ class ServingMetrics:
             "dllm_flight_records_total",
             "Flight-recorder captures by reason (error|degraded|slow)",
             ("reason",))
+        # Resource-pressure family (PR 5): KV-aware admission, mid-decode
+        # preemption with replay, context-overflow policy, graceful drain.
+        self.preemptions = registry.counter(
+            "dllm_preemptions_total",
+            "Mid-decode slot preemptions under KV block starvation "
+            "(victim replays byte-identically on re-admission)", ("tier",))
+        self.kv_admission_rejected = registry.counter(
+            "dllm_kv_admission_rejected_total",
+            "Requests shed because projected KV block demand exceeded "
+            "free + reclaimable pool blocks", ("tier",))
+        self.overflow = registry.counter(
+            "dllm_overflow_total",
+            "Context-overflow policy applications at the router, by tier "
+            "and action (rejected|truncated)", ("tier", "action"))
+        self.drained_requests = registry.counter(
+            "dllm_drained_requests_total",
+            "In-flight requests completed during a graceful drain",
+            ("tier",))
 
 
 _BREAKER_STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}

@@ -35,6 +35,12 @@ logger = logging.getLogger(__name__)
 
 HISTORY_LIMIT = 10
 
+# Input-hardening cap on one /chat message, in characters.  Far above any
+# real prompt (every tier's context truncates earlier — overflow_policy /
+# prepare_prompt), low enough that a hostile body can't make the session
+# store or the tokenizer chew megabytes before the edge says no.
+MAX_MESSAGE_CHARS = 65536
+
 # Same defaults the reference app passes (src/app.py:9-14).
 BASE_CONFIG: Dict[str, Any] = {
     "cache_enabled": True,
@@ -107,20 +113,54 @@ def create_app(router: Optional[Router] = None,
                 "tokens": 0,
             }), 500
 
+    def _bad_request(msg: str):
+        """One 400 shape for every input-hardening rejection (reference
+        error dict, like the original missing-message branch)."""
+        return ((jsonify({"error": msg}), 400),
+                None, None, None, None, None)
+
     def _begin_chat_turn():
-        """Shared /chat + /chat/stream front half: parse the request,
-        hot-swap the strategy, append the user turn.  Returns
+        """Shared /chat + /chat/stream front half: parse AND VALIDATE the
+        request, hot-swap the strategy, append the user turn.  Returns
         (error_response | None, user_input, requested, session_id,
-        history, snapshot)."""
-        data = request.get_json(silent=True) or {}
+        history, snapshot).
+
+        Input hardening: bad JSON / non-object bodies, non-string or
+        oversized messages, and non-string strategy/session_id are all
+        400 with the reference error shape — before this, only a missing
+        message was caught and a non-string one crashed downstream in
+        the tokenizer."""
+        if getattr(state["router"], "draining", False):
+            # Graceful drain: the edge stops admitting FIRST.  503 + the
+            # sanctioned retry hint; in-flight requests keep finishing.
+            return ((jsonify({
+                "error": "Request failed: server is draining "
+                         "(graceful shutdown in progress)",
+                "retry_after_s": state["router"].drain_retry_after_s(),
+            }), 503), None, None, None, None, None)
+        data = request.get_json(silent=True)
+        if data is None:
+            return _bad_request("Request failed: body must be valid JSON")
+        if not isinstance(data, dict):
+            return _bad_request("Request failed: body must be a JSON "
+                                "object")
         user_input = data.get("message", "")
         requested = data.get("strategy", "hybrid")
         session_id = data.get("session_id", "default")
+        if not isinstance(user_input, str):
+            return _bad_request("Request failed: 'message' must be a "
+                                "string")
+        if len(user_input) > MAX_MESSAGE_CHARS:
+            return _bad_request(f"Request failed: 'message' exceeds "
+                                f"{MAX_MESSAGE_CHARS} characters")
+        if not isinstance(requested, str) or not isinstance(session_id,
+                                                            str):
+            return _bad_request("Request failed: 'strategy' and "
+                                "'session_id' must be strings")
         if requested == "token-counting":   # UI dropdown name
             requested = "token"
         if not user_input.strip():
-            return ((jsonify({"error": "No message provided"}), 400),
-                    None, None, None, None, None)
+            return _bad_request("No message provided")
         with state_lock:
             if requested != state["strategy"]:
                 logger.info("Switching strategy: %s -> %s",
@@ -234,6 +274,29 @@ def create_app(router: Optional[Router] = None,
     for route in ui_files:
         app.route(route, methods=["GET"])(_make_ui_view(route))
 
+    @app.route("/health", methods=["GET"])
+    def health():
+        """Process-level liveness for load balancers and drain
+        orchestration: ``status`` is ``draining`` (503) once a graceful
+        drain started, else ``ok``.  Per-tier snapshots ride along —
+        manager.health() is lock-free, so this never blocks behind a
+        mid-compile lifecycle lock."""
+        router_ = state["router"]
+        draining = bool(getattr(router_, "draining", False))
+        tiers = {}
+        for name, tier in router_.tiers.items():
+            try:
+                tiers[name] = tier.server_manager.health()
+            except Exception as exc:
+                tiers[name] = {"ok": False, "detail": str(exc)[:200]}
+        payload = {"status": "draining" if draining else "ok",
+                   "draining": draining,
+                   "tiers": tiers}
+        if draining:
+            payload["retry_after_s"] = router_.drain_retry_after_s()
+            return jsonify(payload), 503
+        return jsonify(payload)
+
     @app.route("/metrics", methods=["GET"])
     def metrics():
         """Prometheus text exposition of the serving metric registry
@@ -338,9 +401,35 @@ def create_app(router: Optional[Router] = None,
     return app
 
 
+def install_drain_handler(router: Router, exit_after: bool = True) -> bool:
+    """SIGTERM → graceful drain (shared by the API server and the CLI):
+    stop admitting (the edge 503s, /health flips to ``draining``), let
+    in-flight requests finish under each tier's ``drain_timeout_s``, stop
+    the engines, then exit.  Returns False when no handler could be
+    installed (non-main thread — e.g. an app built inside a test
+    worker)."""
+    import signal
+
+    def _on_sigterm(signum, frame):
+        logger.warning("SIGTERM: draining before exit")
+        try:
+            router.drain()
+        finally:
+            if exit_after:
+                raise SystemExit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+        return True
+    except ValueError:            # not the main thread: caller's problem
+        return False
+
+
 def main() -> None:
     logging.basicConfig(level=logging.INFO)
-    app = create_app()
+    router = Router(strategy="hybrid", config=dict(BASE_CONFIG))
+    app = create_app(router=router)
+    install_drain_handler(router)
     print("🚀 API running on http://0.0.0.0:8000")
     app.run(host="0.0.0.0", port=8000, threaded=True)
 

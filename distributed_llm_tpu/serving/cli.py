@@ -31,7 +31,14 @@ class Chatbot:
         self.add_message("assistant", reply)
         return f"[{device}] {reply}"
 
-    def shutdown(self) -> None:
+    def shutdown(self, graceful: bool = True) -> None:
+        """Stop both tier engines.  ``graceful`` drains first (stop
+        admitting, finish in-flight work under drain_timeout_s) — the
+        SIGTERM path and the REPL exit both use it; False keeps the old
+        immediate stop for callers that know nothing is in flight."""
+        if graceful and callable(getattr(self.router, "drain", None)):
+            self.router.drain()
+            return
         self.router.nano.server_manager.stop_server()
         self.router.orin.server_manager.stop_server()
 
@@ -52,7 +59,12 @@ class Chatbot:
 
 def main() -> None:
     logging.basicConfig(level=logging.WARNING)
-    Chatbot(strategy="semantic", config=dict(PRODUCTION_CFG)).chat()
+    bot = Chatbot(strategy="semantic", config=dict(PRODUCTION_CFG))
+    # SIGTERM mid-conversation drains in-flight work before exit, same
+    # contract as the API server (serving/app.py install_drain_handler).
+    from .app import install_drain_handler
+    install_drain_handler(bot.router)
+    bot.chat()
 
 
 if __name__ == "__main__":

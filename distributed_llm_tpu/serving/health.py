@@ -87,6 +87,11 @@ class HealthMonitor:
             health = mgr.health()
         except Exception as exc:
             return "failed", {"ok": False, "error": str(exc)}  # dllm-lint: disable=error-shape -- health-probe snapshot (GET /health surface: ok+error), not the tier error path
+        if health.get("draining"):
+            # Graceful drain (EngineManager.drain) is INTENTIONAL
+            # shedding: never a failure, never restarted — a restart
+            # would resurrect a tier the operator is taking down.
+            return "draining", health
         if not running:
             # A DEAD remote is classified failed above (health() raises
             # into the except).  This branch covers the remote that still
@@ -156,7 +161,9 @@ class HealthMonitor:
             # probe of an OPEN tier past its cooldown advances the
             # breaker to half-open, so recovery doesn't need a client
             # request to discover the cooldown expired.
-            if breaker is not None:
+            if breaker is not None and state != "draining":
+                # Draining is intentional: feeding it to the breaker as
+                # either verdict would misrepresent deliberate shedding.
                 try:
                     breaker.note_probe(name, state == "running")
                 except Exception:

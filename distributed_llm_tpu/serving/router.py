@@ -24,6 +24,7 @@ from __future__ import annotations
 import hashlib
 import logging
 import random
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -150,6 +151,10 @@ class Router:
         self.retry_backoff_s = float(getattr(self.cluster,
                                              "retry_backoff_s", 0.05))
         self.degraded_served = 0       # both-tiers-open responses served
+        # Graceful drain (drain()): once True the serving edge
+        # (serving/app.py) answers 503 + retry_after_s and no new request
+        # enters the pipeline; in-flight requests finish normally.
+        self.draining = False
 
         self.enable_response_cache = (
             not benchmark_mode
@@ -187,6 +192,67 @@ class Router:
 
     def set_threshold(self, threshold: int) -> None:
         self.threshold_fallback = threshold
+
+    # -- graceful drain ----------------------------------------------------
+
+    def drain_retry_after_s(self) -> float:
+        """Client retry hint while draining: the longest tier drain
+        deadline (past it the process is gone or restarted)."""
+        vals = []
+        for tier in self.tiers.values():
+            cfg = getattr(tier, "tier", None)
+            val = getattr(cfg, "drain_timeout_s", None)
+            if val:
+                vals.append(float(val))
+        return round(max(vals), 2) if vals else 30.0
+
+    def drain(self, timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """Graceful shutdown of the whole router (SIGTERM path): flip the
+        serving edge to 503 (serving/app.py checks ``draining``), stop
+        the health monitor (a drain must not race an auto-restart), then
+        drain every tier concurrently — each stops admitting, lets its
+        in-flight requests finish under ``drain_timeout_s``, and stops.
+        Idempotent; returns the per-tier drain summaries."""
+        self.draining = True
+        if self.health_monitor is not None:
+            try:
+                self.health_monitor.stop()
+            except Exception:
+                pass
+        results: Dict[str, Any] = {}
+        cap = (timeout_s if timeout_s is not None
+               else self.drain_retry_after_s()) + 30.0
+        threads = []
+        for name, tier in self.tiers.items():
+            fn = getattr(tier.server_manager, "drain", None)
+            if not callable(fn):
+                # Managers without a drain (remote tiers) still get
+                # STOPPED: the pre-drain shutdown path killed their
+                # spawned processes, and graceful must not leak them.
+                fn, label = tier.server_manager.stop_server, "stopped"
+            else:
+                label = None
+
+            def _drain(name=name, fn=fn, label=label):
+                try:
+                    out = fn() if label else fn(timeout_s)
+                    results[name] = (out if label is None
+                                     else {"draining_started": False,
+                                           label: True})
+                except Exception as exc:
+                    results[name] = {"error": f"Request failed: {exc}"}
+
+            t = threading.Thread(target=_drain, daemon=True,
+                                 name=f"drain-{name}")
+            threads.append(t)
+            t.start()
+        deadline = time.monotonic() + cap
+        for t in threads:
+            # Bounded even against a wedged stop_server: the process is
+            # exiting, and a hung drain must not block the signal path.
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        logger.info("router drain complete: %s", results)
+        return results
 
     # -- observability plumbing (obs/) -------------------------------------
 
@@ -415,6 +481,69 @@ class Router:
         return (isinstance(raw, dict)
                 and "admission rejected" in str(raw.get("error", "")))
 
+    def _note_admission_rejection(self, raw: Any, which: str) -> None:
+        """Admission-rejection metrics: every rejection counts, and the
+        KV-pressure subset gets its own counter (the signal the pressure
+        chaos leg and dashboards key on)."""
+        if not self._is_admission_rejection(raw):
+            return
+        self.obs.m.admission_rejected.labels(which).inc()
+        if "KV demand" in str(raw.get("error", "")):
+            self.obs.m.kv_admission_rejected.labels(which).inc()
+
+    # -- context-overflow policy (serving edge) ----------------------------
+
+    def _apply_overflow_policy(self, device: str,
+                               history: List[Dict[str, Any]]
+                               ) -> Tuple[List[Dict[str, Any]],
+                                          Optional[Dict[str, Any]], int]:
+        """Per-tier policy for prompts exceeding ``max_seq_len -
+        max_new_tokens`` (estimated with the router's token counter):
+        ``reject`` fails fast with the reference error shape naming the
+        policy; ``truncate_left`` (default) drops oldest turns until the
+        estimate fits — the engine would silently keep the tail anyway
+        (prepare_prompt), so this makes the choice explicit serving
+        policy and surfaces it in the response.  The final (newest)
+        message always survives.  Returns (history, error_raw | None,
+        dropped_messages)."""
+        tier = self.tiers.get(device)
+        cfg = getattr(tier, "tier", None)
+        if cfg is None or not isinstance(history, list):
+            return history, None, 0
+        try:
+            limit = max(1, cfg.model().max_seq_len - cfg.max_new_tokens)
+        except Exception:
+            return history, None, 0
+        est = self.token_counter.get_context_size(history)
+        if est <= limit:
+            return history, None, 0
+        policy = getattr(cfg, "overflow_policy", "truncate_left")
+        if policy == "reject":
+            self.obs.m.overflow.labels(device, "rejected").inc()
+            obs_spans.event(current_trace(), "overflow_rejected",
+                            tier=device, est_tokens=est, limit=limit)
+            logger.warning("%s: prompt ~%d tokens over the %d-token "
+                           "context budget — overflow_policy=reject",
+                           device, est, limit)
+            return history, {"error": (
+                f"Request failed: prompt of ~{est} tokens exceeds "
+                f"{device}'s context budget of {limit} tokens "
+                f"(max_seq_len - decode budget; "
+                f"overflow_policy=reject)")}, 0
+        trimmed = list(history)
+        dropped = 0
+        while len(trimmed) > 1 and est > limit:
+            dropped += 1
+            est -= self.token_counter.count_tokens(trimmed.pop(0))
+        self.obs.m.overflow.labels(device, "truncated").inc()
+        obs_spans.event(current_trace(), "overflow_truncated",
+                        tier=device, dropped_messages=dropped,
+                        est_tokens=est, limit=limit)
+        logger.info("%s: dropped %d oldest turn(s) to fit the %d-token "
+                    "context budget (overflow_policy=truncate_left)",
+                    device, dropped, limit)
+        return trimmed, None, dropped
+
     def _breaker_record(self, device: str, ok: bool,
                         raw: Any = None) -> None:
         """Feed a dispatch outcome to the breaker.  Admission rejections
@@ -454,8 +583,7 @@ class Router:
         t0 = time.perf_counter()
         with obs_spans.span(current_trace(), "dispatch", tier=tier.name):
             raw = tier.process(history)
-        if self._is_admission_rejection(raw):
-            self.obs.m.admission_rejected.labels(tier.name).inc()
+        self._note_admission_rejection(raw, tier.name)
         return raw, tier.name, (time.perf_counter() - t0) * 1000.0
 
     def _run_device_retrying(self, device: str, history: List[Dict[str, Any]],
@@ -713,6 +841,29 @@ class Router:
                                                confidence, overhead_ms,
                                                device)
 
+        # 1.8) context-overflow policy for the dispatching tier: an over-
+        # budget prompt either fails fast here (policy "reject") or loses
+        # its oldest turns ("truncate_left"), with the choice surfaced.
+        history, overflow_err, overflow_dropped = \
+            self._apply_overflow_policy(device, history)
+        if overflow_err is not None:
+            text = self._extract_text(overflow_err) or "No response available"
+            tokens = self.token_counter.count_tokens(
+                {"role": "assistant", "content": text})
+            return {
+                "response": text,
+                "raw": overflow_err,
+                "cache_hit": False,
+                "benchmark_mode": self.benchmark_mode,
+                "routing_overhead_ms": round(overhead_ms, 2),
+                "routing_method": f"{method}+overflow_reject",
+                "routing_confidence": round(confidence, 4),
+                "routing_reasoning": (f"prompt exceeds {device}'s context "
+                                      f"budget (overflow_policy=reject); "
+                                      f"{reasoning}"),
+                "ok": False,
+            }, tokens, device
+
         # 2) inference + bounded transient retry + failover.  The retry
         # layer is budgeted against the primary tier's request_timeout_s
         # from dispatch start (retries never extend the reference cap).
@@ -778,7 +929,7 @@ class Router:
                 "routing_confidence": round(confidence, 4),
             }
 
-        return {
+        out = {
             "response": text,
             "raw": raw,
             "cache_hit": False,
@@ -788,7 +939,13 @@ class Router:
             "routing_confidence": round(confidence, 4),
             "routing_reasoning": reasoning,
             "ok": ok,
-        }, tokens, which
+        }
+        if overflow_dropped:
+            # Surface the truncate_left choice (additive keys, like the
+            # per-request timing fields).
+            out["overflow_truncated"] = True
+            out["overflow_dropped_messages"] = overflow_dropped
+        return out, tokens, which
 
     def route_query_stream(self, history: List[Dict[str, Any]]
                            ) -> "RoutedStream":
@@ -842,6 +999,14 @@ class Router:
                     "Request failed: all tiers unavailable (circuit "
                     f"open); retry in {self.breaker.retry_after_s():.1f}s")
 
+        # Context-overflow policy, mirroring the sync path: reject raises
+        # (the SSE layer splices the error tail), truncate_left trims and
+        # flags the meta.
+        history, overflow_err, overflow_dropped = \
+            self._apply_overflow_policy(device, history)
+        if overflow_err is not None:
+            raise RuntimeError(overflow_err["error"])
+
         t0 = time.perf_counter()
         tier = self.tiers.get(device, self.nano)
         # Stream setup primes the first token (prefill runs inside), so
@@ -849,8 +1014,7 @@ class Router:
         with trace.span("stream_setup", tier=tier.name):
             handle = tier.process_stream(history)
         which = tier.name
-        if self._is_admission_rejection(handle):
-            self.obs.m.admission_rejected.labels(which).inc()
+        self._note_admission_rejection(handle, which)
         self._breaker_record_stream_setup(which, handle)
         if self._is_error(handle) and self.enable_failover:
             other = self._other(which)
@@ -869,8 +1033,7 @@ class Router:
                             kind="stream_setup")
                 with trace.span("stream_setup", tier=other):
                     alt = self.tiers[other].process_stream(history)
-                if self._is_admission_rejection(alt):
-                    self.obs.m.admission_rejected.labels(other).inc()
+                self._note_admission_rejection(alt, other)
                 self._breaker_record_stream_setup(other, alt)
                 if not self._is_error(alt):
                     handle, which = alt, other
@@ -988,6 +1151,9 @@ class Router:
             "routing_cache_hit": cache_hit,
             "routing_overhead_ms": round(overhead_ms, 2),
         }
+        if overflow_dropped:
+            meta["overflow_truncated"] = True
+            meta["overflow_dropped_messages"] = overflow_dropped
         return RoutedStream(state, meta, on_done,
                             resume=resume_mid_stream)
 

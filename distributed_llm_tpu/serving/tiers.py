@@ -64,7 +64,15 @@ class AdmissionController:
     - the EWMA of recent service times predicts this request would wait
       past ``tier.request_timeout_s`` anyway — failing in microseconds
       what would otherwise fail by timeout after blocking a thread for
-      the full cap.
+      the full cap, or
+    - (``tier.kv_admission``, batched tiers) the request's PROJECTED KV
+      block demand — prompt bucket + decode budget — exceeds the paged
+      pool's free blocks plus the blocks reclaimable by evicting parked
+      prefixes: a fixed HBM block pool admits by blocks, not slots, and
+      a request that must starve should fail over now (reference error
+      shape + ``retry_after_s``) instead of queuing forever, or
+    - the tier is DRAINING (graceful shutdown, EngineManager.drain):
+      rejection with ``retry_after_s`` so clients retry elsewhere/later.
 
     Composes with the abandoned-worker accounting: an abandoned
     timed-out call keeps its admission slot until the worker really
@@ -88,11 +96,23 @@ class AdmissionController:
         self._alpha = 0.25                    # EWMA smoothing
         self.admitted = 0
         self.rejected = 0
+        self.kv_rejected = 0
+        # Graceful drain (EngineManager.drain): while set, every request
+        # is rejected with the drain reason; retry_after_s carries the
+        # drain deadline as the client's retry hint.
+        self._draining = False
+        self._drain_retry_after: Optional[float] = None
 
-    def try_admit(self) -> Optional[str]:
+    def try_admit(self, kv_demand: Optional[int] = None,
+                  kv_supply: Optional[int] = None) -> Optional[str]:
         """None = admitted (caller MUST release exactly once); else the
-        human-readable rejection reason."""
+        human-readable rejection reason.  ``kv_demand``/``kv_supply``
+        (projected blocks needed vs free + reclaimable, from the tier's
+        paged engine) arm the KV-pressure gate; either None skips it."""
         with self._lock:
+            if self._draining:
+                self.rejected += 1
+                return "draining (graceful shutdown in progress)"
             waiting = max(0, self._inflight - self.slots)
             # The line this request would JOIN: cap 0 means "slots only,
             # nobody waits", not "reject even with free slots".
@@ -114,9 +134,52 @@ class AdmissionController:
                             f"exceeds the {self.timeout_s:.0f}s request "
                             f"timeout (queue_depth={waiting}, "
                             f"ewma_service={self._ewma_s:.2f}s)")
+            if (kv_demand is not None and kv_supply is not None
+                    and self._inflight < self.slots
+                    and kv_demand > kv_supply):
+                # A slot is FREE but the block pool cannot serve the
+                # request (starvation / constrained pool) — the anomaly
+                # this gate exists for: the request would sit in the
+                # engine queue invisible to the wait predictor.  Shed
+                # now, while the Router can still fail over.  At full
+                # slot occupancy the gate stands down: blocks free when
+                # slots finish, and the bounded queue + EWMA predictor
+                # already model that wait in time units (shedding there
+                # would reject saturated-load requests that queue fine).
+                self.rejected += 1
+                self.kv_rejected += 1
+                return (f"projected KV demand {kv_demand} blocks exceeds "
+                        f"{kv_supply} free+reclaimable (pool pressure)")
             self._inflight += 1
             self.admitted += 1
             return None
+
+    # -- drain (EngineManager.drain) ---------------------------------------
+
+    def start_drain(self, retry_after_s: Optional[float] = None) -> None:
+        with self._lock:
+            self._draining = True
+            self._drain_retry_after = retry_after_s
+
+    def end_drain(self) -> None:
+        with self._lock:
+            self._draining = False
+            self._drain_retry_after = None
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def retry_after_s(self) -> float:
+        """Client retry hint for a rejection: the drain deadline while
+        draining, else the EWMA service time (one slot finishing frees
+        capacity/blocks), else a 1 s floor."""
+        with self._lock:
+            if self._draining and self._drain_retry_after:
+                return round(float(self._drain_retry_after), 2)
+            if self._ewma_s:
+                return max(0.1, round(self._ewma_s, 2))
+        return 1.0
 
     def release(self, service_s: Optional[float] = None) -> None:
         """End of an admitted request.  ``service_s`` (wall time the
@@ -149,6 +212,8 @@ class AdmissionController:
                                     if self._ewma_s is not None else None),
                 "admitted": self.admitted,
                 "rejected": self.rejected,
+                "kv_rejected": self.kv_rejected,
+                "draining": self._draining,
             }
 
 
@@ -215,15 +280,15 @@ class TierClient:
         the reference error shape in microseconds instead of blocking a
         serving thread for the full cap (AdmissionController)."""
         trace = current_trace()
+        kv_demand, kv_supply = self._kv_admission_args(history)
         with obs_spans.span(trace, "admission", tier=self.name) as adm_sp:
-            admit_err = self.admission.try_admit()
+            admit_err = self.admission.try_admit(kv_demand, kv_supply)
             if admit_err is not None:
                 adm_sp.annotate(rejected=admit_err)
         if admit_err is not None:
             logger.warning("tier %s admission rejected a request: %s",
                            self.name, admit_err)
-            return {"error": f"Request failed: {self.name} admission "
-                             f"rejected: {admit_err}"}
+            return self._admission_error(admit_err)
         if self.faults is not None:
             fault = self.faults.intercept(self.name)
             if fault is not None:
@@ -301,6 +366,47 @@ class TierClient:
                                  f"after {timeout:.0f}s"}
         return box.get("out", {"error": "Request failed: worker died"})
 
+    def _kv_admission_args(self, history: History):
+        """(projected block demand, available block supply) for the KV
+        admission gate, or (None, None) when it doesn't apply: gate off,
+        engine not running, or not a paged engine.  Peeks the live engine
+        without lazy-starting it — a stopped tier's pool has no pressure
+        to gate on."""
+        if not self.tier.kv_admission:
+            return None, None
+        engine = getattr(self.server_manager, "_engine", None)
+        demand_fn = getattr(engine, "projected_demand_blocks", None)
+        stats_fn = getattr(engine, "kv_stats", None)
+        if not (callable(demand_fn) and callable(stats_fn)):
+            return None, None
+        try:
+            st = stats_fn()
+            supply = (int(st["free_blocks"])
+                      + int(st["reclaimable_blocks"]))
+            worst = getattr(engine, "max_demand_blocks", None)
+            if callable(worst) and supply >= int(worst()):
+                # Pool trivially covers ANY request: skip the per-request
+                # prompt tokenization (the gate cannot fire) — the hot
+                # path only pays the precise estimate under pressure.
+                return None, None
+            return int(demand_fn(history)), supply
+        except Exception:
+            return None, None               # estimation must never reject
+
+    def _admission_error(self, admit_err: str) -> Dict[str, Any]:
+        """Reference error shape for an admission rejection.  Drain and
+        KV-pressure rejections carry the sanctioned ``retry_after_s``
+        hint (serving/errors.py): both are transient-by-design states a
+        client should retry past, unlike a full waiting line where
+        failover is the productive move."""
+        from .errors import error_dict
+        msg = (f"Request failed: {self.name} admission rejected: "
+               f"{admit_err}")
+        if "draining" in admit_err or "KV demand" in admit_err:
+            return error_dict(msg,
+                              retry_after_s=self.admission.retry_after_s())
+        return {"error": msg}
+
     def _maybe_break_stream(self, handle):
         """Apply a scripted mid-stream kill (FaultInjector.
         fail_stream_after): the returned stream dies after N chunks —
@@ -338,6 +444,11 @@ class TierClient:
                 with self._engine_lock:
                     result = engine.generate(history)  # dllm-lint: disable=lock-blocking-call -- the engine lock IS the queue: sequential engines require serialized callers, and admission + request_timeout_s bound the wait
         except Exception as exc:   # engine failure → reference error shape
+            # Engine-stopped failures (shutdown/drain deadline) carry the
+            # schema-validated shape already — forward it verbatim.
+            shape = getattr(exc, "shape", None)
+            if isinstance(shape, dict) and "error" in shape:
+                return dict(shape), None
             return {"error": f"Request failed: {exc}"}, None
 
         if result is None:
@@ -395,15 +506,15 @@ class TierClient:
         it to the EWMA would let slow readers poison the predictive
         fail-fast against an idle engine)."""
         trace = current_trace()
+        kv_demand, kv_supply = self._kv_admission_args(history)
         with obs_spans.span(trace, "admission", tier=self.name) as adm_sp:
-            admit_err = self.admission.try_admit()
+            admit_err = self.admission.try_admit(kv_demand, kv_supply)
             if admit_err is not None:
                 adm_sp.annotate(rejected=admit_err)
         if admit_err is not None:
             logger.warning("tier %s admission rejected a stream: %s",
                            self.name, admit_err)
-            return {"error": f"Request failed: {self.name} admission "
-                             f"rejected: {admit_err}"}
+            return self._admission_error(admit_err)
         t0 = time.perf_counter()
         handle_box: Dict[str, Any] = {}
 
@@ -466,6 +577,9 @@ class TierClient:
                 raise
         except Exception as exc:
             self.admission.release()
+            shape = getattr(exc, "shape", None)
+            if isinstance(shape, dict) and "error" in shape:
+                return dict(shape)         # engine-stopped: exact shape
             return {"error": f"Request failed: {exc}"}
 
     def load_snapshot(self) -> Dict[str, Any]:

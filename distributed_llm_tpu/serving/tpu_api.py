@@ -40,6 +40,23 @@ DEFAULT_TEMPERATURE = 0.0
 TIER_PORTS = {"nano": 5001, "orin": 5000}   # reference ports
 
 
+def _validate_history(query) -> Optional[str]:
+    """None = well-formed; else the 400 message.  A list history must be
+    role/content dicts with string fields (the reference clients build
+    exactly that shape) — a malformed entry used to crash downstream in
+    the tokenizer's history join instead of failing at the edge."""
+    if isinstance(query, str):
+        return None
+    for m in query:
+        if not isinstance(m, dict):
+            return ("Invalid history entry: expected "
+                    "{role, content} objects")
+        if not isinstance(m.get("role", ""), str) \
+                or not isinstance(m.get("content", ""), str):
+            return "Invalid history entry: role/content must be strings"
+    return None
+
+
 class _ReleaseOnce:
     """Invoke ``fn`` exactly once — explicitly or via GC.  The stream
     route's admission release lives in its generator's ``finally``, but
@@ -127,6 +144,9 @@ def create_tier_app(tier_name: str,
         if not isinstance(query, (list, str)):
             return jsonify({"error": "Invalid query format. "
                                      "Expect list[role/content] or string."}), 400
+        bad = _validate_history(query)
+        if bad is not None:
+            return jsonify({"error": bad}), 400
 
         try:
             num_predict = int(data.get("num_predict") or DEFAULT_NUM_PREDICT)
@@ -179,6 +199,9 @@ def create_tier_app(tier_name: str,
         query = data.get("query")
         if not query or not isinstance(query, (list, str)):
             return jsonify({"error": "No/invalid query provided"}), 400
+        bad = _validate_history(query)
+        if bad is not None:
+            return jsonify({"error": bad}), 400
         engine = manager.engine()
         if not hasattr(engine, "generate_stream"):
             return jsonify({"error": "this tier's engine does not support "

@@ -160,6 +160,48 @@ class BrokenStream:
         return getattr(self._handle, "result", None)
 
 
+class BlockStarver:
+    """Memory-pressure fault: temporarily confiscate free blocks from a
+    paged engine's BlockAllocator (engine/paged_kv.py) — what a co-tenant
+    grabbing HBM, a parked-prefix burst, or an undersized pool looks like
+    to the scheduler.  Admission's KV gate starts rejecting, and running
+    slots that can no longer grow exercise the preempt→replay path.
+
+    ``starve(n)`` takes up to ``n`` currently-free blocks (repeatable:
+    holdings accumulate); ``release()`` returns every held block.  The
+    starver never touches allocated blocks, so in-flight sequences keep
+    their KV — exactly like real external pressure."""
+
+    def __init__(self, allocator):
+        self.allocator = allocator
+        self._held: List[int] = []
+        self._lock = threading.Lock()
+
+    def starve(self, n: int) -> int:
+        """Confiscate up to ``n`` free blocks; returns how many were
+        actually taken (the pool may already be tighter than asked)."""
+        take = min(max(0, int(n)), self.allocator.available)
+        got = self.allocator.alloc(take) if take else None
+        if not got:
+            return 0
+        with self._lock:
+            self._held.extend(got)
+        return len(got)
+
+    def release(self) -> int:
+        """Return every confiscated block to the pool."""
+        with self._lock:
+            held, self._held = self._held, []
+        if held:
+            self.allocator.free(held)
+        return len(held)
+
+    @property
+    def held(self) -> int:
+        with self._lock:
+            return len(self._held)
+
+
 class FaultSchedule:
     """A scripted fault timeline over a FaultInjector, driven on a
     background thread: the chaos harness's scenario language.
@@ -174,6 +216,7 @@ class FaultSchedule:
         self.injector = injector
         self._events: List[Tuple[float, str, Callable[[], None]]] = []
         self._tiers: set = set()
+        self._starvers: List[BlockStarver] = []
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.applied: List[Tuple[float, str]] = []   # (offset_s, label)
@@ -214,6 +257,21 @@ class FaultSchedule:
                 lambda: self.injector.add_latency(tier, seconds), tier)
         self.at(end_s, f"unlag:{tier}",
                 lambda: self.injector.add_latency(tier, 0.0), tier)
+        return self
+
+    def starve_blocks(self, allocator, start_s: float, end_s: float,
+                      n: int, tier: Optional[str] = None
+                      ) -> "FaultSchedule":
+        """Memory-pressure window: confiscate up to ``n`` free blocks
+        from ``allocator`` at ``start_s``, return them at ``end_s``.
+        ``stop()`` also releases (a schedule may never leak pool
+        blocks past its run)."""
+        starver = BlockStarver(allocator)
+        self._starvers.append(starver)
+        label = tier or "pool"
+        self.at(start_s, f"starve:{label}:{n}",
+                lambda: starver.starve(n), tier)
+        self.at(end_s, f"unstarve:{label}", starver.release, tier)
         return self
 
     def kill_stream(self, tier: str, at_s: float, after_chunks: int
@@ -262,10 +320,13 @@ class FaultSchedule:
 
     def stop(self) -> None:
         """Halt the driver and restore every touched tier (no schedule
-        may leak a sticky outage past its run)."""
+        may leak a sticky outage — or confiscated pool blocks — past
+        its run)."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
         for tier in self._tiers:
             self.injector.restore(tier)
+        for starver in self._starvers:
+            starver.release()

@@ -124,6 +124,55 @@ def test_lock_checker_unbounded_wait_under_lock():
     assert _rules(result) == ["lock-blocking-call"]
 
 
+def test_lock_checker_drain_under_lifecycle_lock_flagged():
+    """``drain`` is in the blocking-call name set (PR 5): it waits out
+    in-flight work and then calls stop_server, so calling it under the
+    lifecycle lock is a self-deadlock — flagged directly AND through a
+    local call."""
+    src = """
+        import threading
+
+        class Manager:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def drain(self, timeout_s=None):
+                pass
+
+            def shutdown(self):
+                with self._lock:
+                    self.drain()             # blocking under the lock
+    """
+    result = _lint(LockChecker(), {SERVING: src})
+    blocking = [f for f in result.findings
+                if f.rule == "lock-blocking-call"]
+    assert len(blocking) == 1, result.findings
+    assert "drain" in blocking[0].message
+
+
+def test_lock_checker_drain_near_miss_outside_lock_clean():
+    """The real shape (engine/manager.py): drain runs OUTSIDE the
+    lifecycle lock and only stop_server re-takes it internally — clean."""
+    src = """
+        import threading
+
+        class Manager:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def stop_server(self):
+                with self._lock:
+                    pass
+
+            def drain(self, timeout_s=None):
+                self.stop_server()           # no lock held here: fine
+
+            def shutdown(self):
+                self.drain()                 # nor here
+    """
+    assert _lint(LockChecker(), {SERVING: src}).findings == []
+
+
 def test_lock_order_inversion_detected_and_consistent_order_clean():
     bad = """
         import threading
