@@ -83,6 +83,7 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
     from ..config import bench_cluster, tiny_cluster
     from ..ops import attention as A
     from ..ops import pallas_attention as PA
+    from ..ops import ragged_attention as RA
 
     if kinds is not None:
         unknown = set(kinds) - ALL_KINDS
@@ -259,6 +260,42 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
                                                  v_scale=a[4]),
                        (q, kq, vq, ksc, vsc, tables, pos),
                        PA.paged_decode_attention_q8,
+                       (q, kq, vq, ksc, vsc, tables, pos), {"batch": b})
+
+        # ragged paged decode: FULL tables + SKEWED per-slot lengths —
+        # the mixed-length regime the ragged kernel exists for (the
+        # dense paged kinds above measure at the uniform worst-case
+        # frontier; measuring ragged there would hide exactly the
+        # padded-window waste it removes).
+        for b in batches[1:]:
+            if not (want("ragged_decode") or want("ragged_decode_q8")):
+                break
+            nb = b * (s // bs) + 1
+            kp = jax.random.normal(key, (nkv, nb, bs, d), bf16)
+            vp = jax.random.normal(key, (nkv, nb, bs, d), bf16)
+            tables = jnp.asarray(
+                np.arange(b * (s // bs), dtype=np.int32).reshape(b, s // bs))
+            # Slot i holds ~(i+1)/b of the full length: one long slot,
+            # the rest progressively shorter.
+            pos = jnp.asarray([max(0, s * (i + 1) // b - 1)
+                               for i in range(b)], jnp.int32)
+            q = jax.random.normal(key, (b, nq, d), bf16)
+            if want("ragged_decode"):
+                record("ragged_decode", s, A.ragged_decode,
+                       (q, kp, vp, tables, pos),
+                       RA.ragged_paged_decode_attention,
+                       (q, kp, vp, tables, pos), {"batch": b})
+
+            if want("ragged_decode_q8"):
+                kq, ksc = _qkv(kp)
+                vq, vsc = _qkv(vp)
+                record("ragged_decode_q8", s,
+                       lambda *a: A.ragged_decode(a[0], a[1], a[2], a[5],
+                                                  a[6], impl="xla",
+                                                  k_scale=a[3],
+                                                  v_scale=a[4]),
+                       (q, kq, vq, ksc, vsc, tables, pos),
+                       RA.ragged_paged_decode_attention_q8,
                        (q, kq, vq, ksc, vsc, tables, pos), {"batch": b})
 
         # paged chunk prefill (prefix-reuse admissions — engine/paged_kv.

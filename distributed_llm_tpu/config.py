@@ -267,6 +267,28 @@ class TierConfig:
     decode_batch: int = 1
     kv_block_size: int = 64
     decode_steps_per_tick: int = 4
+    # Ragged paged decode (ops/ragged_attention.py): the batched engine's
+    # decode tick issues ONE fused attention call over every slot's FULL
+    # block-table row with true per-slot lengths, instead of slicing the
+    # tables to a bucketed window rung shared across the batch.  One
+    # compiled decode program serves the engine's whole life (the rung
+    # ladder minted one per (bucket, window) pair), the host stops
+    # re-uploading sliced tables every tick, and on TPU the Pallas kernel
+    # streams each slot's own frontier so length skew costs per-slot
+    # work, not the batch max.  Unsharded engines only — TP meshes keep
+    # the dense windowed path (a pallas_call has no GSPMD rule, and the
+    # shard-mapped hook is rung-specialized).  On TPU the request is
+    # additionally GATED by the measured dispatch verdict: while
+    # ab_dispatch.json still says 'xla' for ragged_decode (the
+    # conservative pre-measure rows), the engine keeps the dense
+    # windowed tick — the fused XLA fallback's full-span gather is not
+    # measured-better there; an on-chip A/B flipping the row to 'pallas'
+    # flips the engine with no code change
+    # (ContinuousBatchingEngine._resolve_ragged).  DLLM_RAGGED=0/1
+    # forces the TICK SHAPE (fused vs windowed) past everything but the
+    # mesh rule; the KERNEL inside the fused tick stays the table's
+    # measured choice (DLLM_ATTENTION overrides that separately).
+    attention_ragged: bool = True
     # Admission control (serving/tiers.py AdmissionController): the max
     # requests allowed to WAIT for this tier beyond its decode_batch
     # concurrent slots.  Past the bound — or earlier, when queued × EWMA

@@ -61,6 +61,12 @@ ENV_VARS: Dict[str, EnvVar] = {v.name: v for v in (
        "Explicit attention-kernel override ('pallas' / 'xla'); unset = "
        "the measured dispatch table (bench/ab_dispatch.json) decides per "
        "kind."),
+    _e("DLLM_RAGGED", None, "engine/batching.py",
+       "'1' forces the batched engine's ragged fused decode TICK on, "
+       "'0' forces the dense windowed path; unset = "
+       "TierConfig.attention_ragged decides (TP meshes stay dense "
+       "either way; the kernel inside the tick is DLLM_ATTENTION / "
+       "dispatch-table territory)."),
     _e("DLLM_NATIVE", None, "native/__init__.py",
        "'0' disables the g++-built native tokenizer/counter helpers; "
        "behavior is bit-identical to the pure-Python fallback."),
@@ -132,6 +138,11 @@ CONFIG_FIELDS: Dict[str, str] = {
                                 "(engine/paged_kv.py).",
     "TierConfig.decode_steps_per_tick": "Sequential decode steps fused "
                                         "into one device call per tick.",
+    "TierConfig.attention_ragged": "Batched decode tick runs ONE fused "
+                                   "ragged paged-attention call over "
+                                   "full block tables with per-slot "
+                                   "lengths (no bucketed window rungs); "
+                                   "unsharded engines only.",
     "TierConfig.admission_max_queue": "Max requests waiting beyond the "
                                       "slots before fail-fast; None "
                                       "disables admission control.",

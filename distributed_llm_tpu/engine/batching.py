@@ -22,6 +22,7 @@ step serves every occupancy, so the scheduler never recompiles.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import queue
 import threading
 import time
@@ -46,6 +47,8 @@ from .paged_kv import (BlockAllocator, PagedConfig, TRASH_BLOCK,
 from .tokenizer import get_tokenizer
 
 History = Union[str, Sequence[Dict[str, Any]]]
+
+logger = logging.getLogger(__name__)
 
 
 class EngineStoppedError(RuntimeError):
@@ -154,6 +157,27 @@ class ContinuousBatchingEngine:
                                  max_seq_len=self.cfg.max_seq_len,
                                  pool_blocks=tier.kv_pool_blocks)
         self.steps_per_tick = max(1, tier.decode_steps_per_tick)
+        # Ragged fused decode (ops/ragged_attention.py): the tick passes
+        # every slot's FULL table row to ONE attention.ragged_decode call
+        # instead of slicing to a bucketed window rung.  Unsharded
+        # engines only — the TP tick keeps the rung-specialized
+        # shard-mapped dense path.  DLLM_RAGGED=0/1 is the kill
+        # switch / forced-on override (kept strict like DLLM_ATTENTION:
+        # garbage raises rather than failing open).
+        self.ragged = self._resolve_ragged()
+        # Full-table device upload cache: under ragged decode the tables
+        # arg is shape-stable, so it is re-uploaded only when a table row
+        # actually changes (admission/growth/finish/preempt) instead of
+        # re-sliced host→device every tick like the dense rung path.
+        self._tables_dev = None
+        # Recent decode-tick device times in ms (ring; bench skew leg and
+        # tests read it — the obs histogram is the scrapeable twin).
+        self.tick_ms: "deque[float]" = deque(maxlen=512)
+        # Distinct compiled programs minted per stage (prefill buckets,
+        # chunk (bucket, window) pairs, writers, decode widths) — the
+        # compile-churn surface ISSUE 6 bounds: logged on growth and
+        # mirrored to the dllm_compiled_programs gauge.
+        self._compiled: Dict[str, set] = {}
         if tier.kv_pool_blocks is not None:
             # A constrained pool must still fit ONE largest-bucket prefill
             # plus a decode tick, or no request could ever admit.
@@ -278,7 +302,69 @@ class ContinuousBatchingEngine:
         self.phases = PhaseTimer()
         self._wbytes = roofline.weight_bytes(self.cfg, tier.quantize)
 
+    def _resolve_ragged(self) -> bool:
+        """Whether the decode tick runs the ragged fused path.
+
+        Policy: (a) TP meshes never do — a pallas_call has no GSPMD rule
+        and the shard-mapped hook is rung-specialized; (b) DLLM_RAGGED
+        forces the TICK SHAPE ('1' fused, '0' dense windowed) — which
+        KERNEL serves the fused tick's attention is a separate, measured
+        choice (the dispatch table, overridable by DLLM_ATTENTION=pallas
+        like every other kind); (c) otherwise
+        ``TierConfig.attention_ragged`` requests it, GATED by the
+        measured dispatch verdict on TPU: the
+        fused tick's XLA fallback gathers the FULL table span, so while
+        the committed table still says 'xla' for ragged_decode at this
+        pool's span (no on-chip measurement yet — the conservative rows
+        ab_dispatch.json ships with), a TPU engine keeps the dense
+        windowed path, whose bucketed gather is the measured-better XLA
+        strategy there.  Off-TPU backends stay fused: the skew leg
+        measured the fallback WINNING on CPU (the rung ladder's host +
+        compile churn dominates the tiny gather), and the whole point of
+        the table is that an on-chip A/B flipping ragged_decode to
+        'pallas' flips this engine to the kernel with no code change."""
+        if self.mesh is not None:
+            return False
+        from ..config_registry import env_str
+        raw = env_str("DLLM_RAGGED")
+        if raw is not None and raw not in ("0", "1"):
+            raise ValueError(f"DLLM_RAGGED={raw!r}: expected '0' or '1'")
+        if raw is not None:
+            return raw == "1"
+        if not self.tier.attention_ragged:
+            return False
+        if jax.default_backend() != "tpu":
+            return True
+        from ..ops import attention as attn_ops
+        kind = ("ragged_decode_q8" if self.tier.kv_quantize == "int8"
+                else "ragged_decode")
+        span = self.paged.blocks_per_slot * self.paged.block_size
+        return attn_ops._choose(self.cfg.attention_impl, kind,
+                                span) == "pallas"
+
     # -- compiled stages ---------------------------------------------------
+
+    def _note_compile(self, stage: str, key) -> None:
+        """Record a NEW compiled program for ``stage`` (prefill bucket,
+        chunk (bucket, window), pool writer, decode table width): logs the
+        growth — warmup cost must be visible, a mid-serve compile stalls
+        every active slot — and mirrors the per-stage count to the
+        ``dllm_compiled_programs`` gauge.  The ragged decode tick pins the
+        decode stage at ONE program; the dense rung ladder grows it per
+        (bucket, window) rung crossed."""
+        seen = self._compiled.setdefault(stage, set())
+        if key in seen:
+            return
+        seen.add(key)
+        logger.info(
+            "tier %s: compiling %s program %r (%d %s programs so far)",
+            self.tier.name, stage, key, len(seen), stage)
+        try:
+            from ..obs import get_observability
+            get_observability().m.compiled_programs.labels(
+                self.tier.name, stage).set(len(seen))
+        except Exception:
+            pass
 
     def _prefill_fn(self, bucket: int):
         """Per bucket: forward the padded prompt, return the first sampled
@@ -287,6 +373,7 @@ class ContinuousBatchingEngine:
         (parallel/tp_attention.py), same policy as the sequential engine."""
         if bucket in self._prefill_fns:
             return self._prefill_fns[bucket]
+        self._note_compile("prefill", bucket)
         cfg = self.cfg
         from ..parallel.tp_attention import tp_prefill_attn
         attn = tp_prefill_attn(self.mesh, cfg, bucket)
@@ -319,13 +406,16 @@ class ContinuousBatchingEngine:
         max_pos = cfg.max_seq_len - 1
         steps = self.steps_per_tick
         mesh = self.mesh
+        ragged = self.ragged
         quantized = self.tier.kv_quantize == "int8"
 
         def run(params, pool, tables, pos, cur, temps, rng):
             # TP tiers: per-head-shard paged flash decode (the window
             # width is static per trace, so the hook resolves here).
+            # Ragged engines are unsharded by construction, so the two
+            # paths never meet.
             attn = None
-            if cfg.num_experts == 1:
+            if cfg.num_experts == 1 and not ragged:
                 from ..parallel.tp_attention import tp_paged_decode_attn
                 attn = tp_paged_decode_attn(
                     mesh, cfg, tables.shape[1] * self.paged.block_size,
@@ -334,7 +424,8 @@ class ContinuousBatchingEngine:
             def step(carry, _):
                 pool, pos, cur, rng = carry
                 logits, pool = decode_step_paged(cfg, params, cur, pos, pool,
-                                                 tables, attn=attn)
+                                                 tables, attn=attn,
+                                                 ragged=ragged)
                 rng, sub = jax.random.split(rng)
                 nxt = _sample_batched(logits, sub, temps)
                 # Clamp: finished/overshooting slots keep writing into
@@ -358,6 +449,7 @@ class ContinuousBatchingEngine:
         key = ("chunk", bucket, window)
         if key in self._prefill_fns:
             return self._prefill_fns[key]
+        self._note_compile("chunk_prefill", (bucket, window))
         cfg = self.cfg
 
         def run(params, pool, tokens, start, true_len, table, rng, temp):
@@ -380,6 +472,7 @@ class ContinuousBatchingEngine:
         """Jitted pool scatter (donated pool → in-place page-in), one
         compile per prefill block count."""
         if nb not in self._writer_fns:
+            self._note_compile("writer", nb)
             donate = (0,) if jax.default_backend() != "cpu" else ()
             kw = {}
             if self._pool_shardings is not None:
@@ -402,6 +495,14 @@ class ContinuousBatchingEngine:
         row = np.full(self.paged.blocks_per_slot, TRASH_BLOCK, np.int32)
         row[:len(blocks)] = blocks
         return row
+
+    def _set_table_row(self, ix: int, row) -> None:
+        """All block-table mutations funnel here so the ragged tick's
+        cached full-table device upload is invalidated exactly when a row
+        changes (admission, growth, finish, preemption) — the tick itself
+        then re-uploads at most once per change, not once per tick."""
+        self._tables[ix] = row
+        self._tables_dev = None
 
     def _alloc_evicting(self, n_blocks: int) -> Optional[List[int]]:
         """Allocate, evicting parked prefix entries (LRU) under pressure:
@@ -527,7 +628,7 @@ class ContinuousBatchingEngine:
         if req.token_queue is not None:
             req.token_queue.put(first)
         self._slots[slot_ix] = slot
-        self._tables[slot_ix] = self._table_row(blocks)
+        self._set_table_row(slot_ix, self._table_row(blocks))
         self._pos[slot_ix] = n               # first generated token's pos
         self._cur[slot_ix] = first
         self._temps[slot_ix] = temp
@@ -611,7 +712,7 @@ class ContinuousBatchingEngine:
                      prompt_ids=tuple(ids), max_blocks=max_blocks)
         req.replay_tokens = None
         self._slots[slot_ix] = slot
-        self._tables[slot_ix] = self._table_row(blocks)
+        self._set_table_row(slot_ix, self._table_row(blocks))
         self._pos[slot_ix] = len(seq)        # the current token's position
         self._cur[slot_ix] = gen[-1]
         self._temps[slot_ix] = temp
@@ -665,7 +766,7 @@ class ContinuousBatchingEngine:
                 extra = self._alloc_evicting(need - len(slot.blocks))
                 if extra is not None:
                     slot.blocks.extend(extra)
-                    self._tables[ix] = self._table_row(slot.blocks)
+                    self._set_table_row(ix, self._table_row(slot.blocks))
                     break
                 victims = [j for j in active if self._slots[j] is not None]
                 if victims == [ix]:
@@ -728,7 +829,7 @@ class ContinuousBatchingEngine:
         if not parked:
             self.allocator.free(slot.blocks)
         self._slots[slot_ix] = None
-        self._tables[slot_ix] = TRASH_BLOCK
+        self._set_table_row(slot_ix, TRASH_BLOCK)
         self._pos[slot_ix] = 0
         self._cur[slot_ix] = 0
 
@@ -783,26 +884,58 @@ class ContinuousBatchingEngine:
 
             try:
                 self._rng, rng = jax.random.split(self._rng)
-                # Bound the per-step pool gather by a bucketed high-water
-                # mark over active slots (positions written this tick stay
-                # < window); jit retraces per distinct width, one compile
-                # per bucket crossed as conversations grow.
-                w_need = int(max(self._pos[ix] for ix in active)) \
-                    + self.steps_per_tick
-                wb = self._suffix_window(w_need) // self.paged.block_size
+                if self.ragged:
+                    # Ragged fused tick: the FULL tables go to one
+                    # attention.ragged_decode call with true per-slot
+                    # lengths — shape-stable, so exactly ONE compiled
+                    # decode program serves the engine's life, and the
+                    # upload is cached until a table row changes.
+                    wb = self.paged.blocks_per_slot
+                    if self._tables_dev is None:
+                        self._tables_dev = jnp.asarray(self._tables)
+                    tables_arg = self._tables_dev
+                else:
+                    # Dense windowed tick: bound the per-step pool gather
+                    # by a bucketed high-water mark over active slots
+                    # (positions written this tick stay < window); jit
+                    # retraces per distinct width, one compile per bucket
+                    # crossed as conversations grow.
+                    w_need = int(max(self._pos[ix] for ix in active)) \
+                        + self.steps_per_tick
+                    wb = self._suffix_window(w_need) \
+                        // self.paged.block_size
+                    tables_arg = jnp.asarray(self._tables[:, :wb])
+                self._note_compile("decode", wb)
+                t_tick = time.perf_counter()
                 with self.phases.phase("decode"):
                     toks, self.pool = self._decode_step()(
-                        self.params, self.pool,
-                        jnp.asarray(self._tables[:, :wb]),
+                        self.params, self.pool, tables_arg,
                         jnp.asarray(self._pos), jnp.asarray(self._cur),
                         jnp.asarray(self._temps), rng)
                     toks = np.asarray(jax.block_until_ready(toks))  # [T, B]
+                tick_ms = (time.perf_counter() - t_tick) * 1000.0
                 from ..utils import roofline
                 from ..ops import attention as attn_ops
                 window = wb * self.paged.block_size
-                kind = ("paged_decode_q8"
-                        if self.tier.kv_quantize == "int8"
-                        else "paged_decode")
+                q8 = self.tier.kv_quantize == "int8"
+                kind = (("ragged_decode_q8" if q8 else "ragged_decode")
+                        if self.ragged
+                        else ("paged_decode_q8" if q8 else "paged_decode"))
+                self.tick_ms.append(tick_ms)
+                try:
+                    # No injection path on the engine (same pattern as
+                    # the preemption counter): the process-global
+                    # registry — which kernel actually serves decode must
+                    # be readable off /metrics, not guessed.
+                    from ..obs import get_observability
+                    m = get_observability().m
+                    m.decode_tick_ms.labels(self.tier.name).observe(tick_ms)
+                    m.decode_ticks.labels(
+                        self.tier.name, kind,
+                        attn_ops._choose(self.cfg.attention_impl, kind,
+                                         window)).inc()
+                except Exception:
+                    pass
                 # Mid-tick per-row positions (each row advances
                 # steps_per_tick this tick): frontier-clamped Pallas paged
                 # kernels stream ceil((pos+1)/bs) blocks, not the window.
@@ -1059,15 +1192,18 @@ class ContinuousBatchingEngine:
         beat = beat or (lambda: None)
         self.generate("warmup", max_new_tokens=2)
         beat()
-        # The batched decode program retraces per gather-window rung; a
-        # mid-serve retrace stalls EVERY active slot for the compile.
-        # The warm request covered the first rung — also compile the
-        # second (typical multi-turn growth); deeper rungs stay lazy
-        # (one compile each over an engine's life).  All slots are free
-        # here (tables point at the trash block), so the extra ticks
-        # write only trash.
-        for w in self._buckets[1:2]:
+        # The DENSE batched decode program retraces per gather-window
+        # rung; a mid-serve retrace stalls EVERY active slot for the
+        # compile.  The warm request covered the first rung — also
+        # compile the second (typical multi-turn growth); deeper rungs
+        # stay lazy (one compile each over an engine's life).  All slots
+        # are free here (tables point at the trash block), so the extra
+        # ticks write only trash.  The RAGGED tick is shape-stable — the
+        # warm request already compiled its one program, so there is
+        # nothing left to warm.
+        for w in ([] if self.ragged else self._buckets[1:2]):
             wb = min(w // self.paged.block_size, self.paged.blocks_per_slot)
+            self._note_compile("decode", wb)
             self._rng, rng = jax.random.split(self._rng)
             toks, self.pool = self._decode_step()(
                 self.params, self.pool, jnp.asarray(self._tables[:, :wb]),

@@ -239,15 +239,22 @@ def decode_step_paged(
     pool: KVPool,
     tables: jax.Array,         # [B, MB] block ids per slot
     attn=None,                 # (q, kp, vp, tables, pos, ks, vs) override
+    ragged: bool = False,      # fused ragged decode over FULL tables
 ) -> Tuple[jax.Array, KVPool]:
     """One batched autoregressive step over paged caches.
 
     Returns (logits [B, V] float32, updated pool).  Idle slots point their
     whole table at the trash block; their writes land there and their
-    logits are ignored by the scheduler.  Callers bound the attention
+    logits are ignored by the scheduler.
+
+    Two attention contracts: the DENSE path expects callers to bound the
     gather by passing a TRUNCATED table ([B, wb] covering every active
-    position) — the scheduler slices to a bucketed high-water mark so
-    short conversations don't stream max_seq_len of pool per step.
+    position — the scheduler slices to a bucketed high-water mark so
+    short conversations don't stream max_seq_len of pool per step);
+    ``ragged=True`` instead expects each slot's FULL table row and issues
+    one fused ``attention.ragged_decode`` call with true per-slot
+    lengths — the Pallas kernel streams each slot's own frontier, so the
+    padding costs nothing and one compiled step serves every width.
     """
     b = token.shape[0]
     d = cfg.head_dim
@@ -262,7 +269,9 @@ def decode_step_paged(
 
     quantized = "ks" in pool
     if attn is None:
-        attn = lambda q, kp, vp, tbl, p, ks, vs: attention.paged_decode(
+        attn_op = (attention.ragged_decode if ragged
+                   else attention.paged_decode)
+        attn = lambda q, kp, vp, tbl, p, ks, vs: attn_op(
             q, kp, vp, tbl, p, impl=cfg.attention_impl,
             k_scale=ks, v_scale=vs)
 

@@ -4,7 +4,13 @@ A function is a JIT ROOT when it is decorated with ``jax.jit`` /
 ``pjit`` / ``shard_map`` (directly or through ``partial``), or passed
 to one of those as the function argument (``jax.jit(run)``,
 ``shard_map(step, mesh=...)``, ``jax.jit(partial(init, cfg))``,
-``jax.jit(lambda: ...)``).  The checker walks roots plus every
+``jax.jit(lambda: ...)``).  ``pl.pallas_call`` counts as a wrapper too:
+a Pallas KERNEL body is traced exactly like a jitted function (and a
+blocking host call inside one wedges the whole device program), so the
+kernels in ops/pallas_attention.py and ops/ragged_attention.py are
+roots — including the repo idiom ``kernel = partial(_kernel, ...)``
+followed by ``pl.pallas_call(kernel, ...)``, resolved through the
+module-local assignment.  The checker walks roots plus every
 module-local function they transitively call (cross-module callees are
 out of static reach and skipped — keep traced helpers in the module
 that jits them, or lint them where they live).
@@ -34,7 +40,7 @@ from typing import Dict, List, Optional, Set
 from ..core import Checker, Finding, Project
 from ..symbols import attr_chain, call_name, symbols_for
 
-JIT_WRAPPERS = {"jit", "pjit", "shard_map"}
+JIT_WRAPPERS = {"jit", "pjit", "shard_map", "pallas_call"}
 CONCRETIZERS = {"bool", "int", "float", "len"}
 CONCRETIZE_METHODS = {"item", "tolist"}
 
@@ -118,20 +124,66 @@ class JitPurityChecker(Checker):
                 if _wrapper_leaf(target) is not None:
                     roots.add(qual)
 
-        # Call-site roots: jax.jit(X, ...), shard_map(X, mesh=...).
-        for node in ast.walk(mod.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            if _wrapper_leaf(node.func) is None or not node.args:
-                continue
-            target = _unwrap_partial(node.args[0])
-            if isinstance(target, ast.Lambda):
-                lambda_roots.append(target)
-            elif isinstance(target, ast.Name):
-                for qual, info in syms.functions.items():
-                    if (qual == target.id
-                            or qual.endswith(f"<locals>.{target.id}")):
-                        roots.add(qual)
+        # Call-site roots: jax.jit(X, ...), shard_map(X, mesh=...),
+        # pl.pallas_call(X, grid=...).  A Name argument may be a local
+        # variable bound to the kernel (`kernel = partial(_f, ...)`
+        # then `pl.pallas_call(kernel, ...)` — the ops modules' idiom):
+        # it resolves against assignments in the call's own ENCLOSING
+        # scope, falling back to module scope.  Scoped, not module-wide:
+        # a flat map would conflate same-named variables across
+        # functions and root a host-only helper as a kernel (a
+        # CI-blocking false impurity finding).
+
+        def _scope_assignments(scope_node) -> Dict[str, Set[str]]:
+            """name -> function names bound to it in this scope only
+            (nested function/lambda bodies are their own scopes)."""
+            out: Dict[str, Set[str]] = {}
+            stack = list(getattr(scope_node, "body", []))
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue
+                if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                        and isinstance(n.targets[0], ast.Name)):
+                    value = _unwrap_partial(n.value)
+                    if isinstance(value, ast.Name):
+                        out.setdefault(n.targets[0].id,
+                                       set()).add(value.id)
+                stack.extend(ast.iter_child_nodes(n))
+            return out
+
+        module_assigned = _scope_assignments(mod.tree)
+        scopes = [(mod.tree, module_assigned)]
+        scopes += [(info.node, _scope_assignments(info.node))
+                   for info in syms.functions.values()
+                   if hasattr(info.node, "body")]
+        for scope_node, assigned in scopes:
+            stack = list(getattr(scope_node, "body", []))
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue          # nested defs are their own entry
+                # Lambdas are NOT scope entries (not in syms.functions):
+                # keep walking their bodies here, or a jit/pallas_call
+                # issued inside one would silently escape rooting.
+                stack.extend(ast.iter_child_nodes(node))
+                if (not isinstance(node, ast.Call)
+                        or _wrapper_leaf(node.func) is None
+                        or not node.args):
+                    continue
+                target = _unwrap_partial(node.args[0])
+                if isinstance(target, ast.Lambda):
+                    lambda_roots.append(target)
+                elif isinstance(target, ast.Name):
+                    names = ({target.id}
+                             | assigned.get(target.id, set())
+                             | module_assigned.get(target.id, set()))
+                    for qual, info in syms.functions.items():
+                        if any(qual == n
+                               or qual.endswith(f"<locals>.{n}")
+                               for n in names):
+                            roots.add(qual)
 
         if not roots and not lambda_roots:
             return []
@@ -164,12 +216,21 @@ class JitPurityChecker(Checker):
         body = (func_node.body if isinstance(func_node.body, list)
                 else [func_node.body])
         # Skip nested def/lambda subtrees: they are their own entries in
-        # the reachable set when actually called from traced code.
+        # the reachable set when actually called from traced code.  The
+        # exception is Pallas's ``@pl.when(...)`` idiom — the decorator
+        # RUNS the nested body at trace time right where it is defined,
+        # so its statements belong to the enclosing kernel's scan.
         stack = list(body)
         while stack:
             node = stack.pop()
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.Lambda)):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(isinstance(deco, ast.Call)
+                       and (attr_chain(deco.func) or "").rsplit(
+                           ".", 1)[-1] == "when"
+                       for deco in node.decorator_list):
+                    stack.extend(node.body)
+                continue
+            if isinstance(node, ast.Lambda):
                 continue
             if isinstance(node, ast.Call):
                 findings.extend(self._check_call(mod, imports, node,

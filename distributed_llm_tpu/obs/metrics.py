@@ -335,6 +335,25 @@ class ServingMetrics:
             "dllm_drained_requests_total",
             "In-flight requests completed during a graceful drain",
             ("tier",))
+        # Ragged-decode family (PR 6): the serving path must SHOW which
+        # attention kernel is actually running a tier's decode ticks and
+        # what each tick costs — cross-round perf deltas get attributed
+        # to a kernel, not guessed.
+        self.decode_tick_ms = registry.histogram(
+            "dllm_decode_tick_ms",
+            "Batched decode tick device time (decode_steps_per_tick "
+            "fused steps per observation)", ("tier",))
+        self.decode_ticks = registry.counter(
+            "dllm_decode_ticks_total",
+            "Batched decode ticks, by attention dispatch kind "
+            "(ragged_decode|paged_decode[+_q8]) and the impl the "
+            "measured table chose (xla|pallas)", ("tier", "kind", "impl"))
+        self.compiled_programs = registry.gauge(
+            "dllm_compiled_programs",
+            "Distinct compiled XLA programs the batched engine has "
+            "minted, by stage (prefill|chunk_prefill|writer|decode) — "
+            "decode pins at 1 under ragged attention; growth is logged",
+            ("tier", "stage"))
 
 
 _BREAKER_STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}

@@ -44,7 +44,8 @@ _DISPATCH_META: Optional[dict] = None
 # row (VERDICT r5 weak #2: the table had silently fallen behind the
 # kernels).
 DISPATCH_KINDS = ("prefill", "decode", "decode_q8", "chunk", "chunk_q8",
-                  "paged_decode", "paged_decode_q8", "paged_chunk")
+                  "paged_decode", "paged_decode_q8", "paged_chunk",
+                  "ragged_decode", "ragged_decode_q8")
 
 
 def _load_dispatch() -> None:
@@ -249,6 +250,25 @@ def chunk(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     return chunk_attention(q, k_cache, v_cache, q_positions)
 
 
+def _gather_decode_paged(q, k_pool, v_pool, tables, pos, k_scale, v_scale):
+    """XLA fallback shared by ``paged_decode`` and ``ragged_decode``:
+    gather the block table into a contiguous view and reuse
+    ``decode_attention`` (portable / GSPMD-shardable; one code path so
+    the two kinds' fallbacks are byte-identical — the parity reference
+    for the Pallas kernels)."""
+    b, mb = tables.shape
+    nkv, bs, d = k_pool.shape[0], k_pool.shape[2], k_pool.shape[3]
+    # [Nkv, B, MB, bs, D] -> [B, S, Nkv, D]
+    k_seq = k_pool[:, tables].reshape(nkv, b, mb * bs, d).transpose(1, 2, 0, 3)
+    v_seq = v_pool[:, tables].reshape(nkv, b, mb * bs, d).transpose(1, 2, 0, 3)
+    if k_scale is not None:
+        k_sc = k_scale[:, tables].reshape(nkv, b, mb * bs).transpose(1, 2, 0)
+        v_sc = v_scale[:, tables].reshape(nkv, b, mb * bs).transpose(1, 2, 0)
+        k_seq = (k_seq.astype(jnp.float32) * k_sc[..., None]).astype(q.dtype)
+        v_seq = (v_seq.astype(jnp.float32) * v_sc[..., None]).astype(q.dtype)
+    return decode_attention(q, k_seq, v_seq, pos)
+
+
 def paged_decode(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
                  tables: jax.Array, pos: jax.Array,
                  impl: str = "auto", k_scale: jax.Array = None,
@@ -264,7 +284,7 @@ def paged_decode(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
     (paged_decode_attention_q8, its own dispatch kind); the XLA path
     gathers HALF the bytes and dequantizes after."""
     b, mb = tables.shape
-    nkv, bs, d = k_pool.shape[0], k_pool.shape[2], k_pool.shape[3]
+    bs = k_pool.shape[2]
     if k_scale is None:
         if _choose(impl, "paged_decode", mb * bs) == "pallas":
             from .pallas_attention import paged_decode_attention
@@ -273,15 +293,43 @@ def paged_decode(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
         from .pallas_attention import paged_decode_attention_q8
         return paged_decode_attention_q8(q, k_pool, v_pool, k_scale,
                                          v_scale, tables, pos)
-    # [Nkv, B, MB, bs, D] -> [B, S, Nkv, D]
-    k_seq = k_pool[:, tables].reshape(nkv, b, mb * bs, d).transpose(1, 2, 0, 3)
-    v_seq = v_pool[:, tables].reshape(nkv, b, mb * bs, d).transpose(1, 2, 0, 3)
-    if k_scale is not None:
-        k_sc = k_scale[:, tables].reshape(nkv, b, mb * bs).transpose(1, 2, 0)
-        v_sc = v_scale[:, tables].reshape(nkv, b, mb * bs).transpose(1, 2, 0)
-        k_seq = (k_seq.astype(jnp.float32) * k_sc[..., None]).astype(q.dtype)
-        v_seq = (v_seq.astype(jnp.float32) * v_sc[..., None]).astype(q.dtype)
-    return decode_attention(q, k_seq, v_seq, pos)
+    return _gather_decode_paged(q, k_pool, v_pool, tables, pos,
+                                k_scale, v_scale)
+
+
+def ragged_decode(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                  tables: jax.Array, pos: jax.Array,
+                  impl: str = "auto", k_scale: jax.Array = None,
+                  v_scale: jax.Array = None) -> jax.Array:
+    """Dispatching RAGGED batched decode attention over a paged KV pool:
+    same shapes as ``paged_decode`` (q [B, Nq, D], pools [Nkv, NB, bs, D],
+    tables [B, MB], pos [B] -> [B, Nq, D]) but a different contract — the
+    caller passes each slot's FULL table row and TRUE position, never a
+    padded bucket window shared across the batch.
+
+    The Pallas path (ops/ragged_attention.py) grids over slots ×
+    KV blocks with all heads per program and clamps each slot onto its
+    own frontier, so one invocation serves the whole mixed-length batch
+    at per-slot cost and the batched engine compiles ONE decode program
+    for its life (no window-rung ladder, no per-rung compile churn).
+    The XLA path gathers the full table and masks by ``pos`` — the
+    portable fallback (default on CPU) and the byte-level correctness
+    reference the parity suite pins the kernel against.  ``k_scale``/
+    ``v_scale`` ([Nkv, NB, bs]) mark an int8 pool (ragged_decode_q8,
+    in-VMEM dequant on the Pallas path)."""
+    b, mb = tables.shape
+    bs = k_pool.shape[2]
+    if k_scale is None:
+        if _choose(impl, "ragged_decode", mb * bs) == "pallas":
+            from .ragged_attention import ragged_paged_decode_attention
+            return ragged_paged_decode_attention(q, k_pool, v_pool, tables,
+                                                 pos)
+    elif _choose(impl, "ragged_decode_q8", mb * bs) == "pallas":
+        from .ragged_attention import ragged_paged_decode_attention_q8
+        return ragged_paged_decode_attention_q8(q, k_pool, v_pool, k_scale,
+                                                v_scale, tables, pos)
+    return _gather_decode_paged(q, k_pool, v_pool, tables, pos,
+                                k_scale, v_scale)
 
 
 def paged_chunk(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,

@@ -394,6 +394,132 @@ def test_jit_purity_decorator_and_shard_map_roots():
     assert _rules(result).count("jit-host-impurity") == 2
 
 
+def test_jit_purity_pallas_kernel_blocking_host_callback_flagged():
+    """ISSUE 6: a Pallas KERNEL body is traced like any jit root (and a
+    blocking host callback inside one would wedge the whole device
+    program) — the checker must catch it, including through the repo
+    idiom of assigning ``partial(_kernel, ...)`` to a variable before
+    ``pl.pallas_call``."""
+    src = """
+        import functools
+        import time
+
+        from jax.experimental import pallas as pl
+
+
+        def _ragged_kernel(pos_ref, q_ref, o_ref, *, bs):
+            time.sleep(0.1)              # blocking host callback
+            o_ref[0] = q_ref[0]
+
+
+        def run(q, pos):
+            kernel = functools.partial(_ragged_kernel, bs=16)
+            return pl.pallas_call(kernel, grid=(4,))(pos, q)
+    """
+    result = _lint(JitPurityChecker(), {ENGINE: src})
+    assert _rules(result) == ["jit-host-impurity"], result.findings
+    assert "time.sleep" in result.findings[0].message
+
+
+def test_jit_purity_pallas_near_miss_host_timing_around_call_clean():
+    """Host-side timing AROUND a pallas_call (the micro A/B's own shape)
+    must not flag: only the kernel body is traced."""
+    src = """
+        import time
+
+        from jax.experimental import pallas as pl
+
+
+        def _kernel(q_ref, o_ref):
+            o_ref[0] = q_ref[0]
+
+
+        def bench(q):
+            t0 = time.perf_counter()     # host code: fine
+            out = pl.pallas_call(_kernel, grid=(1,))(q)
+            return out, time.perf_counter() - t0
+    """
+    assert _lint(JitPurityChecker(), {ENGINE: src}).findings == []
+
+
+def test_jit_purity_wrapper_call_inside_lambda_body_still_roots():
+    """A jit/pallas_call ISSUED inside a lambda body must keep rooting
+    its function argument (lambdas are not scope entries, so the scoped
+    walker has to descend into them — regression guard for the scoped
+    rewrite)."""
+    src = """
+        import time
+
+        import jax
+
+
+        def step(x):
+            time.sleep(1)
+            return x
+
+
+        run = lambda q: jax.jit(step)(q)
+    """
+    result = _lint(JitPurityChecker(), {ENGINE: src})
+    assert _rules(result) == ["jit-host-impurity"], result.findings
+    assert "time.sleep" in result.findings[0].message
+
+
+def test_jit_purity_pallas_variable_resolution_is_scoped():
+    """A host-only helper bound to the SAME variable name in a different
+    function must not be rooted as a kernel (module-wide name resolution
+    would produce a CI-blocking false impurity finding here)."""
+    src = """
+        import functools
+        import time
+
+        from jax.experimental import pallas as pl
+
+
+        def _kernel(q_ref, o_ref):
+            o_ref[0] = q_ref[0]
+
+
+        def _poll_host():
+            time.sleep(0.5)              # legitimate host code
+
+
+        def run(q):
+            fn = functools.partial(_kernel)
+            return pl.pallas_call(fn, grid=(1,))(q)
+
+
+        def wait_for_device():
+            fn = _poll_host               # same variable name, host scope
+            fn()
+    """
+    assert _lint(JitPurityChecker(), {ENGINE: src}).findings == []
+
+
+def test_jit_purity_covers_shipped_ragged_kernel_module():
+    """The real ops/ragged_attention.py kernels are in the checker's
+    jit-root coverage: injecting a host impurity into a kernel body of
+    the SHIPPED source must produce a finding (a module the checker
+    cannot see would pass this by linting nothing)."""
+    path = os.path.join(repo_root(),
+                        "distributed_llm_tpu/ops/ragged_attention.py")
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    marker = "m_ref[...] = jnp.full_like(m_ref, NEG_INF)"
+    assert marker in src, "kernel init marker moved — update this test"
+    bad = "import time\n" + src.replace(
+        marker, "time.sleep(0.0)\n        " + marker, 1)
+    rel = "distributed_llm_tpu/ops/ragged_attention.py"
+    result = run_checkers(
+        Project("/", {rel: Module(rel, bad)}), [JitPurityChecker()])
+    assert "jit-host-impurity" in _rules(result), result.findings
+    # And the pristine module lints clean (no false findings from the
+    # broadened root set).
+    clean = run_checkers(
+        Project("/", {rel: Module(rel, src)}), [JitPurityChecker()])
+    assert clean.findings == []
+
+
 # -- error shape -------------------------------------------------------------
 
 def test_error_shape_flags_drift():

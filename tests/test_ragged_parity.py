@@ -1,0 +1,315 @@
+"""Ragged paged decode (ISSUE 6): parity pins and engine rewire checks.
+
+The contract under test: the ragged fused decode tick — one
+``attention.ragged_decode`` call over every slot's FULL block-table row
+with true per-slot lengths — produces BYTE-IDENTICAL greedy output to
+the dense windowed path it replaces, across skewed lengths, at the
+``decode_batch`` boundaries (1 slot / full occupancy), on the int8-KV
+pool, and through a mid-decode preemption + replay (the PR 5
+interaction).  Op-level tests pin the Pallas kernel (interpreter mode —
+the exact code Mosaic compiles) against the XLA gather reference, and
+the compile-churn tests pin the one-decode-program property that is the
+tentpole's point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_tpu.config import tiny_batched_cluster
+from distributed_llm_tpu.engine.batching import ContinuousBatchingEngine
+from distributed_llm_tpu.ops import attention as A
+from distributed_llm_tpu.ops import ragged_attention as RA
+
+SHORT = "short question about rivers please"
+LONG = ("long question: " + "rivers lakes mountains oceans deltas " * 16)
+
+
+def _tier(**overrides):
+    base = dataclasses.replace(tiny_batched_cluster().nano,
+                               max_new_tokens=16,
+                               enable_prefix_cache=False)
+    return dataclasses.replace(base, **overrides)
+
+
+def _generate_all(tier, prompts, seed=0):
+    engine = ContinuousBatchingEngine(tier, seed=seed)
+    try:
+        reqs = [engine.submit(p) for p in prompts]
+        for r in reqs:
+            assert r.done.wait(timeout=120)
+        for r in reqs:
+            if r.error is not None:
+                raise r.error
+        return [tuple(r.result.token_ids) for r in reqs], engine._compiled
+    finally:
+        engine.stop()
+
+
+# -- op-level: kernel vs XLA gather reference --------------------------------
+
+def _pool_case(b=4, nq=8, nkv=4, d=16, bs=16, mb=8, dtype=jnp.float32):
+    key = jax.random.PRNGKey(0)
+    nb = b * mb + 1
+    q = jax.random.normal(key, (b, nq, d), dtype)
+    kp = jax.random.normal(key, (nkv, nb, bs, d), dtype)
+    vp = jax.random.normal(jax.random.PRNGKey(1), (nkv, nb, bs, d), dtype)
+    tables = jnp.asarray(
+        np.arange(1, b * mb + 1, dtype=np.int32).reshape(b, mb))
+    # Skewed per-slot lengths: 6, 38, 121, 127 of a 128-position span.
+    pos = jnp.asarray([5, 37, 120, 127][:b], jnp.int32)
+    return q, kp, vp, tables, pos
+
+
+def test_ragged_kernel_matches_xla_gather():
+    q, kp, vp, tables, pos = _pool_case()
+    want = A.ragged_decode(q, kp, vp, tables, pos, impl="xla")
+    got = RA.ragged_paged_decode_attention(q, kp, vp, tables, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_kernel_q8_matches_xla_dequant():
+    from distributed_llm_tpu.ops.quant import quantize_kv_rows
+    q, kp, vp, tables, pos = _pool_case()
+    kq, ks = quantize_kv_rows(kp)
+    vq, vs = quantize_kv_rows(vp)
+    want = A.ragged_decode(q, kq, vq, tables, pos, impl="xla",
+                           k_scale=ks, v_scale=vs)
+    got = RA.ragged_paged_decode_attention_q8(q, kq, vq, ks, vs, tables,
+                                              pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_kernel_honors_per_slot_frontier():
+    """Blocks past a slot's own length contribute nothing — perturbing
+    them must not change that slot's output (the per-slot TRUE-length
+    contract that distinguishes ragged from a padded shared window)."""
+    q, kp, vp, tables, pos = _pool_case()
+    base = RA.ragged_paged_decode_attention(q, kp, vp, tables, pos)
+    bs = kp.shape[2]
+    # Slot 0 sits at position 5 (block 0): poison its table's later block.
+    beyond = tables[0, (int(pos[0]) // bs) + 1]
+    kp2 = kp.at[:, beyond].set(99.0)
+    vp2 = vp.at[:, beyond].set(-99.0)
+    pert = RA.ragged_paged_decode_attention(q, kp2, vp2, tables, pos)
+    np.testing.assert_array_equal(np.asarray(base[0]), np.asarray(pert[0]))
+
+
+def test_ragged_xla_fallback_matches_dense_paged():
+    """The XLA fallbacks of ragged_decode and paged_decode are ONE code
+    path (the byte-level parity reference): same inputs, same bytes."""
+    q, kp, vp, tables, pos = _pool_case()
+    np.testing.assert_array_equal(
+        np.asarray(A.ragged_decode(q, kp, vp, tables, pos, impl="xla")),
+        np.asarray(A.paged_decode(q, kp, vp, tables, pos, impl="xla")))
+
+
+# -- dispatch registry --------------------------------------------------------
+
+def test_ragged_kinds_registered_and_covered():
+    assert "ragged_decode" in A.DISPATCH_KINDS
+    assert "ragged_decode_q8" in A.DISPATCH_KINDS
+    import json
+    with open(A._DISPATCH_PATH) as f:
+        table = json.load(f)["dispatch"]
+    assert "ragged_decode" in table and "default" in table["ragged_decode"]
+    assert "ragged_decode_q8" in table
+
+
+def test_dllm_ragged_env_override(monkeypatch):
+    monkeypatch.setenv("DLLM_RAGGED", "0")
+    eng = ContinuousBatchingEngine(_tier(), seed=0)
+    try:
+        assert eng.ragged is False
+    finally:
+        eng.stop()
+    monkeypatch.setenv("DLLM_RAGGED", "1")
+    eng = ContinuousBatchingEngine(_tier(attention_ragged=False), seed=0)
+    try:
+        assert eng.ragged is True
+    finally:
+        eng.stop()
+    monkeypatch.setenv("DLLM_RAGGED", "yes")
+    with pytest.raises(ValueError, match="DLLM_RAGGED"):
+        ContinuousBatchingEngine(_tier(), seed=0)
+
+
+# -- engine parity: ragged == dense, byte-identical ---------------------------
+
+def test_ragged_matches_dense_skewed_full_occupancy():
+    """Mixed short/long prompts at full decode_batch occupancy: the
+    ragged fused tick and the dense windowed tick emit identical greedy
+    tokens."""
+    prompts = [SHORT, LONG, SHORT + " again", LONG + " again",
+               SHORT, LONG]                     # > slots: queueing too
+    dense, dense_compiled = _generate_all(
+        _tier(attention_ragged=False), prompts)
+    ragged, ragged_compiled = _generate_all(
+        _tier(attention_ragged=True), prompts)
+    assert dense == ragged
+    # The tentpole property: ONE compiled decode program under ragged;
+    # the dense rung ladder needs more as windows cross buckets.
+    assert len(ragged_compiled.get("decode", ())) == 1
+    assert len(dense_compiled.get("decode", ())) >= 1
+
+
+def test_ragged_matches_dense_single_slot():
+    """decode_batch=1 boundary: a 1-slot batched engine still serves
+    through the fused ragged call."""
+    tier = _tier(decode_batch=1)
+    dense, _ = _generate_all(
+        dataclasses.replace(tier, attention_ragged=False), [LONG])
+    ragged, _ = _generate_all(
+        dataclasses.replace(tier, attention_ragged=True), [LONG])
+    assert dense == ragged
+
+
+def test_ragged_matches_dense_int8_kv():
+    """int8 pool boundary: ragged_decode_q8's XLA fallback dequantizes
+    byte-identically to the dense paged path."""
+    tier = _tier(kv_quantize="int8")
+    prompts = [SHORT, LONG, SHORT + " more"]
+    dense, _ = _generate_all(
+        dataclasses.replace(tier, attention_ragged=False), prompts)
+    ragged, _ = _generate_all(
+        dataclasses.replace(tier, attention_ragged=True), prompts)
+    assert dense == ragged
+
+
+def test_ragged_preempt_replay_byte_identical():
+    """PR 5 interaction: a mid-decode preemption + replay on the ragged
+    tick resumes byte-identically (the replayed slot's table row changes
+    wholesale — the cached full-table upload must be invalidated)."""
+    probe_a = "tell me about rivers and lakes and streams and oceans please"
+    probe_b = "what is the tallest mountain on the continent of asia today"
+    solo = ContinuousBatchingEngine(
+        _tier(decode_batch=2, max_new_tokens=24), seed=1)
+    try:
+        base_a = solo.generate(probe_a).text
+        base_b = solo.generate(probe_b).text
+        assert solo.ragged is True          # default-on covers the solo runs
+    finally:
+        solo.stop()
+    tight = ContinuousBatchingEngine(
+        _tier(decode_batch=2, max_new_tokens=24, kv_pool_blocks=5), seed=1)
+    res = {}
+    try:
+        threads = [threading.Thread(
+            target=lambda k, q: res.__setitem__(k, tight.generate(q)),
+            args=(k, q)) for k, q in (("a", probe_a), ("b", probe_b))]
+        threads[0].start()
+        time.sleep(0.02)
+        threads[1].start()
+        for t in threads:
+            t.join(timeout=120)
+        assert tight.preempted_total >= 1
+        assert res["a"].text == base_a
+        assert res["b"].text == base_b
+        assert tight.allocator.available == tight.paged.num_blocks - 1
+    finally:
+        tight.stop()
+
+
+# -- engine mechanics ---------------------------------------------------------
+
+def test_ragged_tick_reuses_cached_table_upload():
+    """Between table mutations the ragged tick reuses ONE device array
+    for the full tables (the dense path re-sliced host→device every
+    tick); any slot change invalidates the cache."""
+    eng = ContinuousBatchingEngine(_tier(), seed=0)
+    try:
+        real = eng._decode_step()
+        seen = []
+
+        def spy(params, pool, tables, pos, cur, temps, rng):
+            seen.append(tables)
+            return real(params, pool, tables, pos, cur, temps, rng)
+
+        eng._decode_fn = spy
+        eng.generate(SHORT, max_new_tokens=12)
+        assert len(seen) >= 2
+        # Consecutive ticks between mutations hand the SAME array object
+        # to the device call — no per-tick re-upload.
+        assert any(a is b for a, b in zip(seen, seen[1:])), (
+            "every tick re-uploaded the tables")
+        # And a table mutation invalidates the cache (the slot release at
+        # finish already exercised this path).
+        assert eng._tables_dev is None
+        eng._tables_dev = object()
+        eng._set_table_row(0, eng._table_row([]))
+        assert eng._tables_dev is None
+    finally:
+        eng.stop()
+
+
+def test_decode_tick_metrics_and_ring():
+    """The tick ring fills, and the obs counter attributes ticks to the
+    ragged dispatch kind + the impl the measured table chose."""
+    from distributed_llm_tpu.obs import get_observability
+    m = get_observability().m
+    eng = ContinuousBatchingEngine(_tier(), seed=0)
+    try:
+        before = m.decode_ticks.labels("nano", "ragged_decode", "xla").value
+        eng.generate(SHORT, max_new_tokens=8)
+        assert len(eng.tick_ms) >= 1
+        assert all(t >= 0.0 for t in eng.tick_ms)
+        after = m.decode_ticks.labels("nano", "ragged_decode", "xla").value
+        assert after > before
+        assert m.decode_tick_ms.labels("nano").count >= 1
+        # Compiled-program gauge mirrors the engine's churn surface.
+        gauge = m.compiled_programs.labels("nano", "decode")
+        assert gauge.value >= 1
+    finally:
+        eng.stop()
+
+
+def test_ragged_request_gated_by_measured_verdict_on_tpu(monkeypatch):
+    """On TPU, attention_ragged=True only runs fused when the measured
+    table says 'pallas' for ragged_decode at the pool span — shipping
+    the full-span XLA gather against a measured 'xla' verdict would be
+    a silent hot-path regression.  DLLM_RAGGED=1 forces past the gate
+    (the A/B's own measurement runs need that)."""
+    eng = ContinuousBatchingEngine(_tier(), seed=0)
+    try:
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.delenv("DLLM_RAGGED", raising=False)
+        # TPU unsharded tiers resolve 'pallas'; the committed table's
+        # conservative 'xla' row must demote the fused tick...
+        eng.cfg = dataclasses.replace(eng.cfg, attention_impl="pallas")
+        monkeypatch.setattr(A, "_DISPATCH_TABLE",
+                            {"ragged_decode": {"default": "xla"}})
+        assert eng._resolve_ragged() is False
+        # ...a measured 'pallas' row flips it with no code change...
+        monkeypatch.setattr(A, "_DISPATCH_TABLE",
+                            {"ragged_decode": {"default": "pallas"}})
+        assert eng._resolve_ragged() is True
+        # ...and the forced override wins for measurement runs.
+        monkeypatch.setattr(A, "_DISPATCH_TABLE",
+                            {"ragged_decode": {"default": "xla"}})
+        monkeypatch.setenv("DLLM_RAGGED", "1")
+        assert eng._resolve_ragged() is True
+    finally:
+        eng.stop()
+
+
+def test_tp_mesh_engine_stays_dense():
+    """A sharded tier never takes the ragged path (pallas_call has no
+    GSPMD rule; the TP hook is rung-specialized) even when the tier and
+    env ask for it."""
+    devs = np.array(jax.devices()[:2])
+    mesh = jax.sharding.Mesh(devs, ("tp",))
+    eng = ContinuousBatchingEngine(_tier(attention_ragged=True), seed=0,
+                                   mesh=mesh)
+    try:
+        assert eng.ragged is False
+    finally:
+        eng.stop()
