@@ -395,6 +395,18 @@ class TierConfig:
     # semantics where the Jetson keeps crunching after the client
     # times out.
     request_timeout_s: Optional[float] = 180.0
+    # Per-tier SLO targets (obs/slo.py, fed from the router's exactly-
+    # once _finish_request exit): a request is GOODPUT only when it
+    # completes ok with TTFT ≤ slo_ttft_ms and per-request p95
+    # time-between-tokens ≤ slo_tbt_ms.  The open-loop bench leg and the
+    # online dllm_slo_goodput gauges judge serving by these, and a tier
+    # whose windowed goodput collapses raises an overload incident into
+    # the flight recorder.  None disables that criterion (error-only
+    # goodput); DLLM_SLO_TTFT_MS / DLLM_SLO_TBT_MS override globally.
+    # Defaults are interactive-chat-shaped: first token within 2 s,
+    # no p95 inter-token stall past 200 ms.
+    slo_ttft_ms: Optional[float] = 2000.0
+    slo_tbt_ms: Optional[float] = 200.0
     # Decode-watchdog deadline (serving/health.py + engine/batching.py):
     # a batched engine with admitted/queued work but NO step progress
     # (tick completion, admission, or idle heartbeat) for this many

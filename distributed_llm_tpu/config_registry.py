@@ -81,6 +81,21 @@ ENV_VARS: Dict[str, EnvVar] = {v.name: v for v in (
     _e("DLLM_OBS_SLOW_MS", "30000", "obs/__init__.py",
        "Global flight-recorder slow-request threshold in ms; '0'/'off' "
        "disables the slow trigger (failed/degraded still record)."),
+    _e("DLLM_OBS_FLIGHT_CAPACITY", "32", "obs/__init__.py",
+       "Global flight-recorder ring size (failed/degraded/slow requests "
+       "plus overload incidents retained for GET /stats?debug=1)."),
+    _e("DLLM_OBS_SAMPLE_MS", "250", "serving/router.py",
+       "System-state sampler period in ms (obs/sampler.py timeline + "
+       "/metrics gauges); '0' disables the sampler thread."),
+    _e("DLLM_OBS_TIMELINE_SAMPLES", "240", "serving/router.py",
+       "System-state timeline ring size in samples (60 s of history at "
+       "the default 250 ms period)."),
+    _e("DLLM_SLO_TTFT_MS", None, "serving/router.py",
+       "Global TTFT SLO target override in ms for the goodput monitor "
+       "(obs/slo.py); unset = each tier's TierConfig.slo_ttft_ms."),
+    _e("DLLM_SLO_TBT_MS", None, "serving/router.py",
+       "Global p95 time-between-tokens SLO target override in ms "
+       "(obs/slo.py); unset = each tier's TierConfig.slo_tbt_ms."),
     _e("DLLM_FLAGSHIP_KV_INT8", None, "config.py",
        "'1' opts the single-chip flagship orin tier into int8 KV cache "
        "(measured ~break-even r5; default off, VERDICT r5 #4)."),
@@ -183,6 +198,10 @@ CONFIG_FIELDS: Dict[str, str] = {
     "TierConfig.request_timeout_s": "Per-request wall cap; past it the "
                                     "reference error shape returns and "
                                     "the worker is abandoned.",
+    "TierConfig.slo_ttft_ms": "TTFT SLO target (ms) for the goodput "
+                              "monitor; None disables the criterion.",
+    "TierConfig.slo_tbt_ms": "Per-request p95 time-between-tokens SLO "
+                             "target (ms); None disables the criterion.",
     "TierConfig.watchdog_stall_s": "Decode-watchdog deadline: pending "
                                    "work with no step progress for this "
                                    "long reads as wedged.",

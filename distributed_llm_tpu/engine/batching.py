@@ -37,6 +37,7 @@ import numpy as np
 from ..config import TierConfig
 from .. import models
 from ..models import transformer
+from ..obs import metrics as obs_metrics
 from ..obs import spans as obs_spans
 from ..serving.errors import error_dict
 from .inference import (GenerationResult, prepare_prompt, trim_at_eos,
@@ -1147,6 +1148,29 @@ class ContinuousBatchingEngine:
         if not has_work:
             return 0.0
         return max(0.0, time.monotonic() - self._progress_t)
+
+    def tick_stats(self) -> Dict[str, Any]:
+        """Decode-tick latency quantiles over the recent-tick ring
+        (``tick_ms``, maxlen 512) — the read API for the obs state
+        sampler and the bench skew/open-loop legs.  Advisory GIL-safe
+        read of a deque the scheduler thread appends to: a concurrent
+        append can abort one iteration pass (RuntimeError), so retry a
+        couple of times and report empty rather than block or raise —
+        a telemetry read must never synchronize with the decode loop."""
+        ticks: List[float] = []
+        for _ in range(3):
+            try:
+                ticks = sorted(self.tick_ms)
+                break
+            except RuntimeError:
+                continue
+        if not ticks:
+            return {"n": 0, "p50_ms": None, "p95_ms": None}
+
+        def pct(q: float) -> float:
+            return round(obs_metrics.nearest_rank(ticks, q), 3)
+
+        return {"n": len(ticks), "p50_ms": pct(0.5), "p95_ms": pct(0.95)}
 
     def slot_stats(self) -> Dict[str, Any]:
         """Live occupancy snapshot for health()/telemetry: queued

@@ -15,6 +15,7 @@ from .config_drift import ConfigDriftChecker
 from .error_shape import ErrorShapeChecker
 from .jit_purity import JitPurityChecker
 from .locks import LockChecker
+from .obs_discipline import ObsDisciplineChecker
 from .span_discipline import SpanDisciplineChecker
 
 
@@ -25,4 +26,5 @@ def all_checkers() -> List[Checker]:
         ErrorShapeChecker(),
         ConfigDriftChecker(),
         SpanDisciplineChecker(),
+        ObsDisciplineChecker(),
     ]

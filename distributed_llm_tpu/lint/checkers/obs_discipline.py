@@ -1,0 +1,82 @@
+"""Observability-feed discipline: the SLO monitor has ONE feed site.
+
+``SLOMonitor.record_request`` (obs/slo.py) counts a finished request
+into the sliding goodput windows.  Its correctness contract is
+exactly-once-per-request, which the serving stack gets structurally by
+feeding it ONLY from ``Router._finish_request`` — the single exit that
+already runs exactly once on every path of both pipelines (sync,
+stream, exception).  A second feed site anywhere in serving/ or
+engine/ would double-count requests, halve every goodput reading, and
+fire phantom overload incidents — and nothing at runtime would look
+obviously wrong.
+
+Rule ``slo-feed-outside-finish``: any call ``<...>.slo.record_request(...)``
+(or bare ``slo.record_request(...)``) in the instrumented layers must
+appear inside a function named ``_finish_request``.  Matching is
+receiver-chain-based (the chain must contain a ``slo`` segment), so an
+unrelated object's ``record_request`` method does not false-positive.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Checker, Finding, Project
+
+FEED_ATTR = "record_request"
+ALLOWED_FUNC = "_finish_request"
+
+
+def _chain(node: ast.expr) -> List[str]:
+    """Attribute-chain segments of a receiver, innermost-last
+    (``self.obs.slo`` -> ["slo", "obs", "self"])."""
+    out: List[str] = []
+    while isinstance(node, ast.Attribute):
+        out.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        out.append(node.id)
+    return out
+
+
+def _is_slo_feed(call: ast.Call) -> bool:
+    fn = call.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr == FEED_ATTR):
+        return False
+    return "slo" in _chain(fn.value)
+
+
+class ObsDisciplineChecker(Checker):
+    name = "obs_discipline"
+    rules = ("slo-feed-outside-finish",)
+    scope = ("distributed_llm_tpu/serving", "distributed_llm_tpu/engine")
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in project.in_dirs(self.scope):
+            if mod.tree is None:
+                continue
+            self._visit(mod.tree, None, mod.relpath, findings)
+        return findings
+
+    def _visit(self, node: ast.AST, func: Optional[str], path: str,
+               findings: List[Finding]) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_func = func
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_func = child.name
+            elif isinstance(child, ast.Lambda):
+                # A lambda inherits its enclosing function's identity: a
+                # feed hidden in a callback defined INSIDE
+                # _finish_request is still the sanctioned site.
+                child_func = func
+            if (isinstance(child, ast.Call) and _is_slo_feed(child)
+                    and func != ALLOWED_FUNC):
+                findings.append(Finding(
+                    "slo-feed-outside-finish", path, child.lineno,
+                    f"SLO feed `slo.{FEED_ATTR}(...)` outside "
+                    f"`{ALLOWED_FUNC}` — the goodput windows count "
+                    f"requests exactly once, on the router's single "
+                    f"completion exit; a second feed site double-counts"))
+            self._visit(child, child_func, path, findings)

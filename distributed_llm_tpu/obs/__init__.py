@@ -17,7 +17,7 @@ Router takes an ``observability=`` override so tests and bench legs can
 read from a registry no other traffic writes to.  ``DLLM_OBS_SLOW_MS``
 tunes the global recorder's slow threshold (ms; empty/unset = 30000;
 ``0`` or ``off`` disables the slow trigger — failed/degraded requests
-still record).
+still record); ``DLLM_OBS_FLIGHT_CAPACITY`` sizes its ring.
 """
 
 from __future__ import annotations
@@ -37,11 +37,17 @@ class Observability:
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  flight: Optional[FlightRecorder] = None,
-                 slow_ms: Optional[float] = 30000.0):
+                 slow_ms: Optional[float] = 30000.0,
+                 flight_capacity: Optional[int] = None):
+        from .recorder import DEFAULT_CAPACITY
         self.metrics = registry if registry is not None else MetricsRegistry()
         self.m = ServingMetrics(self.metrics)
         self.recorder = (flight if flight is not None
-                         else FlightRecorder(slow_ms=slow_ms))
+                         else FlightRecorder(
+                             capacity=(flight_capacity
+                                       if flight_capacity is not None
+                                       else DEFAULT_CAPACITY),
+                             slow_ms=slow_ms))
 
     def trace(self, name: str = "request", **attrs) -> RequestTrace:
         return RequestTrace(name, **attrs)
@@ -57,7 +63,7 @@ def get_observability() -> Observability:
     if _GLOBAL is None:
         with _GLOBAL_LOCK:
             if _GLOBAL is None:
-                from ..config_registry import env_str
+                from ..config_registry import env_int, env_str
                 raw = (env_str("DLLM_OBS_SLOW_MS", "") or "") \
                     .strip().lower()
                 slow_ms: Optional[float] = 30000.0
@@ -75,5 +81,8 @@ def get_observability() -> Observability:
                         # the post-mortems the ring exists to keep.
                         if slow_ms <= 0:
                             slow_ms = None
-                _GLOBAL = Observability(slow_ms=slow_ms)
+                _GLOBAL = Observability(
+                    slow_ms=slow_ms,
+                    flight_capacity=max(1, env_int(
+                        "DLLM_OBS_FLIGHT_CAPACITY", 32)))
     return _GLOBAL

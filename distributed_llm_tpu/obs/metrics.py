@@ -39,6 +39,23 @@ DEFAULT_BUCKETS_MS: Tuple[float, ...] = (
     1000, 2000, 5000, 10000, 20000, 60000, 120000)
 
 
+def nearest_rank(values: Iterable[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile over raw samples — rank
+    ``round(q * (n - 1))`` of the sorted values, None when empty.  The
+    ONE rank rule shared by the decode tick ring
+    (``ContinuousBatchingEngine.tick_stats``), the per-request TBT
+    cadence criterion (``RequestTrace.tbt_p95_ms``) and the open-loop
+    bench leg, so "p95" means the same thing in the sampler gauges, the
+    SLO verdicts, and the bench artifact.  (Histogram.quantile is the
+    OTHER estimator — bucket interpolation over the log ladder — used
+    where raw samples are not retained.)"""
+    vs = sorted(values)
+    if not vs:
+        return None
+    ix = min(len(vs) - 1, int(q * (len(vs) - 1) + 0.5))
+    return vs[ix]
+
+
 def _fmt(v: float) -> str:
     """Prometheus sample formatting: integers without a trailing .0."""
     if v == int(v):
@@ -354,6 +371,52 @@ class ServingMetrics:
             "minted, by stage (prefill|chunk_prefill|writer|decode) — "
             "decode pins at 1 under ragged attention; growth is logged",
             ("tier", "stage"))
+        # System-state timeline family (PR 7, obs/sampler.py): the
+        # background sampler mirrors its latest per-tier sample to these
+        # gauges so dashboards plot the same series the timeline ring
+        # stores.  The *_g attribute suffix keeps them apart from the
+        # identically-themed request-path counters above.
+        self.queue_depth_g = registry.gauge(
+            "dllm_queue_depth",
+            "Requests waiting beyond the tier's batch slots (sampled)",
+            ("tier",))
+        self.active_slots_g = registry.gauge(
+            "dllm_active_slots",
+            "Busy batch slots per tier (sampled)", ("tier",))
+        self.max_slots_g = registry.gauge(
+            "dllm_max_slots",
+            "Configured batch slots per tier (sampled)", ("tier",))
+        self.kv_free_blocks_g = registry.gauge(
+            "dllm_kv_free_blocks",
+            "Free paged-KV pool blocks per tier (sampled)", ("tier",))
+        self.kv_reclaimable_blocks_g = registry.gauge(
+            "dllm_kv_reclaimable_blocks",
+            "Pool blocks reclaimable by evicting parked prefixes "
+            "(sampled)", ("tier",))
+        self.tier_draining_g = registry.gauge(
+            "dllm_tier_draining",
+            "1 while the tier is gracefully draining, else 0 (sampled)",
+            ("tier",))
+        self.decode_tick_p50_g = registry.gauge(
+            "dllm_decode_tick_p50_ms",
+            "p50 decode-tick device time over the engine's recent-tick "
+            "ring (sampled)", ("tier",))
+        # SLO / goodput family (PR 7, obs/slo.py): fed from the router's
+        # exactly-once _finish_request exit (obs_discipline lint pins the
+        # single feed site).
+        self.slo_goodput = registry.gauge(
+            "dllm_slo_goodput",
+            "Sliding-window fraction of requests meeting the tier's SLO "
+            "(TTFT and p95 TBT targets)", ("strategy", "tier"))
+        self.slo_violations = registry.counter(
+            "dllm_slo_violations_total",
+            "Requests missing their SLO, by kind (error|ttft|tbt)",
+            ("kind",))
+        self.overload_incidents = registry.counter(
+            "dllm_overload_incidents_total",
+            "Rising-edge overload incidents (tier goodput under the "
+            "floor); each lands in the flight recorder with a timeline "
+            "slice", ("tier",))
 
 
 _BREAKER_STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}

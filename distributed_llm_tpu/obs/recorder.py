@@ -25,6 +25,12 @@ from typing import Any, Dict, List, Optional
 from .spans import RequestTrace
 
 DEFAULT_CAPACITY = 32
+# Incidents (obs/slo.py overload windows) keep a ring of their OWN: an
+# overload storm floods the request ring with hundreds of per-request
+# error entries in seconds, and the one record that EXPLAINS them — the
+# incident with its timeline slice — must not be evicted by its own
+# symptoms.
+INCIDENT_CAPACITY = 8
 
 
 class FlightRecorder:
@@ -34,6 +40,8 @@ class FlightRecorder:
         self.slow_ms = slow_ms
         self._lock = threading.Lock()
         self._ring: "deque[Dict[str, Any]]" = deque(maxlen=self.capacity)
+        self._incidents: "deque[Dict[str, Any]]" = deque(
+            maxlen=INCIDENT_CAPACITY)
         self.recorded_total = 0
 
     def classify(self, ok: bool, degraded: bool,
@@ -62,13 +70,47 @@ class FlightRecorder:
         if snapshot:
             entry["state"] = snapshot
         with self._lock:
-            self._ring.append(entry)
             self.recorded_total += 1
+            entry["seq"] = self.recorded_total   # capture order, ts ties
+            self._ring.append(entry)
+
+    # -- incident records (obs/slo.py overload lifecycle) ------------------
+
+    def record_incident(self, kind: str,
+                        info: Dict[str, Any]) -> Dict[str, Any]:
+        """Retain one traceless incident (e.g. an SLO overload window).
+        Returns the ring entry so the caller can finalize it in place
+        via ``update_incident`` when the incident closes — an incident
+        is recorded at its RISING edge (a process that dies mid-overload
+        must already have it on the post-mortem surface)."""
+        entry = {
+            "ts": round(time.time(), 3),
+            "reason": kind,
+            "incident": dict(info),
+        }
+        with self._lock:
+            self.recorded_total += 1
+            entry["seq"] = self.recorded_total
+            self._incidents.append(entry)
+        return entry
+
+    def update_incident(self, entry: Dict[str, Any], **info: Any) -> None:
+        """Finalize a live incident entry.  The ``incident`` value is
+        REPLACED (not mutated): a concurrent ``snapshot`` serializer
+        holding the old dict sees a complete earlier view, never a
+        half-updated one."""
+        with self._lock:
+            entry["incident"] = {**entry.get("incident", {}), **info}
 
     def snapshot(self) -> List[Dict[str, Any]]:
-        """Most-recent-first copy of the ring (the /stats?debug=1 body)."""
+        """Most-recent-first copy of BOTH rings, merged by timestamp
+        (the /stats?debug=1 body).  Shallow-copied entries: incident
+        finalization swaps top-level values on live entries, and a
+        serializer must not iterate a dict being rebound under it."""
         with self._lock:
-            return list(reversed(self._ring))
+            merged = list(self._ring) + list(self._incidents)
+        merged.sort(key=lambda e: e.get("seq", 0), reverse=True)
+        return [dict(e) for e in merged]
 
     def __len__(self) -> int:
         with self._lock:

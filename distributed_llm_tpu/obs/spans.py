@@ -222,6 +222,22 @@ class RequestTrace:
             return max(0.0, span_s * 1000.0 / (len(self.token_times) - 1))
         return None
 
+    def tbt_p95_ms(self) -> Optional[float]:
+        """Per-request p95 time-between-tokens — the SLO monitor's
+        cadence criterion (one long stall mid-stream breaks a user's
+        reading flow even when the MEAN looks fine).  Source: the
+        observed token timeline's inter-token gaps when ≥3 stamps exist
+        (tick-granular on the batched engine — a tick's T tokens land
+        together, so the gaps measured are the gaps a stream consumer
+        actually sees); fallback: the mean TBT (engine-true when
+        annotated).  None when neither exists."""
+        times = self.token_times
+        if len(times) >= 3:
+            from .metrics import nearest_rank
+            gaps = [(b - a) * 1000.0 for a, b in zip(times, times[1:])]
+            return max(0.0, nearest_rank(gaps, 0.95))
+        return self.tbt_ms()
+
     def to_dict(self) -> Dict[str, Any]:
         with self._lock:
             attrs = dict(self.attrs)

@@ -376,7 +376,35 @@ def create_app(router: Optional[Router] = None,
                         if getattr(router_, "breaker", None) is not None
                         else None),
             "degraded_served": getattr(router_, "degraded_served", 0),
+            # Degradation cause in ONE call: per-tier draining flags next
+            # to the breaker states, and the SLO monitor's windowed
+            # goodput + incident state (obs/slo.py) — an operator seeing
+            # goodput collapse reads WHY (circuit open? draining? queue?)
+            # without a second scrape.
+            "draining": {
+                name: bool(getattr(t.server_manager, "draining", False))
+                for name, t in router_.tiers.items()},
+            "slo": (router_.slo.snapshot()
+                    if getattr(router_, "slo", None) is not None
+                    else None),
         }
+        if request.args.get("timeline") == "1":
+            # The system-state timeline ring (obs/sampler.py): per-tier
+            # queue/slot/KV/breaker/tick trajectory at the sampler's
+            # cadence — samples once on demand for an idle router.
+            fn = getattr(router_, "timeline_snapshot", None)
+            payload["timeline"] = fn() if callable(fn) else []
+            sampler = getattr(router_, "sampler", None)
+            if sampler is not None:
+                payload["timeline_meta"] = {
+                    "period_s": sampler.period_s,
+                    "capacity": sampler.capacity,
+                    "samples_total": sampler.samples_total,
+                    "sample_cost_ms": (round(sampler.sample_cost_ms, 4)
+                                       if sampler.sample_cost_ms is not None
+                                       else None),
+                    "running": sampler.running,
+                }
         if request.args.get("debug") == "1":
             obs = getattr(router_, "obs", None)
             if obs is not None:

@@ -692,6 +692,50 @@ def test_span_discipline_near_miss_with_item():
     assert _lint(SpanDisciplineChecker(), {SERVING: src}).findings == []
 
 
+# -- obs discipline (SLO feed has ONE site) ----------------------------------
+
+def test_obs_discipline_flags_slo_feed_outside_finish():
+    """A second SLOMonitor.record_request site in the instrumented
+    layers double-counts requests and halves every goodput reading —
+    flagged anywhere but _finish_request."""
+    from distributed_llm_tpu.lint.checkers.obs_discipline import \
+        ObsDisciplineChecker
+    bad = """
+        class Router:
+            def _finish_request(self, trace, which, ok):
+                self.slo.record_request("hybrid", which, ok)   # sanctioned
+
+            def route_query(self, history):
+                self.slo.record_request("hybrid", "nano", True)
+
+        def helper(obs):
+            obs.slo.record_request("perf", "orin", False)
+    """
+    result = _lint(ObsDisciplineChecker(), {SERVING: bad})
+    assert _rules(result) == ["slo-feed-outside-finish"] * 2
+    assert all("_finish_request" in f.message for f in result.findings)
+
+
+def test_obs_discipline_near_miss_unrelated_record_request():
+    """Precision: a non-SLO object's record_request method, and the
+    sanctioned feed inside _finish_request (including via a callback
+    defined there), must stay silent."""
+    from distributed_llm_tpu.lint.checkers.obs_discipline import \
+        ObsDisciplineChecker
+    src = """
+        class AccessLog:
+            def flush(self):
+                self.log.record_request("GET /chat")     # not an SLO feed
+
+        class Router:
+            def _finish_request(self, trace, which, ok):
+                self.obs.slo.record_request("s", which, ok)
+                retry = lambda: self.slo.record_request("s", which, ok)
+                return retry
+    """
+    assert _lint(ObsDisciplineChecker(), {SERVING: src}).findings == []
+
+
 # -- suppression machinery ---------------------------------------------------
 
 def test_suppression_with_justification_silences_finding():

@@ -381,6 +381,348 @@ def test_span_discipline_pass_is_clean():
 
 # -- overhead budget ---------------------------------------------------------
 
+def test_histogram_quantile_edge_cases():
+    """Satellite pin (ISSUE 7): empty, single-bucket, and over-top-bucket
+    observations must produce sane estimates, not crashes or garbage."""
+    from distributed_llm_tpu.obs.metrics import Histogram
+    h = Histogram(buckets=(1, 10, 100))
+    for q in (0.0, 0.5, 0.95, 1.0):
+        assert h.quantile(q) is None            # empty at every q
+    single = Histogram(buckets=(10,))
+    single.observe(5)
+    q50 = single.quantile(0.5)
+    assert 0 <= q50 <= 10                       # interpolates inside (0,10]
+    assert single.quantile(1.0) == 10
+    over = Histogram(buckets=(1, 10))
+    over.observe(5000)                          # lands in +Inf only
+    assert over.quantile(0.5) == 10             # clamps to top finite bound
+    assert over.quantile(0.99) == 10
+    assert over.count == 1 and over.counts[-1] == 1
+
+
+# -- system-state sampler ----------------------------------------------------
+
+def test_sampler_ring_bounds_and_gauge_export():
+    from distributed_llm_tpu.obs.sampler import SystemStateSampler
+    obs = Observability(slow_ms=None)
+    calls = [0]
+
+    def collect():
+        calls[0] += 1
+        return {"nano": {"queue_depth": calls[0], "active_slots": 1,
+                         "max_slots": 4, "draining": False}}
+
+    s = SystemStateSampler(collect, metrics=obs.m, period_s=0.02,
+                           capacity=8)
+    for _ in range(20):
+        s.sample_once()
+    assert len(s) == 8                          # ring bound holds
+    snap = s.snapshot()
+    assert snap[0]["tiers"]["nano"]["queue_depth"] == 13  # oldest kept
+    assert snap[-1]["tiers"]["nano"]["queue_depth"] == 20
+    assert s.tail(3) == snap[-3:]
+    assert s.slice_since(snap[-2]["ts"])[-1] is not None
+    # Latest sample mirrored to the gauges.
+    assert obs.metrics.get("dllm_queue_depth").labels("nano").value == 20
+    assert obs.metrics.get("dllm_tier_draining").labels("nano").value == 0
+
+
+def test_sampler_thread_is_daemon_and_stops_cleanly():
+    from distributed_llm_tpu.obs.sampler import SystemStateSampler
+    s = SystemStateSampler(lambda: {"nano": {"queue_depth": 0}},
+                           period_s=0.01)
+    s.start()
+    assert s.running
+    assert s._thread.daemon                     # must never block exit
+    deadline = time.time() + 2.0
+    while s.samples_total < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    assert s.samples_total >= 3
+    s.stop(timeout_s=2.0)
+    assert not s.running
+    s.start()                                   # restartable after stop
+    assert s.running
+    s.stop(timeout_s=2.0)
+    assert not s.running
+
+
+def test_router_drain_stops_sampler_thread():
+    r = Router(strategy="heuristic", benchmark_mode=True,
+               cluster=_cluster(), observability=_obs())
+    try:
+        r.route_query(HIST)                     # lazy sampler start
+        assert r.sampler is not None and r.sampler.running
+        assert r.sampler._thread.daemon
+        r.drain(timeout_s=5.0)
+        assert not r.sampler.running
+    finally:
+        _stop(r)
+
+
+def test_sampler_overhead_within_observability_budget():
+    """Acceptance (ISSUE 7): sampling a LIVE router's state must stay
+    inside the PR 3 < 1 ms observability budget — the sampler reads only
+    lock-free in-memory counters, so one sample is microseconds."""
+    obs = _obs()
+    r = Router(strategy="heuristic", benchmark_mode=True,
+               cluster=_cluster(), observability=obs)
+    try:
+        r.route_query(HIST)                     # engines live, state real
+        sampler = r.sampler
+        assert sampler is not None
+        n = 100
+        t0 = time.perf_counter()
+        for _ in range(n):
+            sampler.sample_once()
+        per_sample_ms = (time.perf_counter() - t0) * 1000.0 / n
+        assert per_sample_ms < 1.0, f"{per_sample_ms:.3f} ms per sample"
+        assert sampler.sample_cost_ms is not None
+    finally:
+        _stop(r)
+
+
+# -- SLO monitor -------------------------------------------------------------
+
+def test_slo_monitor_goodput_and_violation_kinds():
+    from distributed_llm_tpu.obs.slo import SLOMonitor
+    obs = Observability(slow_ms=None)
+    mon = SLOMonitor({"nano": (100.0, 10.0)}, metrics=obs.m)
+    assert mon.record_request("hybrid", "nano", ok=True, ttft_ms=50.0,
+                              tbt_p95_ms=5.0) is True
+    assert mon.record_request("hybrid", "nano", ok=True,
+                              ttft_ms=150.0) is False       # ttft miss
+    assert mon.record_request("hybrid", "nano", ok=True, ttft_ms=50.0,
+                              tbt_p95_ms=20.0) is False     # tbt miss
+    assert mon.record_request("hybrid", "nano", ok=False) is False
+    # A cache hit has no engine latency to judge — it is goodput.
+    assert mon.record_request("hybrid", "nano", ok=True,
+                              cache_hit=True) is True
+    # Missing targets / missing measurements skip the criterion.
+    assert mon.record_request("hybrid", "orin", ok=True,
+                              ttft_ms=9999.0) is True       # no targets
+    assert mon.violations == {"error": 1, "ttft": 1, "tbt": 1}
+    assert mon.goodput("hybrid", "nano") == pytest.approx(2 / 5)
+    assert mon.goodput(tier="nano") == pytest.approx(2 / 5)
+    assert mon.goodput() == pytest.approx(3 / 6)
+    assert obs.metrics.get("dllm_slo_goodput").labels(
+        "hybrid", "nano").value == pytest.approx(2 / 5)
+    assert obs.metrics.get("dllm_slo_violations_total").labels(
+        "ttft").value == 1
+    snap = mon.snapshot()
+    assert snap["goodput"]["hybrid"]["nano"] == pytest.approx(0.4)
+    assert snap["targets"]["nano"] == {"slo_ttft_ms": 100.0,
+                                       "slo_tbt_ms": 10.0}
+
+
+def test_slo_overload_incident_lifecycle_with_timeline():
+    """Rising edge opens ONE incident (flight-recorded immediately, with
+    the sampler timeline slice and peak queue depth); recovery closes it
+    in place with end/duration.  No re-trigger while active."""
+    from distributed_llm_tpu.obs.slo import SLOMonitor
+    obs = Observability(slow_ms=None)
+    timeline = [{"ts": time.time(),
+                 "tiers": {"nano": {"queue_depth": 7}}}]
+    mon = SLOMonitor({"nano": (100.0, None)}, metrics=obs.m,
+                     recorder=obs.recorder, timeline=lambda: timeline,
+                     window=8, min_samples=4, goodput_floor=0.5,
+                     recover_margin=0.1)
+    for _ in range(6):                          # collapse goodput
+        mon.record_request("perf", "nano", ok=False)
+    assert mon.incidents_total == 1             # rising edge, once
+    entries = [e for e in obs.recorder.snapshot()
+               if e["reason"] == "overload"]
+    assert len(entries) == 1
+    inc = entries[0]["incident"]
+    assert inc["tier"] == "nano" and inc["open"] is True
+    assert inc["peak_queue_depth"] == 7
+    assert inc["timeline"] == timeline
+    assert obs.metrics.get("dllm_overload_incidents_total").labels(
+        "nano").value == 1
+    for _ in range(8):                          # recover past the margin
+        mon.record_request("perf", "nano", ok=True, ttft_ms=10.0)
+    assert mon.incidents_total == 1
+    snap = mon.snapshot()
+    assert snap["active_incidents"] == {}
+    closed = snap["recent_incidents"][0]
+    assert closed["open"] is False and "end_unix" in closed
+    assert closed["duration_s"] >= 0
+    # The flight entry was finalized IN PLACE.
+    entries = [e for e in obs.recorder.snapshot()
+               if e["reason"] == "overload"]
+    assert entries[0]["incident"]["open"] is False
+
+
+def test_incident_open_close_race_placeholder_not_closable():
+    """A recovered request racing the incident OPEN (goodput back above
+    floor + margin while ``_open_incident`` is still building the
+    recorder entry) must not take the closing branch against the
+    reserved placeholder — that would finalize a throwaway dict, push a
+    malformed history record, and leave the real flight entry open
+    forever.  The close instead defers to the first feed after the open
+    lands."""
+    from distributed_llm_tpu.obs.slo import SLOMonitor
+    obs = Observability(slow_ms=None)
+    mon = None
+    raced = {"done": False}
+
+    def timeline():
+        # Runs INSIDE _open_incident — exactly the window where the
+        # placeholder is parked in _active.  Simulate concurrent
+        # recovered requests pushing goodput past floor + margin.
+        if not raced["done"]:
+            raced["done"] = True
+            for _ in range(8):
+                mon.record_request("perf", "nano", ok=True, ttft_ms=10.0)
+        return []
+
+    mon = SLOMonitor({"nano": (100.0, None)}, metrics=obs.m,
+                     recorder=obs.recorder, timeline=timeline,
+                     window=8, min_samples=4, goodput_floor=0.5,
+                     recover_margin=0.1)
+    for _ in range(4):                          # exactly the opening edge
+        mon.record_request("perf", "nano", ok=False)
+    assert mon.incidents_total == 1
+    # The racing recovered requests closed NOTHING: no malformed history
+    # record, and the one flight entry is the real one, still open.
+    snap = mon.snapshot()
+    assert snap["recent_incidents"] == []
+    entries = [e for e in obs.recorder.snapshot()
+               if e["reason"] == "overload"]
+    assert len(entries) == 1
+    assert entries[0]["incident"]["open"] is True
+    assert entries[0]["incident"]["tier"] == "nano"
+    # The first feed AFTER the open landed closes the real entry.
+    mon.record_request("perf", "nano", ok=True, ttft_ms=10.0)
+    assert [e for e in obs.recorder.snapshot()
+            if e["reason"] == "overload"][0]["incident"]["open"] is False
+    closed = mon.snapshot()["recent_incidents"][0]
+    assert closed["tier"] == "nano" and "start_unix" in closed
+
+
+def test_incident_ring_survives_request_error_flood():
+    """An overload storm floods the request ring with per-request error
+    entries; the incident that EXPLAINS them must survive (own ring)."""
+    rec = FlightRecorder(capacity=4, slow_ms=None)
+    entry = rec.record_incident("overload", {"tier": "nano"})
+    for i in range(50):
+        tr = RequestTrace(i=i)
+        tr.finish()
+        rec.record("error", tr)
+    snap = rec.snapshot()
+    assert [e for e in snap if e["reason"] == "overload"]
+    rec.update_incident(entry, open=False, end_unix=1.0)
+    snap = rec.snapshot()
+    inc = [e for e in snap if e["reason"] == "overload"][0]["incident"]
+    assert inc["open"] is False
+
+
+def test_router_slo_feed_and_stats_surfaces():
+    """Router integration: the exactly-once _finish_request exit feeds
+    the SLO monitor, and GET /stats surfaces goodput + per-tier draining
+    (one call = degradation cause); ?timeline=1 dumps the sampler ring."""
+    from distributed_llm_tpu.serving.app import create_app
+    obs = _obs()
+    r = Router(strategy="heuristic", benchmark_mode=True,
+               cluster=_cluster(), observability=obs)
+    app = create_app(router=r)
+    client = app.test_client()
+    try:
+        resp = client.post("/chat", json={"message": "hello there",
+                                          "strategy": "heuristic"})
+        assert resp.status_code == 200
+        assert r.slo.observed_total == 1
+        stats = client.get("/stats").get_json()
+        assert stats["slo"]["observed_total"] == 1
+        assert stats["slo"]["goodput"]["heuristic"]
+        assert set(stats["draining"]) == {"nano", "orin"}
+        assert stats["draining"]["nano"] is False
+        assert "timeline" not in stats          # opt-in dump
+        timed = client.get("/stats?timeline=1").get_json()
+        assert isinstance(timed["timeline"], list) and timed["timeline"]
+        sample = timed["timeline"][-1]
+        assert "ts" in sample and "nano" in sample["tiers"]
+        assert timed["timeline_meta"]["capacity"] >= 8
+        # /metrics exports the SLO gauge family.
+        text = client.get("/metrics").text
+        assert "# TYPE dllm_slo_goodput gauge" in text
+        assert 'dllm_slo_goodput{strategy="heuristic"' in text
+    finally:
+        _stop(r)
+
+
+def test_slo_targets_env_override(monkeypatch):
+    monkeypatch.setenv("DLLM_SLO_TTFT_MS", "123.5")
+    monkeypatch.setenv("DLLM_SLO_TBT_MS", "7")
+    r = Router(strategy="heuristic", benchmark_mode=True,
+               cluster=_cluster(), observability=_obs())
+    try:
+        assert r.slo.targets_for("nano") == (123.5, 7.0)
+        assert r.slo.targets_for("orin") == (123.5, 7.0)
+    finally:
+        _stop(r)
+
+
+def test_trace_tbt_p95_from_token_timeline():
+    tr = RequestTrace()
+    t0 = time.perf_counter()
+    # Synthetic timeline: nine 1 ms gaps and one 50 ms stall.
+    tr.token_times.extend([t0 + 0.001 * i for i in range(10)])
+    tr.token_times.append(tr.token_times[-1] + 0.050)
+    p95 = tr.tbt_p95_ms()
+    assert p95 == pytest.approx(50.0, rel=0.05)  # the stall, not the mean
+    assert tr.tbt_ms() < p95
+    # Fallback: too few stamps → the mean estimate.
+    short = RequestTrace()
+    short.annotate(ttft_ms=5.0, total_ms=25.0, gen_tokens=11)
+    assert short.tbt_p95_ms() == pytest.approx(2.0)
+
+
+# -- open-loop harness (bench/openloop.py mechanics) -------------------------
+
+def test_openloop_rate_point_and_knee_rule():
+    """One cheap open-loop rate point against the tiny sequential tiers
+    through the real HTTP edge (schema + goodput accounting), plus the
+    knee rule on synthetic sweeps — the full adaptive sweep runs in the
+    bench leg, not tier-1."""
+    from distributed_llm_tpu.bench.openloop import (_find_knee,
+                                                    _run_rate_point)
+    from distributed_llm_tpu.serving.app import create_app
+    obs = Observability(slow_ms=None)
+    r = Router(strategy="heuristic", benchmark_mode=True,
+               cluster=_cluster(), observability=obs)
+    app = create_app(router=r)
+    client = app.test_client()
+    try:
+        queries = [{"query": "hello there"}, {"query": "what is water"}]
+        point = _run_rate_point(client, r, queries, "heuristic",
+                                rate_req_per_s=6.0, duration_s=1.0,
+                                label="t")
+        assert point["arrivals"] >= 1
+        assert point["completed"] == point["arrivals"]
+        assert point["hung_clients"] == 0
+        assert point["availability"] == 1.0
+        assert point["goodput_req_per_s"] >= 0
+        assert 0 <= (point["slo_attainment"] or 0) <= 1
+    finally:
+        _stop(r)
+    sweep = [
+        {"offered_req_per_s": 5.0, "goodput_req_per_s": 5.0,
+         "slo_attainment": 1.0},
+        {"offered_req_per_s": 10.0, "goodput_req_per_s": 9.8,
+         "slo_attainment": 0.97},
+        {"offered_req_per_s": 20.0, "goodput_req_per_s": 11.0,
+         "slo_attainment": 0.55},
+    ]
+    knee = _find_knee(sweep)
+    assert knee["knee_req_per_s"] == 10.0
+    assert knee["goodput_at_knee"] == 9.8
+    # No point attains → max-goodput point, flagged.
+    bad = _find_knee([dict(p, slo_attainment=0.5) for p in sweep])
+    assert bad["slo_attainment_below_target_at_all_rates"] is True
+    assert bad["knee_req_per_s"] == 20.0
+    assert _find_knee([])["knee_req_per_s"] is None
+
+
 def test_instrumentation_overhead_under_budget():
     """Acceptance: < 1 ms instrumentation per request.  Simulate a full
     request's worth of tracing+metrics work (trace, 6 spans, 2 events,

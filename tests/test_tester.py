@@ -128,6 +128,12 @@ def test_stats_endpoint_exposes_phases_and_cache():
     assert d["strategy"] == "heuristic"
     assert d["sessions"] == 1
     assert set(d["tiers"]) == {"nano", "orin"}
+    # Degradation cause in one call (ISSUE 7): per-tier draining flags
+    # and the SLO monitor's goodput snapshot ride next to the breaker.
+    assert set(d["draining"]) == {"nano", "orin"}
+    assert d["draining"]["nano"] is False
+    assert d["slo"]["observed_total"] >= 1
+    assert "goodput" in d["slo"] and "violations" in d["slo"]
     used = [t for t in d["tiers"].values() if t.get("phases")]
     assert used, "at least one tier should have phase timings"
     phases = used[0]["phases"]
