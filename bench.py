@@ -870,6 +870,16 @@ def skew_phase(n_requests: int = 32, beat=lambda: None) -> dict:
             token_ids[mode] = [tuple(r.result.token_ids)
                                for r in reqs if r.result is not None]
             ticks = list(eng.tick_ms)
+            # The dllm_compiled_programs gauge is the RUNTIME half of
+            # the one-decode-program invariant (the retrace lint is the
+            # static half): read it off the live registry so the leg
+            # pins what /metrics would actually have served.
+            try:
+                from distributed_llm_tpu.obs import get_observability
+                gauge = get_observability().m.compiled_programs.labels(
+                    tier.name, "decode").value
+            except Exception:
+                gauge = None
             out[mode] = {
                 "req_per_s": round(n_requests / max(wall, 1e-9), 4),
                 "decode_tick_p50_ms": pct(ticks, 0.50),
@@ -878,6 +888,7 @@ def skew_phase(n_requests: int = 32, beat=lambda: None) -> dict:
                 "errors": errors,
                 "compiled_decode_programs":
                     len(eng._compiled.get("decode", ())),
+                "compiled_programs_gauge": gauge,
                 "attention_impl": eng.cfg.attention_impl,
                 "attention_ragged": eng.ragged,
             }
@@ -886,6 +897,19 @@ def skew_phase(n_requests: int = 32, beat=lambda: None) -> dict:
         beat()
     if prior_ragged is not None:
         os.environ["DLLM_RAGGED"] = prior_ragged
+    # HARD invariant, failed not logged (ISSUE 8): the ragged engine
+    # compiles exactly ONE decode program for its whole life, and the
+    # gauge agrees — a retrace hazard that slipped past the static
+    # checker fails the leg here, from the runtime side.
+    rg = out.get("ragged") or {}
+    if rg and not rg.get("errors"):
+        programs = rg.get("compiled_decode_programs")
+        gauge = rg.get("compiled_programs_gauge")
+        if programs != 1 or (gauge is not None and gauge != 1.0):
+            out["error"] = (
+                f"decode compile churn: ragged minted {programs} "
+                f"program(s), dllm_compiled_programs gauge read "
+                f"{gauge} — the one-program invariant is broken")
     d50 = (out.get("dense") or {}).get("decode_tick_p50_ms")
     r50 = (out.get("ragged") or {}).get("decode_tick_p50_ms")
     if d50 and r50:

@@ -78,6 +78,12 @@ ENV_VARS: Dict[str, EnvVar] = {v.name: v for v in (
     _e("DLLM_PEAK_HBM", None, "utils/roofline.py",
        "Peak HBM bytes/s for roofline accounting (float); unset = the "
        "v5e peak constant in utils/roofline.py."),
+    _e("DLLM_LINT_CHANGED", "HEAD", "lint/__main__.py",
+       "Base git ref for `scripts/lint.sh --changed` (dllm-lint's "
+       "diff-scoped mode): per-file checkers report only findings in "
+       "files changed vs this ref; whole-project checkers (locks, "
+       "retrace, transfer, thread_lifecycle, config_drift) auto-widen "
+       "to full reporting because their verdicts cross files."),
     _e("DLLM_OBS_SLOW_MS", "30000", "obs/__init__.py",
        "Global flight-recorder slow-request threshold in ms; '0'/'off' "
        "disables the slow trigger (failed/degraded still record)."),

@@ -1,18 +1,30 @@
 """dllm-lint: the repo's AST-based static-analysis suite.
 
-``python -m distributed_llm_tpu.lint`` runs five checkers over the
-project (no jax import, CPU-only, sub-second):
+``python -m distributed_llm_tpu.lint`` runs nine checkers over the
+project (no jax import, CPU-only, a few seconds), sharing ONE parsed
+AST per file and ONE whole-project call graph per run
+(symbols.ProjectSymbols — import-aware cross-module resolution):
 
-- ``locks``            lock-discipline / race detector (PR 2's bug class)
-- ``jit_purity``       host impurity inside jit/pjit/shard_map traces
-- ``error_shape``      reference error-dict conformance (parity surface)
-- ``config_drift``     DLLM_* env vars + config fields vs the registry
-- ``span_discipline``  span enter/exit pairing (PR 3, migrated from
-                       scripts/check_span_discipline.py)
+- ``locks``             lock-discipline / race detector (PR 2's bug
+                        class), blocking-ness propagated CROSS-MODULE
+- ``jit_purity``        host impurity inside jit/pjit/shard_map traces
+- ``error_shape``       reference error-dict conformance (parity surface)
+- ``config_drift``      DLLM_* env vars + config fields vs the registry
+- ``span_discipline``   span enter/exit pairing (PR 3)
+- ``obs_discipline``    the SLO monitor's single-feed-site contract
+- ``retrace``           compile-churn hazards at jit/pallas roots — the
+                        static half of PR 6's one-decode-program pin
+- ``transfer``          host↔device sync/round-trip discipline on
+                        ``# dllm-lint: hot-path``-annotated roots
+- ``thread_lifecycle``  non-daemon threads without a drain-reachable
+                        join, acquire() without exception-safe release,
+                        module-scope thread owners without a stop hook
 
 Suppression: ``# dllm-lint: disable=<rule> -- <justification>`` (line or
 file scope via ``disable-file``); the justification is mandatory and
-enforced.  Wired into tier-1 by tests/test_lint.py.
+enforced.  ``scripts/lint.sh --changed`` scopes reporting to the git
+diff (whole-project checkers auto-widen).  Wired into tier-1 by
+tests/test_lint.py.
 """
 
 from __future__ import annotations

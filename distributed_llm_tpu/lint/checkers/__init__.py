@@ -16,7 +16,10 @@ from .error_shape import ErrorShapeChecker
 from .jit_purity import JitPurityChecker
 from .locks import LockChecker
 from .obs_discipline import ObsDisciplineChecker
+from .retrace import RetraceChecker
 from .span_discipline import SpanDisciplineChecker
+from .thread_lifecycle import ThreadLifecycleChecker
+from .transfer import TransferChecker
 
 
 def all_checkers() -> List[Checker]:
@@ -27,4 +30,7 @@ def all_checkers() -> List[Checker]:
         ConfigDriftChecker(),
         SpanDisciplineChecker(),
         ObsDisciplineChecker(),
+        RetraceChecker(),
+        TransferChecker(),
+        ThreadLifecycleChecker(),
     ]

@@ -53,6 +53,10 @@ class ConfigDriftChecker(Checker):
     # The whole default project: bench.py, scripts, conftest included.
     scope = ("distributed_llm_tpu", "scripts", "bench.py",
              "tests/conftest.py")
+    # An edit anywhere can strand a registry entry (delete the last
+    # reader) — the finding then lands in the UNCHANGED registry file,
+    # so --changed must not drop it.
+    whole_project = True
 
     def check(self, project: Project) -> List[Finding]:
         findings: List[Finding] = []

@@ -38,30 +38,12 @@ import ast
 from typing import Dict, List, Optional, Set
 
 from ..core import Checker, Finding, Project
-from ..symbols import attr_chain, call_name, symbols_for
+from ..symbols import (JIT_WRAPPERS, attr_chain, call_name, jit_roots_for,
+                       symbols_for, unwrap_partial as _unwrap_partial,
+                       wrapper_leaf as _wrapper_leaf)
 
-JIT_WRAPPERS = {"jit", "pjit", "shard_map", "pallas_call"}
 CONCRETIZERS = {"bool", "int", "float", "len"}
 CONCRETIZE_METHODS = {"item", "tolist"}
-
-
-def _wrapper_leaf(node: ast.expr) -> Optional[str]:
-    """'jit' for jax.jit / jit, 'shard_map' for jax.shard_map, etc."""
-    chain = attr_chain(node)
-    if chain is None:
-        return None
-    leaf = chain.rsplit(".", 1)[-1]
-    return leaf if leaf in JIT_WRAPPERS else None
-
-
-def _unwrap_partial(node: ast.expr) -> ast.expr:
-    """partial(f, ...) -> f (functools.partial / partial)."""
-    if isinstance(node, ast.Call):
-        leaf = attr_chain(node.func)
-        if leaf is not None and leaf.rsplit(".", 1)[-1] == "partial":
-            if node.args:
-                return node.args[0]
-    return node
 
 
 class _ImportMap(ast.NodeVisitor):
@@ -101,89 +83,12 @@ class JitPurityChecker(Checker):
 
     def _check_module(self, mod, syms) -> List[Finding]:
         imports = _ImportMap(mod.tree)
-        roots: Set[str] = set()
-        lambda_roots: List[ast.Lambda] = []
-
-        # Decorator roots.
-        for qual, info in syms.functions.items():
-            node = info.node
-            for deco in getattr(node, "decorator_list", []):
-                target = deco
-                if isinstance(deco, ast.Call):
-                    if _wrapper_leaf(deco.func) is not None:
-                        roots.add(qual)
-                        continue
-                    # @partial(jax.jit, ...) / @partial(shard_map, ...)
-                    chain = attr_chain(deco.func)
-                    if (chain is not None
-                            and chain.rsplit(".", 1)[-1] == "partial"
-                            and deco.args
-                            and _wrapper_leaf(deco.args[0]) is not None):
-                        roots.add(qual)
-                        continue
-                if _wrapper_leaf(target) is not None:
-                    roots.add(qual)
-
-        # Call-site roots: jax.jit(X, ...), shard_map(X, mesh=...),
-        # pl.pallas_call(X, grid=...).  A Name argument may be a local
-        # variable bound to the kernel (`kernel = partial(_f, ...)`
-        # then `pl.pallas_call(kernel, ...)` — the ops modules' idiom):
-        # it resolves against assignments in the call's own ENCLOSING
-        # scope, falling back to module scope.  Scoped, not module-wide:
-        # a flat map would conflate same-named variables across
-        # functions and root a host-only helper as a kernel (a
-        # CI-blocking false impurity finding).
-
-        def _scope_assignments(scope_node) -> Dict[str, Set[str]]:
-            """name -> function names bound to it in this scope only
-            (nested function/lambda bodies are their own scopes)."""
-            out: Dict[str, Set[str]] = {}
-            stack = list(getattr(scope_node, "body", []))
-            while stack:
-                n = stack.pop()
-                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.Lambda)):
-                    continue
-                if (isinstance(n, ast.Assign) and len(n.targets) == 1
-                        and isinstance(n.targets[0], ast.Name)):
-                    value = _unwrap_partial(n.value)
-                    if isinstance(value, ast.Name):
-                        out.setdefault(n.targets[0].id,
-                                       set()).add(value.id)
-                stack.extend(ast.iter_child_nodes(n))
-            return out
-
-        module_assigned = _scope_assignments(mod.tree)
-        scopes = [(mod.tree, module_assigned)]
-        scopes += [(info.node, _scope_assignments(info.node))
-                   for info in syms.functions.values()
-                   if hasattr(info.node, "body")]
-        for scope_node, assigned in scopes:
-            stack = list(getattr(scope_node, "body", []))
-            while stack:
-                node = stack.pop()
-                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    continue          # nested defs are their own entry
-                # Lambdas are NOT scope entries (not in syms.functions):
-                # keep walking their bodies here, or a jit/pallas_call
-                # issued inside one would silently escape rooting.
-                stack.extend(ast.iter_child_nodes(node))
-                if (not isinstance(node, ast.Call)
-                        or _wrapper_leaf(node.func) is None
-                        or not node.args):
-                    continue
-                target = _unwrap_partial(node.args[0])
-                if isinstance(target, ast.Lambda):
-                    lambda_roots.append(target)
-                elif isinstance(target, ast.Name):
-                    names = ({target.id}
-                             | assigned.get(target.id, set())
-                             | module_assigned.get(target.id, set()))
-                    for qual, info in syms.functions.items():
-                        if any(qual == n
-                               or qual.endswith(f"<locals>.{n}")
-                               for n in names):
-                            roots.add(qual)
+        # Root discovery (decorator forms, call-site forms including the
+        # ``kernel = partial(_f, ...)`` then ``pl.pallas_call(kernel,
+        # ...)`` idiom, scoped variable resolution) lives in
+        # symbols.jit_roots_for — one cached pass shared with the
+        # retrace checker's traced-reachability analysis.
+        roots, lambda_roots = jit_roots_for(mod, syms)
 
         if not roots and not lambda_roots:
             return []

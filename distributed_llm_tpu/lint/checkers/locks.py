@@ -8,11 +8,15 @@ Three rules over ``serving/`` + ``engine/`` + ``obs/``:
   ``start_server``/``stop_server``, checkpoint loads, ``time.sleep``,
   socket/HTTP reads) plus the unbounded wait forms ``.join()`` /
   ``.wait()`` / ``.get()`` / ``.acquire()`` called with no
-  timeout/arguments — propagated transitively through the module-local
-  call graph, so ``with self._lock: self.start_server()`` is flagged
-  even though the compile lives two calls down.  This is exactly the
-  PR 2 shape: a health probe blocking on the lifecycle lock through a
-  multi-minute warmup compile reads as a dead tier.
+  timeout/arguments — propagated transitively through the WHOLE-PROJECT
+  call graph (symbols.ProjectSymbols), so ``with self._lock:
+  self.start_server()`` is flagged even when the compile lives two
+  calls down IN ANOTHER FILE (import-resolved: ``from m import fn``,
+  ``module.fn``, ``self.method``; bare-name coincidences never edge).
+  This is exactly the PR 2 shape: a health probe blocking on the
+  lifecycle lock through a multi-minute warmup compile reads as a dead
+  tier — and the upcoming multi-replica refactor splits exactly these
+  paths across modules, where the old module-local graph was blind.
 - ``lock-order-inversion``: lock B acquired while A is held in one
   place and A while B is held in another (static deadlock pair).
   Acquisition-under-lock is collected transitively through resolvable
@@ -36,7 +40,9 @@ import ast
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..core import Checker, Finding, Project
-from ..symbols import ModuleSymbols, attr_chain, call_name, symbols_for
+from ..symbols import (ModuleSymbols, ProjectSymbols, attr_chain,
+                       call_name, module_dotted_name, project_symbols,
+                       symbols_for)
 
 # Long-running by name, wherever they are called (receiver-insensitive:
 # cross-module receivers cannot be typed statically).
@@ -76,7 +82,6 @@ class _FuncScan(ast.NodeVisitor):
         self.syms = syms
         self.func_qual = func_qual
         self.class_name = class_name
-        self.direct_blocking: List[Tuple[ast.Call, str]] = []
         self.acquires: Set[str] = set()          # locks this func takes
         # (held_lock, acquired_lock, node) ordered pairs seen directly
         self.order_pairs: List[Tuple[str, str, ast.AST]] = []
@@ -170,8 +175,6 @@ class _FuncScan(ast.NodeVisitor):
                 self.generic_visit(node)
                 return
         reason = _is_blocking_call(node)
-        if reason is not None:
-            self.direct_blocking.append((node, reason))
         held = self._held_now()
         if held:
             resolved = None
@@ -180,8 +183,10 @@ class _FuncScan(ast.NodeVisitor):
                 if cnode is node:
                     resolved = callee
                     break
-            if reason is not None or resolved is not None:
-                self.held_calls.append((node, reason, held[0], resolved))
+            # EVERY call under a held lock is recorded: module-locally
+            # unresolvable callees may still resolve cross-module
+            # through the project graph at check time.
+            self.held_calls.append((node, reason, held[0], resolved))
         self.generic_visit(node)
 
     # -- attribute accesses ------------------------------------------------
@@ -210,15 +215,74 @@ def _plain_accesses(scan: _FuncScan, tree_parents: Dict[int, ast.AST]
     return out
 
 
+def _first_direct_blocking(func_node) -> Optional[Tuple[ast.Call, str]]:
+    """The first (by line) blocking call in a function body, nested defs
+    skipped — the seed of the project-wide blocking fixpoint."""
+    best: Optional[Tuple[ast.Call, str]] = None
+    body = (func_node.body if isinstance(func_node.body, list)
+            else [func_node.body])
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            reason = _is_blocking_call(node)
+            if reason is not None and (best is None
+                                       or node.lineno < best[0].lineno):
+                best = (node, reason)
+        stack.extend(ast.iter_child_nodes(node))
+    return best
+
+
+def _display(gid: str, from_rel: str) -> str:
+    """How a callee reads in a finding message: bare qualname inside the
+    same module, ``dotted.module.qualname`` across modules."""
+    rel, qual = gid.split(":", 1)
+    if rel == from_rel:
+        return qual
+    return f"{module_dotted_name(rel)}.{qual}"
+
+
+def _global_blocking(ps: ProjectSymbols) -> Dict[str, str]:
+    """gid -> human-readable witness for every function that blocks,
+    directly or transitively through the project-wide call graph."""
+    blocking: Dict[str, str] = {}
+    for gid, gf in ps.functions.items():
+        if isinstance(gf.info.node, ast.Lambda):
+            continue
+        hit = _first_direct_blocking(gf.info.node)
+        if hit is not None:
+            blocking[gid] = f"{hit[1]} at line {hit[0].lineno}"
+    changed = True
+    while changed:
+        changed = False
+        for gid, edges in ps.calls.items():
+            if gid in blocking:
+                continue
+            for callee, _bare, _node in edges:
+                if callee is not None and callee in blocking:
+                    rel = gid.split(":", 1)[0]
+                    blocking[gid] = (f"calls `{_display(callee, rel)}` "
+                                     f"({blocking[callee]})")
+                    changed = True
+                    break
+    return blocking
+
+
 class LockChecker(Checker):
     name = "locks"
     rules = ("lock-blocking-call", "lock-order-inversion",
              "lock-mixed-guard")
     scope = ("distributed_llm_tpu/serving", "distributed_llm_tpu/engine",
              "distributed_llm_tpu/obs")
+    whole_project = True      # an edit elsewhere can make a callee block
 
     def check(self, project: Project) -> List[Finding]:
         findings: List[Finding] = []
+        ps = project_symbols(project)
+        blocking = _global_blocking(ps)
         # (relpath, lockA, lockB) -> first site, for inversion detection
         pair_sites: Dict[Tuple[str, str, str], Tuple[str, int]] = {}
 
@@ -226,7 +290,8 @@ class LockChecker(Checker):
             syms = symbols_for(mod)
             if syms is None:
                 continue
-            findings.extend(self._check_module(mod, syms, pair_sites))
+            findings.extend(self._check_module(mod, syms, ps, blocking,
+                                               pair_sites))
 
         # Lock-order inversions across all collected pairs (locks are
         # module-scoped, so pairs only collide within one module).
@@ -244,9 +309,11 @@ class LockChecker(Checker):
 
     # -- per-module --------------------------------------------------------
 
-    def _check_module(self, mod, syms: ModuleSymbols,
+    def _check_module(self, mod, syms: ModuleSymbols, ps: ProjectSymbols,
+                      blocking: Dict[str, str],
                       pair_sites) -> List[Finding]:
         findings: List[Finding] = []
+        rel = mod.relpath
         scans: Dict[str, _FuncScan] = {}
         for qual, info in syms.functions.items():
             if isinstance(info.node, ast.Lambda):
@@ -254,15 +321,11 @@ class LockChecker(Checker):
             scans[qual] = _FuncScan(syms, qual,
                                     info.class_name).run(info.node)
 
-        # Transitive blocking + transitive lock acquisition (fixpoint
-        # over resolved module-local call edges).
-        blocking: Dict[str, str] = {}        # qual -> witness reason
+        # Transitive lock acquisition (fixpoint over resolved
+        # module-local call edges — lock identity is module-scoped, so
+        # cross-module edges cannot contribute inversion pairs).
         acquires: Dict[str, Set[str]] = {q: set(s.acquires)
                                          for q, s in scans.items()}
-        for qual, scan in scans.items():
-            if scan.direct_blocking:
-                node, reason = scan.direct_blocking[0]
-                blocking[qual] = f"{reason} at line {node.lineno}"
         changed = True
         while changed:
             changed = False
@@ -270,39 +333,40 @@ class LockChecker(Checker):
                 for callee, _n, _c in syms.calls.get(qual, ()):
                     if callee is None or callee not in scans:
                         continue
-                    if callee in blocking and qual not in blocking:
-                        blocking[qual] = f"calls `{callee}` " \
-                                         f"({blocking[callee]})"
-                        changed = True
                     extra = acquires[callee] - acquires[qual]
                     if extra:
                         acquires[qual] |= extra
                         changed = True
 
-        # Rule: blocking under a held lock (direct or via local callee);
-        # plus transitive order pairs through local calls.
+        # Rule: blocking under a held lock — direct, via a local callee,
+        # or via a callee in ANOTHER module (the project graph's
+        # import-resolved edge; blocking-ness came from the global
+        # fixpoint).  Plus transitive order pairs through local calls.
         for qual, scan in scans.items():
             for held, acquired, node in scan.order_pairs:
-                key = (mod.relpath, held, acquired)
-                pair_sites.setdefault(key, (mod.relpath, node.lineno))
+                key = (rel, held, acquired)
+                pair_sites.setdefault(key, (rel, node.lineno))
             for node, reason, held_lock, resolved in scan.held_calls:
+                gid = (f"{rel}:{resolved}" if resolved is not None
+                       else ps.callee_of(rel, node))
                 if reason is not None:
                     findings.append(Finding(
-                        "lock-blocking-call", mod.relpath, node.lineno,
+                        "lock-blocking-call", rel, node.lineno,
                         f"blocking {reason} while holding {held_lock}"))
-                elif resolved is not None and resolved in blocking:
+                elif gid is not None and gid in blocking:
                     findings.append(Finding(
-                        "lock-blocking-call", mod.relpath, node.lineno,
-                        f"call to `{resolved}` while holding {held_lock} "
-                        f"— transitively blocking: {blocking[resolved]}"))
+                        "lock-blocking-call", rel, node.lineno,
+                        f"call to `{_display(gid, rel)}` while holding "
+                        f"{held_lock} — transitively blocking: "
+                        f"{blocking[gid]}"))
                 if resolved is not None:
                     held = {held_lock}
                     for lock in acquires.get(resolved, ()):
                         for h in held:
                             if h != lock:
-                                key = (mod.relpath, h, lock)
+                                key = (rel, h, lock)
                                 pair_sites.setdefault(
-                                    key, (mod.relpath, node.lineno))
+                                    key, (rel, node.lineno))
 
         findings.extend(self._mixed_guard(mod, syms, scans))
         return findings

@@ -1,0 +1,301 @@
+"""Retrace-hazard lint: compile-churn at jit/pjit/shard_map/pallas_call
+roots, enforcing PR 6's headline invariant STATICALLY — exactly one
+compiled decode program per engine life — instead of only observing it
+at runtime through ``_note_compile``.
+
+A retrace hazard is any shape that mints a NEW compiled program on a
+path that runs more than once per engine life.  The runtime cost is
+invisible until a bench round pays for it (a mid-serve compile stalls
+every active slot for seconds-to-minutes on chip), which is why the
+rule family exists: the hazard must fail CI, not a later bench.
+
+Rules:
+
+- ``retrace-wrap-in-loop``: ``jax.jit(...)`` / ``pjit`` / ``shard_map``
+  / ``pl.pallas_call`` invoked inside a ``for``/``while`` body — a
+  fresh wrapper (and a fresh trace) per iteration.  Calling an
+  ALREADY-wrapped function in a loop is the normal warm path and stays
+  silent, and so does a loop inside TRACED code (it unrolls at trace
+  time — one outer compile, the per-layer ops idiom).
+- ``retrace-per-call-wrap``: a wrap immediately invoked
+  (``jax.jit(f)(x)``, ``pl.pallas_call(partial(k, ...), ...)(...)``)
+  inside a function reachable from an annotated hot-path root
+  (``# dllm-lint: hot-path`` — decode tick, scheduler loop, request
+  handlers) but NOT reachable from any jit root: every request/tick
+  re-traces.  Inside traced code the same shape is fine — it traces
+  once per outer compile — so traced-reachable functions (project-wide
+  closure, ``lax.scan`` bodies included) are exempt.
+- ``retrace-dynamic-shape``: a device upload whose SHAPE varies per
+  call — ``jnp.asarray(x[:, :w])`` with a non-constant slice bound —
+  or a shape-derived Python scalar (``len(x)``, ``x.shape[i]``) passed
+  to a known-jitted callable that declares no ``static_argnums`` /
+  ``static_argnames``.  Each distinct width is a distinct compiled
+  program; bucket it, pad it, or make it static and accept the
+  per-value retrace knowingly.  Deliberately-bounded families (the
+  dense rung ladder, prefill buckets) carry inline suppressions whose
+  justification states the bound.
+- ``retrace-shape-cache-key``: a mapping key built from an array's
+  ``.shape`` (directly, in a tuple, or through an f-string) — keying a
+  cache by shape is declaring "one entry per shape", i.e. institutional
+  churn.  Slicing TO a shape bound (``x[: q.shape[1]]``) and indexing
+  by a shape-derived SCALAR (``tables[q.shape[0]]`` — a shape indexed
+  down to an int is ordinary array code, not a mapping key) stay
+  silent: mappings and arrays are statically indistinguishable, so
+  only the unambiguously-mapping-shaped keys fire.
+
+Scope: the serving stack (engine/ops/serving/models/parallel/obs) —
+bench and training mint programs per measurement case by design.
+Functions named ``*warmup*`` are exempt: minting every program the
+engine can touch is warmup's JOB.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Checker, Finding, Project
+from ..symbols import (attr_chain, call_name, hot_path_roots,
+                       project_symbols, symbols_for, unwrap_partial,
+                       wrapper_leaf)
+
+EXEMPT_RE = re.compile(r"warmup|prewarm", re.IGNORECASE)
+
+# Wrap-site static-argument keywords that sanction per-value retraces.
+STATIC_KWARGS = {"static_argnums", "static_argnames"}
+
+
+def _is_exempt(qual: Optional[str]) -> bool:
+    return bool(qual and EXEMPT_RE.search(qual))
+
+
+def _nonconstant_slice(sub: ast.Subscript) -> bool:
+    """True when the subscript contains a Slice with a non-constant
+    bound (``x[:, :wb]``): the result's shape varies with the bound."""
+    def dynamic(bound: Optional[ast.expr]) -> bool:
+        return bound is not None and not isinstance(bound, ast.Constant)
+
+    for node in ast.walk(sub.slice):
+        if isinstance(node, ast.Slice):
+            if dynamic(node.lower) or dynamic(node.upper):
+                return True
+    return False
+
+
+def _shape_in_key(sub: ast.Subscript) -> bool:
+    """True when the subscript KEY (not a slice bound) uses an
+    ``.shape`` attribute AS A VALUE — the whole tuple, directly or
+    inside a tuple/f-string key (``cache[x.shape]``,
+    ``cache[(x.shape, dtype)]``, ``cache[f"prog-{x.shape}"]``).  A
+    shape INDEXED down to a scalar (``tables[q.shape[0]]``) is ordinary
+    array indexing, not a mapping key, and stays silent — the checker
+    cannot tell mappings from arrays statically, so only the
+    unambiguously-mapping-shaped keys fire."""
+    indexed: Set[int] = set()
+    hits: List[ast.Attribute] = []
+    stack = [sub.slice]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Slice):
+            continue                  # slicing to a shape bound is fine
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.value, ast.Attribute) \
+                and node.value.attr == "shape":
+            indexed.add(id(node.value))
+        if isinstance(node, ast.Attribute) and node.attr == "shape":
+            hits.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return any(id(h) not in indexed for h in hits)
+
+
+def _shape_derived(expr: ast.expr) -> Optional[str]:
+    """'len(...)' / 'x.shape[i]' when the expression is (or contains at
+    the top arithmetic level) a shape-derived Python scalar."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "len"):
+            return "len(...)"
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr == "shape"):
+            return ".shape[...]"
+        if isinstance(node, (ast.BinOp, ast.UnaryOp)):
+            stack.extend(ast.iter_child_nodes(node))
+    return None
+
+
+class _JitWrapIndex:
+    """``f = jax.jit(g, ...)`` assignments, scoped to the function (or
+    module body) that binds them: a call site resolves against its OWN
+    scope first, then module scope — never against a sibling function's
+    local binding (a module-wide flat map conflated same-named locals
+    across functions, both ways: a host-only local shadowed by another
+    function's jit wrap false-positived, and a sanctioned wrap masked an
+    unsanctioned same-named one)."""
+
+    def __init__(self, scopes):
+        # scope qual (None = module body) -> {name -> wrap Call}
+        self.by_scope: Dict[Optional[str], Dict[str, ast.Call]] = {}
+        for qual, body in scopes:
+            table: Dict[str, ast.Call] = {}
+            stack = list(body)
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue          # their own scope entries
+                stack.extend(ast.iter_child_nodes(n))
+                if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                        and isinstance(n.targets[0], ast.Name)
+                        and isinstance(n.value, ast.Call)
+                        and wrapper_leaf(n.value.func) in ("jit", "pjit")):
+                    table[n.targets[0].id] = n.value
+            if table:
+                self.by_scope[qual] = table
+
+    def unsanctioned(self, qual: Optional[str], name: str) -> bool:
+        for scope in (qual, None):
+            wrap = self.by_scope.get(scope, {}).get(name)
+            if wrap is not None:
+                return not any(kw.arg in STATIC_KWARGS
+                               for kw in wrap.keywords)
+        return False
+
+
+class RetraceChecker(Checker):
+    name = "retrace"
+    rules = ("retrace-wrap-in-loop", "retrace-per-call-wrap",
+             "retrace-dynamic-shape", "retrace-shape-cache-key")
+    scope = ("distributed_llm_tpu/engine", "distributed_llm_tpu/ops",
+             "distributed_llm_tpu/serving", "distributed_llm_tpu/models",
+             "distributed_llm_tpu/parallel", "distributed_llm_tpu/obs")
+    whole_project = True     # traced/hot reachability crosses modules
+
+    def check(self, project: Project) -> List[Finding]:
+        ps = project_symbols(project)
+        traced = ps.traced_closure()
+        hot = ps.closure(hot_path_roots(ps))
+        findings: List[Finding] = []
+        for mod in project.in_dirs(self.scope):
+            syms = symbols_for(mod)
+            if syms is None:
+                continue
+            findings.extend(self._check_module(mod, syms, ps, traced, hot))
+        return findings
+
+    def _check_module(self, mod, syms, ps, traced: Set[str],
+                      hot: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        rel = mod.relpath
+
+        # Walk each function body (module scope included) with loop
+        # depth, attributing nodes to their enclosing function's gid.
+        scopes: List[Tuple[Optional[str], list]] = [(None, mod.tree.body)]
+        scopes += [(qual, info.node.body)
+                   for qual, info in syms.functions.items()
+                   if isinstance(info.node.body, list)]
+        jit_index = _JitWrapIndex(scopes)
+
+        for qual, body in scopes:
+            gid = f"{rel}:{qual}" if qual else None
+            if _is_exempt(qual):
+                continue
+            stack: List[Tuple[ast.AST, int]] = [(n, 0) for n in body]
+            while stack:
+                node, loops = stack.pop()
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue           # separate scope entry
+                depth = loops + (1 if isinstance(node, (ast.For,
+                                                        ast.While))
+                                 else 0)
+                stack.extend((c, depth)
+                             for c in ast.iter_child_nodes(node))
+                if isinstance(node, ast.Subscript) and _shape_in_key(node):
+                    findings.append(Finding(
+                        "retrace-shape-cache-key", rel, node.lineno,
+                        "mapping key built from an array's `.shape` — a "
+                        "shape-keyed cache institutionalizes one "
+                        "compiled program per shape; bucket or pad the "
+                        "shape instead"))
+                if not isinstance(node, ast.Call):
+                    continue
+                findings.extend(self._check_call(
+                    mod, node, qual, gid, loops, traced, hot, jit_index))
+        return findings
+
+    def _check_call(self, mod, node: ast.Call, qual: Optional[str],
+                    gid: Optional[str], loops: int, traced: Set[str],
+                    hot: Set[str],
+                    jit_index: _JitWrapIndex) -> List[Finding]:
+        rel = mod.relpath
+        out: List[Finding] = []
+        leaf = wrapper_leaf(node.func)
+        if leaf is not None:
+            # Inside TRACED code a wrap-in-loop unrolls at trace time —
+            # one outer compile, the per-layer ops-module idiom — same
+            # exemption the per-call-wrap rule grants below.
+            if loops > 0 and (gid is None or gid not in traced):
+                out.append(Finding(
+                    "retrace-wrap-in-loop", rel, node.lineno,
+                    f"`{leaf}(...)` inside a loop mints a fresh wrapper "
+                    f"(and a fresh trace) every iteration — hoist the "
+                    f"wrap out of the loop and call the wrapped "
+                    f"function instead"))
+            return out
+
+        # Immediate invoke of a wrap: Call whose func is itself a
+        # wrapper Call — jax.jit(f)(x) / pl.pallas_call(k, ...)(...).
+        inner = node.func
+        if isinstance(inner, ast.Call):
+            ileaf = wrapper_leaf(inner.func)
+            if ileaf is not None and gid is not None \
+                    and gid in hot and gid not in traced:
+                target = unwrap_partial(inner.args[0]) if inner.args \
+                    else None
+                what = ("a freshly-built partial/lambda kernel"
+                        if isinstance(target, (ast.Lambda, ast.Call))
+                        else "its function argument")
+                out.append(Finding(
+                    "retrace-per-call-wrap", rel, node.lineno,
+                    f"`{ileaf}(...)` wrapped and invoked in one "
+                    f"expression on a hot path: every call re-traces "
+                    f"{what} — build the wrapper once (module scope or "
+                    f"a keyed cache) and reuse it"))
+            return out
+
+        chain = attr_chain(node.func) or ""
+        name = call_name(node)
+        # Dynamic-shape device upload: jnp.asarray(x[:, :w]) & friends.
+        if chain.startswith("jnp.") and name in ("asarray", "array") \
+                and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Subscript) and _nonconstant_slice(arg):
+                out.append(Finding(
+                    "retrace-dynamic-shape", rel, node.lineno,
+                    "device upload of a variably-sliced array: every "
+                    "distinct width is a distinct operand shape — one "
+                    "compiled program per width downstream; bucket or "
+                    "pad the slice, or justify the bound in a "
+                    "suppression"))
+        # Shape-derived Python scalar into a jitted callable that
+        # declared no static_argnums/static_argnames.
+        if isinstance(node.func, ast.Name) \
+                and jit_index.unsanctioned(qual, node.func.id):
+            for arg in node.args:
+                derived = _shape_derived(arg)
+                if derived is not None:
+                    out.append(Finding(
+                        "retrace-dynamic-shape", rel, node.lineno,
+                        f"shape-derived scalar {derived} flows into "
+                        f"jitted `{node.func.id}(...)` with no "
+                        f"static_argnums/static_argnames — the value "
+                        f"becomes a traced 0-d array (silent intent "
+                        f"mismatch) or, marked static later, a "
+                        f"per-value retrace; declare it static "
+                        f"explicitly or bucket it"))
+                    break
+        return out

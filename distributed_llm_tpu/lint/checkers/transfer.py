@@ -1,0 +1,220 @@
+"""Host↔device transfer discipline on annotated hot-path roots.
+
+APEX-style host/accelerator overlap (PAPERS.md) dies silently when a
+host sync creeps into the decode tick or the scheduler thread: one
+stray ``.item()`` serializes the device queue against Python, and
+nothing errors — throughput just sags.  This checker makes the
+discipline structural: a function annotated ``# dllm-lint: hot-path``
+(the decode tick / scheduler loop, the sampler collect, stream pumps)
+and EVERYTHING it transitively calls — project-wide, through the
+import-resolved call graph — must not sync or round-trip through the
+host, except at sites that carry an inline suppression naming why that
+specific sync is the sanctioned one.
+
+Rules:
+
+- ``transfer-host-sync``: ``jax.block_until_ready(...)``,
+  ``jax.device_get(...)`` or ``.item()`` in the hot-path closure.  The
+  batched tick keeps exactly ONE — the tick-boundary sync that makes
+  the tokens observable — and that site's suppression justification
+  says so; prefill's first-token syncs are likewise sanctioned by name
+  (TTFT is the SLO).  Anything else is a new stall.
+- ``transfer-host-round-trip``: ``np.asarray(...)`` / ``np.array(...)``
+  / ``float()`` / ``int()`` / ``bool()`` directly over a ``jnp.`` /
+  ``jax.`` expression in the closure — an implicit device→host pull
+  (and often a fresh host copy) on every tick.  Expressions that
+  contain an explicit sync are reported once, as the sync.
+- ``transfer-undonated-buffer``: a ``jax.jit``/``pjit`` wrap whose
+  function threads a KV/cache/pool buffer (a parameter named ``pool``
+  / ``cache`` / ``kv*`` that the function also returns) with no
+  ``donate_argnums`` — the update double-buffers the pool on every
+  call.  This rule is project-wide (not hot-path-gated): the wrap site
+  is where donation is declared, wherever it is.
+
+Functions named ``*warmup*``/``*bench*`` are exempt from the closure
+rules: warmup syncs to force compiles, benches sync to measure.
+
+Adding a new hot-path root is one comment: put ``# dllm-lint:
+hot-path`` on (or directly above) the ``def`` line — see DESIGN.md
+"Static analysis".
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set
+
+from ..core import Checker, Finding, Project
+from ..symbols import (attr_chain, call_name, hot_path_roots,
+                       project_symbols, symbols_for, wrapper_leaf)
+
+EXEMPT_RE = re.compile(r"warmup|prewarm|bench|micro", re.IGNORECASE)
+
+SYNC_NAMES = {"block_until_ready", "device_get"}
+PULL_WRAPPERS = {"float", "int", "bool"}
+BUFFER_PARAM_RE = re.compile(r"^(pool|cache|kv\w*|buffer)$")
+
+
+def _contains_sync(expr: ast.expr) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and call_name(node) in SYNC_NAMES:
+            return True
+    return False
+
+
+def _contains_device_expr(expr: ast.expr) -> bool:
+    """A call rooted at jnp./jax. anywhere inside — the device-value
+    heuristic for round-trip detection."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func) or ""
+            root = chain.split(".", 1)[0]
+            if root in ("jnp", "jax"):
+                return True
+    return False
+
+
+class TransferChecker(Checker):
+    name = "transfer"
+    rules = ("transfer-host-sync", "transfer-host-round-trip",
+             "transfer-undonated-buffer")
+    scope = ("distributed_llm_tpu/engine", "distributed_llm_tpu/serving",
+             "distributed_llm_tpu/obs", "distributed_llm_tpu/ops",
+             "distributed_llm_tpu/models", "distributed_llm_tpu/parallel")
+    whole_project = True     # the hot-path closure crosses modules
+
+    def check(self, project: Project) -> List[Finding]:
+        ps = project_symbols(project)
+        closure = ps.closure(hot_path_roots(ps))
+        findings: List[Finding] = []
+
+        # Closure rules fire wherever the callee LIVES (a hot tick
+        # calling a syncing helper in utils/ is still a hot-path sync).
+        for gid in sorted(closure):
+            gf = ps.functions.get(gid)
+            if gf is None or EXEMPT_RE.search(gf.qualname):
+                continue
+            mod = project.get(gf.relpath)
+            if mod is None:
+                continue
+            findings.extend(self._scan_hot_body(mod, gf))
+
+        # Donation rule: every wrap site in scope, hot or not.
+        for mod in project.in_dirs(self.scope):
+            syms = symbols_for(mod)
+            if syms is None:
+                continue
+            findings.extend(self._scan_donation(mod, syms))
+        return findings
+
+    # -- closure rules -----------------------------------------------------
+
+    def _scan_hot_body(self, mod, gf) -> List[Finding]:
+        findings: List[Finding] = []
+        node = gf.info.node
+        body = (node.body if isinstance(getattr(node, "body", None), list)
+                else [node.body] if hasattr(node, "body") else [])
+        stack = list(body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue          # own graph entries when actually called
+            # Lambdas are NOT graph entries and cannot carry their own
+            # hot-path annotation: scan their bodies as part of the
+            # enclosing function, or a per-tick sync hides in one.
+            stack.extend(ast.iter_child_nodes(n))
+            if not isinstance(n, ast.Call):
+                continue
+            name = call_name(n)
+            if name in SYNC_NAMES:
+                findings.append(Finding(
+                    "transfer-host-sync", mod.relpath, n.lineno,
+                    f"`{name}(...)` on the hot path (reachable from a "
+                    f"`# dllm-lint: hot-path` root via `{gf.qualname}`) "
+                    f"— a device sync serializes the tick against the "
+                    f"host; if this is the sanctioned sync, say so in a "
+                    f"suppression justification"))
+                continue
+            if name == "item" and isinstance(n.func, ast.Attribute) \
+                    and not n.args and not n.keywords:
+                findings.append(Finding(
+                    "transfer-host-sync", mod.relpath, n.lineno,
+                    f"`.item()` on the hot path (via `{gf.qualname}`) "
+                    f"pulls a device value to host per call — batch the "
+                    f"pull at the tick boundary instead"))
+                continue
+            is_np_pull = False
+            chain = attr_chain(n.func) or ""
+            if chain in ("np.asarray", "np.array", "numpy.asarray",
+                         "numpy.array"):
+                is_np_pull = True
+            elif isinstance(n.func, ast.Name) and name in PULL_WRAPPERS:
+                is_np_pull = True
+            if is_np_pull and n.args \
+                    and _contains_device_expr(n.args[0]) \
+                    and not _contains_sync(n.args[0]):
+                findings.append(Finding(
+                    "transfer-host-round-trip", mod.relpath, n.lineno,
+                    f"`{name}(...)` over a device expression on the hot "
+                    f"path (via `{gf.qualname}`) — an implicit "
+                    f"device→host transfer every call; keep the value "
+                    f"on device or move the pull to the tick boundary"))
+        return findings
+
+    # -- donation rule -----------------------------------------------------
+
+    def _scan_donation(self, mod, syms) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) \
+                    or wrapper_leaf(node.func) not in ("jit", "pjit") \
+                    or not node.args:
+                continue
+            if any(kw.arg == "donate_argnums" for kw in node.keywords):
+                continue
+            target = node.args[0]
+            if not isinstance(target, ast.Name):
+                continue
+            fn = self._local_def(syms, target.id)
+            if fn is None:
+                continue
+            params = [p.arg for p in fn.args.args]
+            buffered = [p for p in params if BUFFER_PARAM_RE.match(p)]
+            if not buffered:
+                continue
+            returned = self._returned_names(fn)
+            threaded = sorted(set(buffered) & returned)
+            if threaded:
+                findings.append(Finding(
+                    "transfer-undonated-buffer", mod.relpath, node.lineno,
+                    f"jit wrap threads buffer parameter(s) "
+                    f"{threaded} through without donate_argnums — the "
+                    f"functional update double-buffers the pool on "
+                    f"every call; donate it (device backends) or "
+                    f"justify why not"))
+        return findings
+
+    @staticmethod
+    def _local_def(syms, name: str) -> Optional[ast.FunctionDef]:
+        for qual, info in syms.functions.items():
+            if (qual == name or qual.endswith(f"<locals>.{name}")) \
+                    and isinstance(info.node, ast.FunctionDef):
+                return info.node
+        return None
+
+    @staticmethod
+    def _returned_names(fn: ast.FunctionDef) -> Set[str]:
+        out: Set[str] = set()
+        stack = list(fn.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Return) and n.value is not None:
+                for sub in ast.walk(n.value):
+                    if isinstance(sub, ast.Name):
+                        out.add(sub.id)
+            stack.extend(ast.iter_child_nodes(n))
+        return out

@@ -35,6 +35,12 @@ SUPPRESS_RE = re.compile(
     r"#\s*dllm-lint:\s*(disable|disable-file)=([A-Za-z0-9_,\-]+)"
     r"(?:\s*--\s*(\S.*))?")
 
+# ``def _loop(self):  # dllm-lint: hot-path`` (same line or the line
+# above the def) marks a function as a host-transfer-discipline root:
+# the transfer checker flags device syncs/round-trips in everything the
+# function transitively calls, project-wide.  See DESIGN.md.
+HOT_PATH_RE = re.compile(r"#\s*dllm-lint:\s*hot-path\b")
+
 JUSTIFICATION_RULE = "suppression-missing-justification"
 PARSE_RULE = "parse-error"
 
@@ -57,6 +63,7 @@ class Suppressions:
         self.by_line: Dict[int, set] = {}     # line -> {rules}
         self.file_level: set = set()
         self.malformed: List[Tuple[int, str]] = []   # (line, rules-text)
+        self.hot_path_lines: set = set()      # '# dllm-lint: hot-path'
 
     @classmethod
     def parse(cls, source: str) -> "Suppressions":
@@ -79,6 +86,8 @@ class Suppressions:
         for tok in tokens:
             if tok.type != tokenize.COMMENT:
                 continue
+            if HOT_PATH_RE.search(tok.string):
+                sup.hot_path_lines.add(tok.start[0])
             m = SUPPRESS_RE.search(tok.string)
             if not m:
                 continue
@@ -210,11 +219,19 @@ class Checker:
     ``scope`` is the path-prefix set the checker examines; the runner
     passes the full project so cross-module checkers (locks, drift) can
     still see everything.
+
+    ``whole_project`` marks checkers whose verdicts depend on the whole
+    call graph or registry, not just the file a finding lands in: a
+    ``--changed`` (git-diff-scoped) run auto-widens these to full
+    reporting, because an edit in one file can create or cure a finding
+    in another (cross-module blocking-under-lock, a knob losing its
+    last reader, a hot-path callee growing a sync).
     """
 
     name: str = ""
     rules: Tuple[str, ...] = ()
     scope: Tuple[str, ...] = ("distributed_llm_tpu",)
+    whole_project: bool = False
 
     def check(self, project: Project) -> List[Finding]:  # pragma: no cover
         raise NotImplementedError
@@ -263,6 +280,24 @@ def run_checkers(project: Project, checkers: Iterable[Checker],
         else:
             findings.append(f)
     return LintResult(findings=findings, suppressed=suppressed)
+
+
+def filter_changed(result: LintResult, changed: Iterable[str],
+                   checkers: Iterable[Checker]) -> LintResult:
+    """The ``--changed`` reporting filter: keep findings that land in a
+    changed file, plus EVERY finding of a ``whole_project`` checker —
+    those analyses already ran over the full project (a narrowed load
+    would be unsound for them), and their findings can be caused by a
+    changed file while landing in an unchanged one.  Parse errors and
+    naked suppressions are never filtered either: a module that fails
+    to parse is invisible to every whole-project analysis, so hiding
+    its finding would report a green the graph checkers cannot back."""
+    changed_set = set(changed)
+    wide_rules = {r for c in checkers if c.whole_project for r in c.rules}
+    wide_rules |= {PARSE_RULE, JUSTIFICATION_RULE}
+    keep = [f for f in result.findings
+            if f.path in changed_set or f.rule in wide_rules]
+    return LintResult(findings=keep, suppressed=result.suppressed)
 
 
 def repo_root() -> str:

@@ -123,9 +123,12 @@ class SystemStateSampler:
 
     # -- sampling ----------------------------------------------------------
 
-    def sample_once(self) -> Dict[str, Any]:
+    def sample_once(self) -> Dict[str, Any]:    # dllm-lint: hot-path
         """Take one sample NOW (also the on-demand path for
-        ``GET /stats?timeline=1`` on an idle router)."""
+        ``GET /stats?timeline=1`` on an idle router).  Hot-path root for
+        the transfer lint: a sample must stay tens-of-microseconds cheap
+        and must NEVER touch the device (a host sync here would stall
+        the timeline behind a busy chip)."""
         t0 = time.perf_counter()
         try:
             tiers = self._collect() or {}

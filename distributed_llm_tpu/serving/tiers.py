@@ -550,9 +550,11 @@ class TierClient:
             # A sequential engine's lock IS its queue: the wait here is
             # the streaming twin of the batching engine's queue_wait.
             with obs_spans.span(trace, "engine_lock_wait", tier=self.name):
-                acquired = (self._engine_lock.acquire(timeout=timeout)
-                            if timeout is not None
-                            else self._engine_lock.acquire())
+                # timeout=-1 is threading's own "block forever" sentinel,
+                # so the two branches collapse to ONE acquire site.
+                # dllm-lint: disable=thread-acquire-leak -- the STREAM owns this lock past the frame: release_all/_PrimedStream release it on exhaustion/close/GC, and the except-BaseException below releases on setup failure — a try/finally here would release while the stream is still decoding
+                acquired = self._engine_lock.acquire(
+                    timeout=timeout if timeout is not None else -1)
             if not acquired:
                 self.admission.release()
                 logger.warning("tier %s stream setup could not take the "

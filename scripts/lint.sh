@@ -2,11 +2,18 @@
 # Local entry point for the repo's static-analysis suite (dllm-lint).
 #
 #   scripts/lint.sh                 # whole project (the tier-1 surface)
+#   scripts/lint.sh --changed       # report only files changed vs
+#                                   # $DLLM_LINT_CHANGED (default HEAD);
+#                                   # whole-project checkers (locks,
+#                                   # retrace, transfer, thread_lifecycle,
+#                                   # config_drift) auto-widen — the
+#                                   # analysis always loads everything
 #   scripts/lint.sh --list-rules    # checker/rule inventory
 #   scripts/lint.sh distributed_llm_tpu/serving --rule lock-blocking-call
 #
-# Pure AST passes: no jax import, CPU-only, sub-second — safe as a
-# pre-commit hook.  Exit 0 = clean, 1 = unsuppressed findings.
+# Pure AST passes: no jax import, CPU-only, a few seconds on the full
+# repo — safe as a pre-commit hook (use --changed there).  Exit 0 =
+# clean, 1 = unsuppressed findings.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec python -m distributed_llm_tpu.lint "$@"

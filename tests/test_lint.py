@@ -797,6 +797,860 @@ def test_suppression_wrong_rule_does_not_silence():
     assert _rules(result) == ["span-not-with"]
 
 
+# -- whole-project call graph (ISSUE 8 tentpole) -----------------------------
+
+def _project(files):
+    return Project("/", {path: Module(path, textwrap.dedent(src))
+                         for path, src in files.items()})
+
+
+def _psyms(files):
+    from distributed_llm_tpu.lint.symbols import project_symbols
+    return project_symbols(_project(files))
+
+
+UTIL = "distributed_llm_tpu/engine/util.py"
+CALLER = "distributed_llm_tpu/serving/caller.py"
+
+
+def test_callgraph_resolves_from_import():
+    ps = _psyms({
+        UTIL: """
+            def helper():
+                pass
+        """,
+        CALLER: """
+            from ..engine.util import helper
+
+            def go():
+                helper()
+        """,
+    })
+    edges = ps.calls.get(f"{CALLER}:go", [])
+    assert (f"{UTIL}:helper", "helper") in [(g, b) for g, b, _ in edges]
+
+
+def test_callgraph_resolves_import_alias_and_dotted():
+    ps = _psyms({
+        UTIL: """
+            def helper():
+                pass
+        """,
+        CALLER: """
+            import distributed_llm_tpu.engine.util as u
+            import distributed_llm_tpu.engine.util
+
+            def via_alias():
+                u.helper()
+
+            def via_dotted():
+                distributed_llm_tpu.engine.util.helper()
+        """,
+    })
+    for fn in ("via_alias", "via_dotted"):
+        gids = [g for g, _, _ in ps.calls.get(f"{CALLER}:{fn}", [])]
+        assert f"{UTIL}:helper" in gids, (fn, gids)
+
+
+def test_callgraph_resolves_self_method_and_locals():
+    ps = _psyms({
+        CALLER: """
+            class C:
+                def outer(self):
+                    def worker():
+                        pass
+                    self.inner()
+                    worker()
+
+                def inner(self):
+                    pass
+        """,
+    })
+    gids = [g for g, _, _ in ps.calls.get(f"{CALLER}:C.outer", [])]
+    assert f"{CALLER}:C.inner" in gids
+    assert f"{CALLER}:C.outer.<locals>.worker" in gids
+
+
+def test_callgraph_follows_reexport_chain():
+    """``from pkg import fn`` where pkg/__init__ re-exports fn from an
+    impl module — the repo's models/__init__ idiom."""
+    ps = _psyms({
+        "distributed_llm_tpu/pkgx/__init__.py": """
+            from .impl import fn
+        """,
+        "distributed_llm_tpu/pkgx/impl.py": """
+            def fn():
+                pass
+        """,
+        CALLER: """
+            from ..pkgx import fn
+
+            def go():
+                fn()
+        """,
+    })
+    gids = [g for g, _, _ in ps.calls.get(f"{CALLER}:go", [])]
+    assert "distributed_llm_tpu/pkgx/impl.py:fn" in gids
+
+
+def test_callgraph_name_collision_never_edges():
+    """Two modules defining the same bare name must NOT edge without an
+    import proving it — the PR 4 graph's documented blind spot was
+    name-matching; the fix must not overcorrect into name-matching
+    across files."""
+    ps = _psyms({
+        UTIL: """
+            def build():
+                pass
+        """,
+        CALLER: """
+            def build():
+                pass
+
+            def go(obj):
+                obj.build()      # a METHOD on some object: unknowable
+        """,
+    })
+    gids = [g for g, b, _ in ps.calls.get(f"{CALLER}:go", [])
+            if b == "build"]
+    assert gids == [None]
+
+
+def test_callgraph_conflicting_from_imports_poison_the_name():
+    """Two from-imports binding the SAME local name to DIFFERENT
+    targets (top-level + a lazy function-local import) must resolve to
+    NEITHER: module-wide last-writer-wins would silently mis-edge every
+    call site of the other import."""
+    ps = _psyms({
+        UTIL: """
+            def load():
+                pass
+        """,
+        "distributed_llm_tpu/engine/other.py": """
+            def load():
+                pass
+        """,
+        CALLER: """
+            from ..engine.util import load
+
+            def go():
+                load()
+
+            def lazy():
+                from ..engine.other import load
+                load()
+        """,
+    })
+    for qual in ("go", "lazy"):
+        gids = [g for g, b, _ in ps.calls.get(f"{CALLER}:{qual}", [])
+                if b == "load"]
+        assert gids == [None], (qual, gids)
+
+
+def test_callgraph_resolves_thread_target_cross_module():
+    ps = _psyms({
+        UTIL: """
+            def loop():
+                pass
+        """,
+        CALLER: """
+            import threading
+            from ..engine.util import loop
+
+            def spawn():
+                threading.Thread(target=loop, daemon=True).start()
+        """,
+    })
+    targets = ps.thread_target_gids()
+    assert f"{UTIL}:loop" in targets
+    assert targets[f"{UTIL}:loop"][0][0] == CALLER
+
+
+def test_callgraph_resolves_callee_defined_later_in_file():
+    """Regression: the PR 4 walker resolved calls DURING the AST walk,
+    so a self-method call to a method defined later in the class (the
+    _admit -> _admit_replay shape) silently never edged."""
+    ps = _psyms({
+        CALLER: """
+            class C:
+                def first(self):
+                    self.second()
+
+                def second(self):
+                    pass
+        """,
+    })
+    gids = [g for g, _, _ in ps.calls.get(f"{CALLER}:C.first", [])]
+    assert f"{CALLER}:C.second" in gids
+
+
+# -- cross-module lock regression (the PR 2 shape, split across files) -------
+
+XMOD_MANAGER = """
+    import threading
+    from .builder import build_engine
+
+    class Manager:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self._engine = None
+
+        def health(self):
+            with self._lock:
+                if self._engine is None:
+                    self._engine = build_engine()
+                return {"ok": True}
+"""
+
+XMOD_BUILDER = """
+    def build_engine():
+        engine = object()
+        engine.warmup()              # compiles for minutes on chip
+        return engine
+"""
+
+
+def test_lock_checker_catches_pr2_shape_across_modules():
+    """ISSUE 8 acceptance: the lock-held-through-compile shape with the
+    blocking callee in ANOTHER FILE is now caught."""
+    result = _lint(LockChecker(), {
+        "distributed_llm_tpu/engine/xmanager.py":
+            textwrap.dedent(XMOD_MANAGER),
+        "distributed_llm_tpu/engine/builder.py":
+            textwrap.dedent(XMOD_BUILDER)})
+    blocking = [f for f in result.findings
+                if f.rule == "lock-blocking-call"]
+    assert len(blocking) == 1, result.findings
+    assert "transitively" in blocking[0].message
+    assert "warmup" in blocking[0].message
+    assert "builder.build_engine" in blocking[0].message
+
+
+def test_lock_checker_old_module_local_graph_was_a_miss():
+    """The same fixture with ONLY the manager module loaded produces no
+    finding: module-local resolution cannot see the callee — which is
+    exactly what the PR 4 (module-local) graph did even with both files
+    loaded.  This pins that the cross-module catch comes from the
+    import-resolved edge, not from bare-name matching."""
+    result = _lint(LockChecker(), {
+        "distributed_llm_tpu/engine/xmanager.py":
+            textwrap.dedent(XMOD_MANAGER)})
+    assert result.findings == []
+
+
+# -- retrace checker ---------------------------------------------------------
+
+def test_retrace_wrap_in_loop_flagged_and_warm_call_clean():
+    from distributed_llm_tpu.lint.checkers.retrace import RetraceChecker
+    bad = """
+        import jax
+
+        def serve(batches):
+            for b in batches:
+                fn = jax.jit(lambda x: x + 1)    # fresh trace per batch
+                fn(b)
+    """
+    result = _lint(RetraceChecker(), {ENGINE: bad})
+    assert "retrace-wrap-in-loop" in _rules(result)
+
+    good = """
+        import jax
+
+        fn = jax.jit(lambda x: x + 1)
+
+        def serve(batches):
+            for b in batches:
+                fn(b)                 # calling the wrapped fn: warm path
+    """
+    assert _lint(RetraceChecker(), {ENGINE: good}).findings == []
+
+
+def test_retrace_per_call_wrap_on_hot_path_flagged():
+    from distributed_llm_tpu.lint.checkers.retrace import RetraceChecker
+    bad = """
+        from functools import partial
+
+        import jax
+
+        def step(x, k):
+            return x + k
+
+        def handle(q):    # dllm-lint: hot-path
+            return jax.jit(partial(step, k=2))(q)   # re-traced per request
+    """
+    result = _lint(RetraceChecker(), {ENGINE: bad})
+    assert _rules(result) == ["retrace-per-call-wrap"], result.findings
+
+
+def test_retrace_per_call_wrap_inside_traced_code_clean():
+    """pallas_call/jit rebuilt INSIDE traced code traces once per outer
+    compile — the ops-module idiom must stay silent even when the
+    function is also hot-path-reachable (project-wide traced closure
+    wins)."""
+    from distributed_llm_tpu.lint.checkers.retrace import RetraceChecker
+    src = """
+        from functools import partial
+
+        import jax
+        from jax.experimental import pallas as pl
+
+        def _k(q_ref, o_ref, *, bs):
+            o_ref[0] = q_ref[0]
+
+        def op(x):
+            return pl.pallas_call(partial(_k, bs=4), grid=(1,))(x)
+
+        def run(x):
+            return op(x)
+
+        f = jax.jit(run)
+
+        def handle(q):    # dllm-lint: hot-path
+            return run(q)
+    """
+    assert _lint(RetraceChecker(), {ENGINE: src}).findings == []
+
+
+def test_retrace_dynamic_shape_upload_flagged_and_full_clean():
+    from distributed_llm_tpu.lint.checkers.retrace import RetraceChecker
+    bad = """
+        import jax.numpy as jnp
+
+        def tick(self, wb):
+            return jnp.asarray(self._tables[:, :wb])   # shape varies
+    """
+    result = _lint(RetraceChecker(), {ENGINE: bad})
+    assert _rules(result) == ["retrace-dynamic-shape"]
+
+    good = """
+        import jax.numpy as jnp
+
+        def tick(self):
+            full = jnp.asarray(self._tables)        # shape-stable
+            head = jnp.asarray(self._tables[:, :8])  # constant bound
+            return full, head
+    """
+    assert _lint(RetraceChecker(), {ENGINE: good}).findings == []
+
+
+def test_retrace_shape_derived_scalar_without_static_argnums():
+    from distributed_llm_tpu.lint.checkers.retrace import RetraceChecker
+    bad = """
+        import jax
+
+        def _run(x, width):
+            return x
+
+        fn = jax.jit(_run)
+
+        def serve(x, tokens):
+            return fn(x, len(tokens))
+    """
+    result = _lint(RetraceChecker(), {ENGINE: bad})
+    assert _rules(result) == ["retrace-dynamic-shape"], result.findings
+    assert "static_argnums" in result.findings[0].message
+
+    good = bad.replace("fn = jax.jit(_run)",
+                       "fn = jax.jit(_run, static_argnums=(1,))")
+    assert _lint(RetraceChecker(), {ENGINE: good}).findings == []
+
+
+def test_retrace_shape_cache_key_flagged_and_slice_clean():
+    from distributed_llm_tpu.lint.checkers.retrace import RetraceChecker
+    bad = """
+        _cache = {}
+
+        def get(x):
+            return _cache[f"prog-{x.shape}"]
+    """
+    result = _lint(RetraceChecker(), {ENGINE: bad})
+    assert _rules(result) == ["retrace-shape-cache-key"]
+
+    good = """
+        def get(x, q):
+            window = x[:, : q.shape[1]]      # slicing TO a bound: fine
+            msg = f"shapes {x.shape}"        # logging: fine
+            return window, msg
+    """
+    assert _lint(RetraceChecker(), {ENGINE: good}).findings == []
+
+
+def test_retrace_shape_scalar_index_is_not_a_cache_key():
+    """``tables[q.shape[0]]`` is ordinary array indexing — a shape
+    INDEXED down to a scalar must not read as a mapping key (mappings
+    and arrays are statically indistinguishable; only the
+    unambiguously-mapping-shaped keys fire)."""
+    from distributed_llm_tpu.lint.checkers.retrace import RetraceChecker
+    good = """
+        def gather(tables, q, buf):
+            row = tables[q.shape[0]]
+            last = buf[q.shape[1] - 1]
+            return row, last
+    """
+    assert _lint(RetraceChecker(), {ENGINE: good}).findings == []
+
+    # But the shape used AS a value in a tuple key still fires.
+    bad = """
+        def get(cache, x):
+            return cache[(x.shape, x.dtype)]
+    """
+    result = _lint(RetraceChecker(), {ENGINE: bad})
+    assert _rules(result) == ["retrace-shape-cache-key"], result.findings
+
+
+def test_retrace_warmup_exempt():
+    from distributed_llm_tpu.lint.checkers.retrace import RetraceChecker
+    src = """
+        import jax.numpy as jnp
+
+        def warmup(self):
+            for wb in self._buckets:
+                arr = jnp.asarray(self._tables[:, :wb])   # warmup's JOB
+    """
+    assert _lint(RetraceChecker(), {ENGINE: src}).findings == []
+
+
+# -- transfer checker --------------------------------------------------------
+
+def test_transfer_sync_in_cross_module_hot_callee_flagged():
+    """The headline shape: the hot-path root is in one module, the sync
+    hides in a helper in ANOTHER — only the project-wide closure sees
+    it."""
+    from distributed_llm_tpu.lint.checkers.transfer import TransferChecker
+    files = {
+        ENGINE: """
+            from ..serving.helper import pull
+
+            def tick(self):    # dllm-lint: hot-path
+                while True:
+                    pull(self.buf)
+        """,
+        "distributed_llm_tpu/serving/helper.py": """
+            import jax
+
+            def pull(buf):
+                return jax.block_until_ready(buf)
+        """,
+    }
+    result = _lint(TransferChecker(), files)
+    assert _rules(result) == ["transfer-host-sync"], result.findings
+    assert result.findings[0].path == "distributed_llm_tpu/serving/helper.py"
+
+
+def test_transfer_sync_outside_hot_path_and_warmup_clean():
+    from distributed_llm_tpu.lint.checkers.transfer import TransferChecker
+    src = """
+        import jax
+
+        def generate(self, q):          # not hot-path-annotated
+            out = self._fn(q)
+            return jax.block_until_ready(out)
+
+        def tick(self):    # dllm-lint: hot-path
+            self.warmup_programs()
+
+        def warmup_programs(self):      # warmup-named: exempt
+            jax.block_until_ready(self._fn(0))
+    """
+    assert _lint(TransferChecker(), {ENGINE: src}).findings == []
+
+
+def test_transfer_item_and_round_trip_flagged():
+    from distributed_llm_tpu.lint.checkers.transfer import TransferChecker
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def tick(self):    # dllm-lint: hot-path
+            x = self.state.item()                # device pull per call
+            y = np.asarray(jnp.dot(self.a, self.b))   # implicit pull
+            z = int(toks[0])                     # host indexing: fine
+            return x, y, z
+    """
+    result = _lint(TransferChecker(), {ENGINE: src})
+    assert sorted(_rules(result)) == ["transfer-host-round-trip",
+                                      "transfer-host-sync"]
+
+
+def test_transfer_sync_inside_lambda_on_hot_path_flagged():
+    """A lambda is not a call-graph entry and cannot carry its own
+    hot-path annotation, so its body scans as part of the enclosing hot
+    function — a per-tick sync must not hide in one."""
+    from distributed_llm_tpu.lint.checkers.transfer import TransferChecker
+    src = """
+        import jax
+
+        def tick(self):    # dllm-lint: hot-path
+            pull = lambda v: int(jax.device_get(v))
+            return pull(self.state)
+    """
+    result = _lint(TransferChecker(), {ENGINE: src})
+    assert "transfer-host-sync" in _rules(result), result.findings
+
+
+def test_transfer_undonated_buffer_flagged_and_donated_clean():
+    from distributed_llm_tpu.lint.checkers.transfer import TransferChecker
+    bad = """
+        import jax
+
+        def _step(params, pool, tok):
+            pool = pool + 1
+            return tok, pool
+
+        fn = jax.jit(_step)
+    """
+    result = _lint(TransferChecker(), {ENGINE: bad})
+    assert _rules(result) == ["transfer-undonated-buffer"], result.findings
+    assert "pool" in result.findings[0].message
+
+    good = bad.replace("fn = jax.jit(_step)",
+                       "fn = jax.jit(_step, donate_argnums=(1,))")
+    assert _lint(TransferChecker(), {ENGINE: good}).findings == []
+
+
+# -- thread_lifecycle checker ------------------------------------------------
+
+def test_thread_no_reclaim_flagged_daemon_and_joined_clean():
+    from distributed_llm_tpu.lint.checkers.thread_lifecycle import \
+        ThreadLifecycleChecker
+    bad = """
+        import threading
+
+        def spawn():
+            t = threading.Thread(target=work)
+            t.start()                       # never joined, not daemon
+
+        def work():
+            pass
+    """
+    result = _lint(ThreadLifecycleChecker(), {SERVING: bad})
+    assert _rules(result) == ["thread-no-reclaim"]
+
+    daemon = bad.replace("threading.Thread(target=work)",
+                         "threading.Thread(target=work, daemon=True)")
+    assert _lint(ThreadLifecycleChecker(), {SERVING: daemon}).findings == []
+
+    joined = bad.replace("t.start()                       "
+                         "# never joined, not daemon",
+                         "t.start()\n            t.join()")
+    assert _lint(ThreadLifecycleChecker(), {SERVING: joined}).findings == []
+
+
+def test_thread_string_join_does_not_reclaim():
+    """``", ".join(names)`` is the formatting idiom, not a thread join —
+    it must not silence thread-no-reclaim for an unrelated Thread in the
+    same function (only thread-shaped joins count: no args, or a
+    timeout)."""
+    from distributed_llm_tpu.lint.checkers.thread_lifecycle import \
+        ThreadLifecycleChecker
+    bad = """
+        import threading
+
+        def spawn(names):
+            label = ", ".join(names)
+            t = threading.Thread(target=work, name=label)
+            t.start()
+
+        def work():
+            pass
+    """
+    result = _lint(ThreadLifecycleChecker(), {SERVING: bad})
+    assert _rules(result) == ["thread-no-reclaim"], result.findings
+
+    joined = bad.replace("t.start()", "t.start()\n            t.join(2.0)")
+    assert _lint(ThreadLifecycleChecker(), {SERVING: joined}).findings == []
+
+
+def test_thread_join_must_name_its_thread():
+    """Joining worker A must not silence a never-joined worker B in the
+    same function — the join is matched to the thread's own binding."""
+    from distributed_llm_tpu.lint.checkers.thread_lifecycle import \
+        ThreadLifecycleChecker
+    bad = """
+        import threading
+
+        def spawn():
+            a = threading.Thread(target=work)
+            b = threading.Thread(target=work)
+            a.start()
+            b.start()
+            a.join()                    # b is never joined
+
+        def work():
+            pass
+    """
+    result = _lint(ThreadLifecycleChecker(), {SERVING: bad})
+    assert _rules(result) == ["thread-no-reclaim"], result.findings
+
+    both = bad.replace("a.join()                    # b is never joined",
+                       "a.join()\n            b.join()")
+    assert _lint(ThreadLifecycleChecker(), {SERVING: both}).findings == []
+
+
+def test_thread_loop_variable_join_reclaims_fanout():
+    """The bench fan-out idiom: threads collected in a list, joined
+    through a loop variable — an alias no spawn is bound to counts as
+    reclamation (the binding is untraceable, edge-only-when-proven cuts
+    the other way for reclaim credit)."""
+    from distributed_llm_tpu.lint.checkers.thread_lifecycle import \
+        ThreadLifecycleChecker
+    good = """
+        import threading
+
+        def fan_out(n):
+            workers = []
+            for _ in range(n):
+                t = threading.Thread(target=work)
+                t.start()
+                workers.append(t)
+            for th in workers:
+                th.join(5.0)
+
+        def work():
+            pass
+    """
+    assert _lint(ThreadLifecycleChecker(), {SERVING: good}).findings == []
+
+
+def test_thread_reclaim_requires_stop_reachable_join():
+    """A join parked in a method NO stop/drain path calls does not
+    reclaim the thread — nothing runs it at shutdown."""
+    from distributed_llm_tpu.lint.checkers.thread_lifecycle import \
+        ThreadLifecycleChecker
+    good = """
+        import threading
+
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                pass
+
+            def stop(self):
+                self._t.join(timeout=2)
+    """
+    assert _lint(ThreadLifecycleChecker(), {SERVING: good}).findings == []
+
+    bad = good.replace("def stop(self):", "def refresh(self):")
+    result = _lint(ThreadLifecycleChecker(), {SERVING: bad})
+    assert _rules(result) == ["thread-no-reclaim"]
+
+
+def test_thread_acquire_leak_flagged_and_finally_clean():
+    from distributed_llm_tpu.lint.checkers.thread_lifecycle import \
+        ThreadLifecycleChecker
+    bad = """
+        import threading
+
+        class M:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def step(self):
+                self._lock.acquire()
+                do_work()                # raises -> lock held forever
+                self._lock.release()
+    """
+    result = _lint(ThreadLifecycleChecker(), {ENGINE: bad})
+    assert _rules(result) == ["thread-acquire-leak"]
+
+    good = """
+        import threading
+
+        class M:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def step(self):
+                self._lock.acquire()
+                try:
+                    do_work()
+                finally:
+                    self._lock.release()
+    """
+    assert _lint(ThreadLifecycleChecker(), {ENGINE: good}).findings == []
+
+
+def test_thread_ring_no_stop_flagged_and_drained_clean():
+    from distributed_llm_tpu.lint.checkers.thread_lifecycle import \
+        ThreadLifecycleChecker
+    bad = """
+        import threading
+
+        class Recorder:
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                pass
+
+        RECORDER = Recorder()       # module-scope, no stop hook at all
+    """
+    result = _lint(ThreadLifecycleChecker(), {SERVING: bad})
+    assert _rules(result) == ["thread-ring-no-stop"]
+    assert "no stop/close/shutdown hook" in result.findings[0].message
+
+    good = """
+        import threading
+
+        class Recorder:
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                pass
+
+            def stop(self):
+                pass
+
+        RECORDER = Recorder()
+
+        def drain_all():
+            RECORDER.stop()
+    """
+    assert _lint(ThreadLifecycleChecker(), {SERVING: good}).findings == []
+
+    orphan = good.replace("def drain_all():", "def refresh_all():")
+    result = _lint(ThreadLifecycleChecker(), {SERVING: orphan})
+    assert _rules(result) == ["thread-ring-no-stop"]
+    assert "never called" in result.findings[0].message
+
+
+def test_thread_ring_hook_match_requires_instance_receiver():
+    """An unrelated ``fh.close()`` in a drain path must not mark a
+    never-stopped recorder reclaimed — the hook call's receiver has to
+    name the module-scope instance."""
+    from distributed_llm_tpu.lint.checkers.thread_lifecycle import \
+        ThreadLifecycleChecker
+    bad = """
+        import threading
+
+        class Recorder:
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                pass
+
+            def close(self):
+                pass
+
+        RECORDER = Recorder()
+
+        def drain_all(fh):
+            fh.close()                  # a file handle, not the ring
+    """
+    result = _lint(ThreadLifecycleChecker(), {SERVING: bad})
+    assert _rules(result) == ["thread-ring-no-stop"], result.findings
+
+    good = bad.replace("fh.close()                  # a file handle, "
+                       "not the ring",
+                       "RECORDER.close()")
+    assert _lint(ThreadLifecycleChecker(), {SERVING: good}).findings == []
+
+
+# -- --changed reporting filter ----------------------------------------------
+
+def test_filter_changed_keeps_whole_project_findings():
+    from distributed_llm_tpu.lint.core import Finding, LintResult, \
+        filter_changed
+
+    class _Narrow:
+        whole_project = False
+        rules = ("span-not-with",)
+
+    class _Wide:
+        whole_project = True
+        rules = ("lock-blocking-call",)
+
+    result = LintResult(findings=[
+        Finding("span-not-with", "a.py", 1, "in changed file"),
+        Finding("span-not-with", "b.py", 1, "in unchanged file"),
+        Finding("lock-blocking-call", "b.py", 2, "whole-project rule"),
+    ], suppressed=[])
+    out = filter_changed(result, ["a.py"], [_Narrow(), _Wide()])
+    got = [(f.rule, f.path) for f in out.findings]
+    assert got == [("span-not-with", "a.py"),
+                   ("lock-blocking-call", "b.py")]
+
+
+def test_filter_changed_never_drops_parse_or_suppression_findings():
+    """A syntax error (or naked suppression) in an UNCHANGED file blinds
+    every whole-project analysis to that module — --changed must surface
+    it, not report a green the graph checkers cannot back."""
+    from distributed_llm_tpu.lint.core import (Finding, JUSTIFICATION_RULE,
+                                               LintResult, PARSE_RULE,
+                                               filter_changed)
+    result = LintResult(findings=[
+        Finding(PARSE_RULE, "unchanged.py", 1, "syntax error"),
+        Finding(JUSTIFICATION_RULE, "unchanged.py", 2, "naked suppression"),
+    ], suppressed=[])
+    out = filter_changed(result, ["a.py"], [])
+    assert [(f.rule, f.path) for f in out.findings] == [
+        (PARSE_RULE, "unchanged.py"),
+        (JUSTIFICATION_RULE, "unchanged.py")]
+
+
+def test_config_drift_widens_under_changed_mode():
+    """config-env-stale lands in the UNCHANGED registry file when an
+    edit elsewhere deletes a knob's last reader — config_drift must be
+    whole_project so --changed cannot drop it."""
+    from distributed_llm_tpu.lint.checkers.config_drift import \
+        ConfigDriftChecker
+    assert ConfigDriftChecker.whole_project is True
+
+
+def test_changed_mode_survives_unusable_git(monkeypatch):
+    """No git binary / hung git falls back to a full-project run (None),
+    not a traceback."""
+    import subprocess as sp
+    from distributed_llm_tpu.lint.__main__ import _git_changed_files
+
+    def boom(*a, **k):
+        raise FileNotFoundError("git")
+    monkeypatch.setattr(sp, "run", boom)
+    assert _git_changed_files("/", "HEAD") is None
+
+
+def test_hot_path_annotation_parsed_on_def_and_line_above():
+    src = textwrap.dedent("""
+        def a():    # dllm-lint: hot-path
+            pass
+
+        # dllm-lint: hot-path
+        def b():
+            pass
+    """)
+    from distributed_llm_tpu.lint.symbols import (hot_path_roots,
+                                                  project_symbols)
+    project = Project("/", {ENGINE: Module(ENGINE, src)})
+    roots = hot_path_roots(project_symbols(project))
+    assert roots == {f"{ENGINE}:a", f"{ENGINE}:b"}
+
+
+# -- perf: one parse, one graph, bounded wall clock --------------------------
+
+def test_full_repo_lint_wall_clock_under_10s():
+    """CI ergonomics pin (ISSUE 8): all nine checkers over the whole
+    repo — shared ASTs, one ProjectSymbols build — stay well inside the
+    tier-1 budget."""
+    t0 = time.perf_counter()
+    run_lint()
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 10.0, f"full-repo lint took {elapsed:.1f}s"
+
+
+def test_project_symbols_built_once_per_project():
+    from distributed_llm_tpu.lint import load_project
+    from distributed_llm_tpu.lint.symbols import project_symbols
+    project = load_project(repo_root())
+    ps1 = project_symbols(project)
+    ps2 = project_symbols(project)
+    assert ps1 is ps2
+
+
 # -- the tier-1 pin: the repo lints clean ------------------------------------
 
 def test_repo_lints_clean():
