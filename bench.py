@@ -29,6 +29,16 @@ import time
 # forever — see CONFIG.md / distributed_llm_tpu/config_registry.py.
 from distributed_llm_tpu.config_registry import (env_flag, env_float,
                                                  env_int)
+# The ONE nearest-rank percentile (also jax-free) — the skew and mixed
+# legs must report the same "p95" the sampler gauges and SLO verdicts
+# use, not a private rounding variant per leg.
+from distributed_llm_tpu.obs.metrics import nearest_rank
+
+
+def _pct(values, q):
+    """Leg-local convenience: shared nearest-rank, rounded for artifacts."""
+    v = nearest_rank(values, q)
+    return None if v is None else round(v, 3)
 
 # Reference throughput on the same query set (see module docstring).
 BASELINE_REQ_PER_S = 12 / (922.2 + 176.0)
@@ -107,22 +117,40 @@ class Progress:
     def idle_s(self) -> float:
         return time.monotonic() - self._beat
 
+    def _write_partial(self, payload: dict) -> None:
+        # Atomic tmp-write-then-replace, caller holds self._lock: a
+        # reader (trend tooling, the SIGTERM flush) never sees a torn
+        # partial.
+        import os
+        tmp = self.partial_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.partial_path)
+        except OSError:
+            pass
+
     def section(self, name: str, value) -> None:
         with self._lock:
             self.data[name] = value
-            tmp = self.partial_path + ".tmp"
-            try:
-                with open(tmp, "w") as f:
-                    json.dump(self.data, f)
-                import os
-                os.replace(tmp, self.partial_path)
-            except OSError:
-                pass
+            self._write_partial(self.data)
         self.beat()
 
     def snapshot(self) -> dict:
         with self._lock:
             return dict(self.data)
+
+    def finalize(self, result: dict) -> None:
+        """Stamp the partial FINAL once the run completes: an
+        interrupted run leaves BENCH_partial.json behind, and trend
+        tooling reading it later cannot tell a dead partial from a
+        current detail dump.  Rewriting it with the COMPLETED result
+        plus a ``"final": true`` marker keeps the detail dump the
+        partial doubles as, while making staleness detectable (a
+        partial without the marker is an interrupted run's leftovers).
+        """
+        with self._lock:
+            self._write_partial(dict(result, final=True))
 
     def flush_compact(self) -> None:
         """(Re)print the compact FINAL line from the sections recorded
@@ -275,6 +303,25 @@ def compact(result: dict) -> dict:
         out["skew_tick_p50_ms"] = {
             m: (sk.get(m) or {}).get("decode_tick_p50_ms")
             for m in ("dense", "ragged") if isinstance(sk.get(m), dict)}
+    mx = result.get("mixed")
+    if isinstance(mx, dict):
+        # One number each (BENCHMARKS.md r12): the chunked short-class
+        # p95 TBT ratio (injected/calm — ≤ ~1.05 = no regression), the
+        # monolithic twin, both modes' absorption-window stalls and
+        # long-class TTFTs, and the cross-mode byte-identity re-check.
+        ch = mx.get("chunked") or {}
+        mo = mx.get("monolithic") or {}
+        cm = {k: v for k, v in {
+            "tbt95_ratio": ch.get("tbt95_ratio"),
+            "tbt95_ratio_mono": mo.get("tbt95_ratio"),
+            "stall_chunked": ch.get("stall_max_ms"),
+            "stall_mono": mo.get("stall_max_ms"),
+            "ttft_long_chunked": ch.get("long_ttft_ms"),
+            "ttft_long_mono": mo.get("long_ttft_ms"),
+            "ident": mx.get("outputs_identical"),
+        }.items() if v is not None}
+        if cm:
+            out["mixed"] = cm
     strategies = result.get("per_strategy")
     if isinstance(strategies, dict):
         # t50/t95 = trace-derived p50/p95 TTFT, tbt50 = trace-derived
@@ -837,13 +884,6 @@ def skew_phase(n_requests: int = 32, beat=lambda: None) -> dict:
                  "steps_per_tick": base.decode_steps_per_tick,
                  "dispatch": dispatch_provenance()}
 
-    def pct(values, q):
-        if not values:
-            return None
-        values = sorted(values)
-        ix = min(len(values) - 1, int(q * (len(values) - 1) + 0.5))
-        return round(values[ix], 3)
-
     token_ids: dict = {}
     # The leg flips attention_ragged itself: an exported DLLM_RAGGED
     # would override BOTH engines (the 'dense' leg would silently
@@ -882,8 +922,8 @@ def skew_phase(n_requests: int = 32, beat=lambda: None) -> dict:
                 gauge = None
             out[mode] = {
                 "req_per_s": round(n_requests / max(wall, 1e-9), 4),
-                "decode_tick_p50_ms": pct(ticks, 0.50),
-                "decode_tick_p95_ms": pct(ticks, 0.95),
+                "decode_tick_p50_ms": _pct(ticks, 0.50),
+                "decode_tick_p95_ms": _pct(ticks, 0.95),
                 "ticks": len(ticks),
                 "errors": errors,
                 "compiled_decode_programs":
@@ -924,6 +964,265 @@ def skew_phase(n_requests: int = 32, beat=lambda: None) -> dict:
         len(token_ids.get("dense", ())) == n_requests
         and len(token_ids.get("ragged", ())) == n_requests
         and token_ids["dense"] == token_ids["ragged"])
+    return out
+
+
+def mixed_phase(repeats: int = 2, beat=lambda: None) -> dict:
+    """Mixed-phase prefill-interference leg (ISSUE 9): a LONG prompt
+    arrives mid-stream next to a short streaming request, chunked
+    prefill (``prefill_chunk_tokens``) vs monolithic one-shot prefill —
+    same engine family, same seed, same prompts, only the chunk config
+    flips.
+
+    Methodology (every choice earned by a failure of the naive design):
+
+    - **mini_bench at one decode step per tick.**  The tiny test model's
+      256-token prefill costs about one decode tick, so the stall this
+      leg exists to show sits inside box noise.  mini_bench's 1792-token
+      bucket prefill is ~7 ticks of wall — the monolithic freeze is
+      unmistakable — while a 256-token chunk grant is ~one tick.  One
+      scanned step per tick keeps every inter-token gap an observable
+      tick boundary.
+    - **Calm rounds get a SHORT co-tenant where injected rounds get the
+      long prompt** (same arrival point, same decode budget): the two
+      rounds then differ ONLY in prefill shape — co-decode cost, slot
+      occupancy, and admission all cancel in the ratio instead of
+      polluting it.
+    - **Gaps pool across rounds** before taking p95 (a per-round p95 of
+      ~60 gaps swings with single-tick hiccups); rounds alternate
+      calm/injected so drift lands on both sides of the ratio, and the
+      two MODES interleave round-by-round so a minutes-scale load swing
+      cannot land wholesale on whichever mode ran second.
+    - **Budget 2 grants per absorption** (chunk 256 × budget 768 over
+      a ~1500-token prompt in the 1792 bucket): the extended ticks stay
+      below the pooled p95 index by construction, which IS the design
+      claim — absorption must not move the p95, only the (bounded) max.
+      Monolithic also pays the PADDED bucket where chunks pay actual
+      tokens, so the stall contrast understates nothing.
+
+    Reported per mode: pooled calm/injected p95 TBT of the measured
+    stream for context, and the headline ``tbt95_ratio`` — the median
+    over injected rounds of p95(whole-life gaps) / p95(same round's
+    outside-absorption gaps) (≤ ~1.05 = the long prompt's absorption
+    did not move the p95 tick cadence).  The baseline lives INSIDE the
+    round because a cross-round one was measured swinging 2x with this
+    box's minutes-scale load; per-round ratios for spread; ``stall_max_ms`` — the largest gap inside the
+    absorption window [arrival submit, arrival first token] (median
+    over injected rounds; monolithic concentrates the whole prefill
+    into that ONE gap, chunked bounds it near one budget grant, and
+    ``stall_calm_ms`` is the same statistic for the short co-tenant's
+    absorption = the no-interference floor); the long request's TTFT
+    and its own p95 TBT once decoding (chunked TRADES long-prompt TTFT
+    for flat short-stream TBT — both sides of the trade are in the
+    artifact).
+
+    Greedy outputs must be byte-identical between modes for every
+    class (per-slot decode math is independent of co-tenants — same
+    contract the skew leg re-checks for dense/ragged).  Scale note:
+    the 1792-token bucket stands in for the ≥4k prompts this leg
+    measures on real presets — the interference MECHANISM (prefill
+    serializing the shared scheduler) is identical, only the stall
+    magnitude grows with prompt length."""
+    import dataclasses
+    import sys
+    import threading
+
+    from distributed_llm_tpu.config import tiny_batched_cluster
+    from distributed_llm_tpu.engine.batching import ContinuousBatchingEngine
+
+    print("[bench] mixed-phase chunked-prefill leg", file=sys.stderr,
+          flush=True)
+    chunk, budget = 256, 768
+    base = dataclasses.replace(
+        tiny_batched_cluster().nano,
+        model_preset="mini_bench", decode_batch=2,
+        decode_steps_per_tick=1, max_new_tokens=64,
+        prefill_buckets=(16, 64, 1792),
+        enable_prefix_cache=False)
+    measured_q = "measured short question about rivers please"
+    co_q = "co-tenant short question about lakes please"
+    long_q = ("long document: " + "rivers lakes mountains oceans deltas "
+              * 150)             # ~1500 tokens -> the 1792 bucket
+    arrival_new = 24             # same decode budget both round kinds
+    out: dict = {"model_preset": base.model_preset,
+                 "decode_batch": base.decode_batch,
+                 "short_max_new": base.max_new_tokens,
+                 "arrival_max_new": arrival_new,
+                 "chunk_tokens": chunk, "chunk_budget": budget,
+                 "repeats": repeats}
+
+    def med(vals):
+        vals = sorted(v for v in vals if v is not None)
+        return (round(vals[len(vals) // 2], 3) if vals else None)
+
+    token_ids: dict = {}
+    modes = (("monolithic", dict(prefill_chunk_tokens=None)),
+             ("chunked", dict(prefill_chunk_tokens=chunk,
+                              prefill_chunk_budget=budget)))
+    engines: dict = {}
+    acc = {m: {"calm_pool": [], "inj_pool": [], "pair_ratios": [],
+               "calm_stalls": [], "inj_stalls": [], "ttfts": [],
+               "ltbts": [], "errors": 0, "fatal": None}
+           for m, _ in modes}
+
+    def run_round(eng, inject: bool):
+        """One round: the measured stream decodes; once primed, the
+        arrival (long when injecting, short otherwise) lands
+        mid-stream.  Returns the measured stream's gaps, the
+        absorption-window stall, and both results."""
+        stamps: list = []
+        stream_res: dict = {}
+        errors: list = []
+
+        def client():
+            try:
+                h = eng.generate_stream(measured_q)
+                for _ in h:
+                    stamps.append(time.perf_counter())
+                stream_res["r"] = h.request.result
+            except Exception as exc:
+                errors.append(str(exc))
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        deadline = time.time() + 120
+        while not stamps and time.time() < deadline:
+            time.sleep(0.002)            # primed: genuinely mid-stream
+        t_sub = time.perf_counter()
+        ah = eng.generate_stream(long_q if inject else co_q,
+                                 max_new_tokens=arrival_new)
+        at: list = []
+        for _ in ah:
+            at.append(time.perf_counter())
+        ares = ah.request.result
+        t.join(timeout=300)
+        gaps = [(b - a) * 1000.0 for a, b in zip(stamps, stamps[1:])]
+        t_first = t_sub + ((ares.ttft_ms / 1000.0)
+                           if ares is not None else 0.0)
+        # A gap belongs to the absorption when its INTERVAL overlaps
+        # the window: the monolithic prefill's giant gap ENDS one tick
+        # after the long's first token (the prefill itself stamps the
+        # TTFT), so an ends-inside filter would miss exactly the stall
+        # this leg exists to show.  The round's OTHER gaps are its own
+        # drift-free baseline (fixed-width table gather makes tick
+        # cost occupancy-independent, so pre-arrival and co-decode
+        # ticks are exchangeable).
+        stall, base = [], []
+        for g, (a, b) in zip(gaps, zip(stamps, stamps[1:])):
+            (stall if (b >= t_sub and a <= t_first) else base).append(g)
+        return {
+            "gaps": gaps,
+            "base_gaps": base,
+            "stall": max(stall) if stall else None,
+            "ttft_ms": (round(ares.ttft_ms, 3)
+                        if ares is not None else None),
+            "arrival_gaps": [(b - a) * 1000.0
+                             for a, b in zip(at, at[1:])],
+            "stream_tokens": (tuple(stream_res["r"].token_ids)
+                              if stream_res.get("r") is not None else ()),
+            "arrival_tokens": (tuple(ares.token_ids)
+                               if ares is not None else ()),
+            "errors": errors,
+        }
+
+    try:
+        for mode, cfgkw in modes:
+            try:
+                eng = ContinuousBatchingEngine(
+                    dataclasses.replace(base, **cfgkw), seed=11)
+                engines[mode] = eng
+                eng.warmup(beat)
+                # Warm the long path's programs (monolithic: the
+                # top-bucket prefill; chunked: re-touches warmup's
+                # chunk family), then one untimed concurrent round —
+                # the first pass after warmup runs 2-4x slow on this
+                # box (cold caches, not the engine).
+                eng.generate(long_q, max_new_tokens=2)
+                beat()
+                run_round(eng, inject=True)
+                beat()
+            except Exception as exc:
+                acc[mode]["fatal"] = str(exc)[:200]
+        # Rounds INTERLEAVE the two modes (m-calm, m-inj, c-calm,
+        # c-inj, repeat): this box carries minutes-scale exogenous
+        # load swings, and running one mode's whole block first was
+        # measured to hand that entire swing to whichever mode drew
+        # the loaded minutes.  Interleaved, both modes sample the
+        # same load epochs and the within-mode calm/injected pairs
+        # stay back-to-back.
+        for _ in range(repeats):
+            for mode, _ in modes:
+                a = acc[mode]
+                if a["fatal"] is not None or mode not in engines:
+                    continue
+                try:
+                    calm = run_round(engines[mode], inject=False)
+                    beat()
+                    inj = run_round(engines[mode], inject=True)
+                    beat()
+                except Exception as exc:
+                    a["fatal"] = str(exc)[:200]
+                    continue
+                a["errors"] += len(calm["errors"]) + len(inj["errors"])
+                a["calm_pool"].extend(calm["gaps"])
+                a["inj_pool"].extend(inj["gaps"])
+                # The headline ratio is WITHIN-round: p95 of the
+                # injected round's whole-life gaps over p95 of the
+                # same round's outside-absorption gaps.  A cross-round
+                # calm baseline was measured swinging 2x with this
+                # box's minutes-scale load; the same-round baseline
+                # shares its round's load state, so only absorption's
+                # own effect on the p95 survives the division.
+                i95 = _pct(inj["gaps"], 0.95)
+                b95 = _pct(inj["base_gaps"], 0.95)
+                if i95 and b95:
+                    a["pair_ratios"].append(round(i95 / b95, 3))
+                a["calm_stalls"].append(calm["stall"])
+                a["inj_stalls"].append(inj["stall"])
+                a["ttfts"].append(inj["ttft_ms"])
+                a["ltbts"].append(_pct(inj["arrival_gaps"], 0.95))
+                token_ids.setdefault(mode, {})["short"] = \
+                    inj["stream_tokens"]
+                token_ids.setdefault(mode, {})["long"] = \
+                    inj["arrival_tokens"]
+                token_ids.setdefault(mode, {})["co"] = \
+                    calm["arrival_tokens"]
+    finally:
+        for eng in engines.values():
+            try:
+                eng.stop()
+            except Exception:
+                pass
+    for mode, _ in modes:
+        a = acc[mode]
+        calm_p95 = _pct(a["calm_pool"], 0.95)
+        inj_p95 = _pct(a["inj_pool"], 0.95)
+        entry = {
+            "repeats": repeats,
+            "calm_tbt_p95_ms": calm_p95,
+            "short_tbt_p95_ms": inj_p95,
+            "tbt95_ratio": med(a["pair_ratios"]),
+            "tbt95_ratios": a["pair_ratios"],
+            "stall_max_ms": med(a["inj_stalls"]),
+            "stall_calm_ms": med(a["calm_stalls"]),
+            "long_ttft_ms": med(a["ttfts"]),
+            "long_tbt_p95_ms": med(a["ltbts"]),
+            "errors": a["errors"],
+        }
+        if a["fatal"] is not None:
+            entry["error"] = a["fatal"]
+        out[mode] = entry
+        beat()
+    # Same prompts, same seed, greedy: every class's tokens must be
+    # identical between modes — chunked prefill changes WHEN prompt K/V
+    # is written, never what it contains.  Not vacuous: every class
+    # must have produced tokens in both modes.
+    ids_c = token_ids.get("chunked") or {}
+    ids_m = token_ids.get("monolithic") or {}
+    out["outputs_identical"] = bool(
+        ids_c and ids_m
+        and all(ids_c.get(k) and ids_c.get(k) == ids_m.get(k)
+                for k in ("short", "long", "co")))
     return out
 
 
@@ -1899,6 +2198,23 @@ def run(progress: "Progress" = None, budget: "Budget" = None) -> dict:
     progress.section("skew", skew)
     progress.flush_compact()
 
+    # Mixed-phase chunked-prefill leg right after the skew leg (ISSUE 9;
+    # mini_bench so the prefill stall is physically visible): a
+    # 1792-bucket prompt injected mid-stream next to a short stream,
+    # chunked vs monolithic prefill at the same seed/prompts —
+    # short-class p95 TBT ratio vs a calm round with a short co-tenant,
+    # the absorption-window stall, long-class TTFT, and the
+    # byte-identity re-check (BENCHMARKS.md r12 "mixed leg" semantics).
+    if budget.allows(270):
+        try:
+            mixed = mixed_phase(beat=progress.beat)
+        except Exception as exc:          # never lose the headline line
+            mixed = {"error": str(exc)[:200]}
+    else:
+        mixed = {"skipped": budget.skip_stamp()}
+    progress.section("mixed", mixed)
+    progress.flush_compact()
+
     # Open-loop SLO goodput leg right after the skew leg (ISSUE 7; same
     # pinned tiny-batched family): Poisson arrivals through the real
     # in-process HTTP edge, arrival rate swept (adaptive doubling) to
@@ -2444,6 +2760,9 @@ if __name__ == "__main__":
     progress.done.set()
     # Full detail on the first line (and in BENCH_partial.json); the
     # LAST line stays compact so the driver's tail capture parses it
-    # (VERDICT r2 weak #2).
+    # (VERDICT r2 weak #2).  The partial is stamped FINAL the moment the
+    # real artifact exists, so trend tooling never reads an interrupted
+    # run's dead partial as current.
     print(json.dumps(result), flush=True)
+    progress.finalize(result)
     print(json.dumps(compact(result)))

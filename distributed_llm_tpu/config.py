@@ -289,6 +289,29 @@ class TierConfig:
     # mesh rule; the KERNEL inside the fused tick stays the table's
     # measured choice (DLLM_ATTENTION overrides that separately).
     attention_ragged: bool = True
+    # Disaggregated chunked prefill (engine/batching.py): a cold
+    # admission whose prompt bucket exceeds this many tokens no longer
+    # prefills in ONE monolithic compiled call on the scheduler thread
+    # (which froze every active decode slot for the whole prompt —
+    # BENCHMARKS.md r6's concurrency ceiling).  Instead the prompt is
+    # split into fixed chunks of this size and the scheduler interleaves
+    # them with decode ticks (chunk_prefill_paged writes each chunk's
+    # K/V straight into the slot's pool blocks), so time-between-tokens
+    # for in-flight streams is bounded by ONE CHUNK of prefill work
+    # instead of one whole prompt.  Must be a multiple of kv_block_size
+    # (chunks page evenly); the compiled chunk-program family is keyed
+    # only by (chunk, window-rung) so it stays bounded regardless of
+    # prompt length.  Prompts that fit a single chunk keep the
+    # monolithic path — they already meet the TBT bound.  0/None
+    # disables chunking (every admission prefills in one shot).
+    prefill_chunk_tokens: Optional[int] = 256
+    # Prefill token budget per scheduler tick: after serving all
+    # decoding slots, the tick advances AT MOST ONE in-flight prefill by
+    # up to this many tokens (whole chunks; at least one chunk so a
+    # prefill always progresses).  None = one chunk per tick
+    # (prefill_chunk_tokens).  Larger values trade decode TBT for TTFT
+    # of long prompts.
+    prefill_chunk_budget: Optional[int] = None
     # Admission control (serving/tiers.py AdmissionController): the max
     # requests allowed to WAIT for this tier beyond its decode_batch
     # concurrent slots.  Past the bound — or earlier, when queued × EWMA

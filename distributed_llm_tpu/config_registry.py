@@ -164,6 +164,17 @@ CONFIG_FIELDS: Dict[str, str] = {
                                    "full block tables with per-slot "
                                    "lengths (no bucketed window rungs); "
                                    "unsharded engines only.",
+    "TierConfig.prefill_chunk_tokens": "Cold prompts past one chunk "
+                                       "prefill in fixed chunks of this "
+                                       "many tokens interleaved with "
+                                       "decode ticks (multiple of "
+                                       "kv_block_size); 0/None = "
+                                       "monolithic one-shot prefill.",
+    "TierConfig.prefill_chunk_budget": "Prefill tokens one scheduler "
+                                       "tick may spend advancing the "
+                                       "in-flight prefill (whole "
+                                       "chunks); None = one chunk per "
+                                       "tick.",
     "TierConfig.admission_max_queue": "Max requests waiting beyond the "
                                       "slots before fail-fast; None "
                                       "disables admission control.",

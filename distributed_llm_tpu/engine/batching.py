@@ -6,11 +6,19 @@ is only across the two Jetsons.  Here a tier runs a scheduler in front of
 the paged KV pool (engine/paged_kv.py):
 
 - requests **admit** into one of ``max_slots`` batch slots as soon as a
-  slot and enough KV blocks are free (prefill runs immediately — TTFT is
-  one compiled prefill call, same as the sequential engine);
+  slot and enough KV blocks are free.  A prompt that fits one prefill
+  chunk (``TierConfig.prefill_chunk_tokens``) prefills immediately —
+  TTFT is one compiled prefill call, same as the sequential engine; a
+  LONGER prompt becomes the tick's single **in-flight chunked prefill**:
+  fixed-size chunks (``chunk_prefill_paged`` writing straight into the
+  slot's pool blocks) interleave with decode ticks under a per-tick
+  token budget, so admitting a 4k-token prompt stalls active streams by
+  one CHUNK per tick, never one whole prompt;
 - every scheduler tick runs ONE batched ``decode_step_paged`` for all
   active slots — a new request joins mid-flight without waiting for its
-  neighbors to finish, and a finished one frees its blocks the same tick;
+  neighbors to finish, and a finished one frees its blocks the same
+  tick — then spends up to ``prefill_chunk_budget`` tokens advancing
+  the in-flight prefill;
 - the public surface stays the synchronous per-request ``generate()``
   (the /query contract): callers block on a per-request event while their
   tokens stream out of the shared loop.
@@ -101,6 +109,10 @@ class _Request:
     # picks the YOUNGEST slot, and a replayed request keeps its original
     # age so it is not immediately re-victimized.
     admit_seq: int = -1
+    # Set when an admission attempt deferred because the single chunked-
+    # prefill lane was busy: the scheduler skips re-popping (and
+    # re-tokenizing) the head request every tick until the lane frees.
+    needs_chunk: bool = False
 
 
 @dataclasses.dataclass
@@ -118,6 +130,39 @@ class _Slot:
     # Growth cap in pool blocks (prompt bucket + decode budget): blocks
     # are materialized lazily as the sequence grows, never past this.
     max_blocks: int = 0
+
+
+@dataclasses.dataclass
+class _Prefill:
+    """The tick's single in-flight chunked prefill: an admitted request
+    whose prompt is being written into its reserved slot's pool blocks
+    one fixed-size chunk per budget grant, interleaved with decode
+    ticks.  A first-class scheduler citizen: its blocks count against
+    the pool (KV-aware admission sees the remainder via ``kv_stats``),
+    starvation cancels-and-requeues it before any DECODING slot is
+    preempted, drain waits it out, and ``stop()`` fails it with the
+    engine-stopped shape like any queued request."""
+
+    request: _Request
+    slot_ix: int                  # reserved slot (no _Slot until done)
+    seq: List[int]                # tokens to prefill (prompt, or
+                                  # prompt + generated[:-1] for a replay)
+    prompt_len: int               # prompt tokens only (slot accounting)
+    prompt_ids: tuple
+    total: int                    # len(seq)
+    budget: int                   # decode cap carried to the slot
+    temperature: float
+    rng: Any                      # split ONCE at start; sampled at the
+                                  # final chunk exactly like monolithic
+    max_blocks: int
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    consumed: int = 0             # prefilled positions so far
+    chunks_done: int = 0
+    # Replayed generation (preempted request): the final chunk's sample
+    # is discarded and decode resumes from replay[-1] (see
+    # _admit_replay for the byte-identity contract).
+    replay: Optional[List[int]] = None
+    t_start: float = dataclasses.field(default_factory=time.perf_counter)
 
 
 class ContinuousBatchingEngine:
@@ -262,6 +307,32 @@ class ContinuousBatchingEngine:
         # window rungs this makes the warm set exhaustive — a prefix-hit
         # admission can never trace mid-chat.
         self._reuse_buckets = self._buckets[:3]
+
+        # Disaggregated chunked prefill (ISSUE 9): a cold admission whose
+        # prompt bucket exceeds one chunk no longer prefills in a single
+        # monolithic call on the scheduler thread — it becomes the
+        # in-flight _Prefill, advanced chunk-by-chunk between decode
+        # ticks so TBT for active streams is bounded by one chunk.
+        # Chunk size must page evenly (multiple of kv_block_size): the
+        # compiled chunk-program family is keyed only by
+        # (chunk, window-rung), the SAME bounded (bucket, window) keys
+        # the prefix-reuse suffix chunks already mint, all funneled
+        # through _note_compile's "chunk_prefill" stage.
+        self.chunk_tokens = int(tier.prefill_chunk_tokens or 0)
+        if self.chunk_tokens < 0 or (self.chunk_tokens
+                                     and self.chunk_tokens
+                                     % tier.kv_block_size):
+            raise ValueError(
+                f"prefill_chunk_tokens={tier.prefill_chunk_tokens} must be"
+                f" a positive multiple of kv_block_size="
+                f"{tier.kv_block_size} (chunks page evenly), or 0/None "
+                f"to disable chunking")
+        self.chunk_budget = max(self.chunk_tokens,
+                                int(tier.prefill_chunk_budget or 0))
+        self._prefill: Optional[_Prefill] = None
+        # Cancel-and-requeue count over the engine's life (the prefill
+        # twin of preempted_total; prefill_stats exposes it).
+        self.prefill_cancelled_total = 0
 
         # Session prefix reuse over pool blocks: a finished request's
         # prompt blocks are parked (ownership moves to the store) and a
@@ -520,12 +591,60 @@ class ContinuousBatchingEngine:
             blocks = self.allocator.alloc(n_blocks)
         return blocks
 
+    def _slot_go_live(self, req: _Request, slot_ix: int,
+                      blocks: List[int], *, prompt_len: int,
+                      prompt_ids: tuple, budget: int, temp: float,
+                      max_blocks: int, pos: int,
+                      first: Optional[int] = None,
+                      gen: Optional[List[int]] = None,
+                      ttft_ms: float = 0.0) -> None:
+        """The go-live tail shared by ALL FOUR admission paths
+        (monolithic/chunked x cold/replay): construct the slot, publish
+        its table row and per-slot decode state, emit the primed first
+        token (cold: ``first``) or resume from the parked prefix
+        (replay: ``gen``), and apply the termination checks.  Keeping
+        this in one place is part of the byte-identity contract — a
+        termination-rule change applied to the monolithic paths but not
+        the chunked ones would silently diverge the modes."""
+        if gen is None:
+            tokens, cur = [first], first
+        else:
+            tokens, cur = list(gen), gen[-1]
+            ttft_ms = req.replay_ttft_ms or 0.0
+        slot = _Slot(request=req, blocks=blocks, prompt_len=prompt_len,
+                     budget=budget, temperature=temp, ttft_ms=ttft_ms,
+                     tokens=tokens, prompt_ids=prompt_ids,
+                     max_blocks=max_blocks)
+        if gen is None:
+            obs_spans.add_token(req.trace)   # the prefill's primed token
+            if req.token_queue is not None:
+                req.token_queue.put(first)
+        else:
+            req.replay_tokens = None
+        self._slots[slot_ix] = slot
+        self._set_table_row(slot_ix, self._table_row(blocks))
+        self._pos[slot_ix] = pos
+        self._cur[slot_ix] = cur
+        self._temps[slot_ix] = temp
+        if gen is None:
+            if first == self.tokenizer.eos_id or budget <= 1:
+                self._finish(slot_ix)
+        elif (cur in (self.tokenizer.eos_id, self.tokenizer.pad_id)
+                or len(gen) >= budget):
+            self._finish(slot_ix)            # was already done (paranoia)
+
     def _admit(self, req: _Request, slot_ix: int) -> bool:
-        # Submit-to-slot wait (the admission queue + any KV-pressure
-        # requeues): the trace's queue_wait_ms and the registry's
-        # queue-wait histogram both read this one stamp.
-        obs_spans.annotate(req.trace, queue_wait_ms=round(
-            (time.perf_counter() - req.t_submit) * 1000.0, 3))
+        # Submit-to-prefill-start wait (the admission queue + any
+        # KV-pressure requeues).  queue_wait_ms keeps its historical
+        # name (the registry histogram reads it); admission_wait_ms is
+        # its explicit half of the TTFT split — prefill_wait_ms (stamped
+        # when the prefill completes) is the other — so a trace shows
+        # whether TTFT went to WAITING for the scheduler or to
+        # PREFILLING the prompt (chunked prefills can spend many ticks
+        # there while decode keeps streaming).
+        wait_ms = round((time.perf_counter() - req.t_submit) * 1000.0, 3)
+        obs_spans.annotate(req.trace, queue_wait_ms=wait_ms,
+                           admission_wait_ms=wait_ms)
         ids, bucket = prepare_prompt(self.tokenizer, req.history,
                                      self.tier.prefill_buckets,
                                      self.cfg.max_seq_len,
@@ -551,6 +670,19 @@ class ContinuousBatchingEngine:
         from .prefix_cache import select_reuse
         reused = select_reuse(self.prefix_cache, ids, self._reuse_buckets,
                               max_seq)
+
+        if reused is None and self._chunk_gate(bucket):
+            # Long cold prompt: chunked prefill interleaved with decode
+            # ticks instead of one monolithic call that would stall
+            # every active stream for the whole prompt.  One in-flight
+            # prefill at a time — a second long prompt waits at the
+            # scheduler head (needs_chunk keeps the loop from
+            # re-tokenizing it every tick) so admission order holds.
+            if self._prefill is not None:
+                req.needs_chunk = True
+                return False
+            self._start_prefill(req, slot_ix, ids, n, bucket, budget)
+            return True
 
         self._rng, rng = jax.random.split(self._rng)
         temp = (self.tier.temperature if req.temperature is None
@@ -630,20 +762,15 @@ class ContinuousBatchingEngine:
                 self.allocator.free(blocks)  # don't leak pool blocks
                 raise
         ttft_ms = (time.perf_counter() - req.t_submit) * 1000.0
+        # The other half of the TTFT split (see the stamp at the top):
+        # for a monolithic prefill it is the one compiled call's wall.
+        obs_spans.annotate(req.trace, prefill_wait_ms=round(
+            max(0.0, ttft_ms - wait_ms), 3))
 
-        slot = _Slot(request=req, blocks=blocks, prompt_len=n, budget=budget,
-                     temperature=temp, ttft_ms=ttft_ms, tokens=[first],
-                     prompt_ids=tuple(ids), max_blocks=max_blocks)
-        obs_spans.add_token(req.trace)       # the prefill's primed token
-        if req.token_queue is not None:
-            req.token_queue.put(first)
-        self._slots[slot_ix] = slot
-        self._set_table_row(slot_ix, self._table_row(blocks))
-        self._pos[slot_ix] = n               # first generated token's pos
-        self._cur[slot_ix] = first
-        self._temps[slot_ix] = temp
-        if first == self.tokenizer.eos_id or slot.budget <= 1:
-            self._finish(slot_ix)
+        self._slot_go_live(req, slot_ix, blocks, prompt_len=n,
+                           prompt_ids=tuple(ids), budget=budget, temp=temp,
+                           max_blocks=max_blocks, pos=n, first=first,
+                           ttft_ms=ttft_ms)
         return True
 
     def _admit_replay(self, req: _Request, slot_ix: int, ids: List[int],
@@ -680,6 +807,20 @@ class ContinuousBatchingEngine:
             if req.token_queue is not None:
                 req.token_queue.put(None)
             req.done.set()
+            return True
+        if self._chunk_gate(bucket):
+            # A deep replay is the same long-prefill stall as a cold
+            # long prompt — chunk it too (the replay's sample is
+            # discarded at the final chunk, decode resumes from the last
+            # emitted token, so the byte-identity contract is unchanged).
+            # replay_tokens stay parked on the request until the prefill
+            # COMPLETES: a cancel-and-requeue must replay from the same
+            # generated prefix.
+            if self._prefill is not None:
+                req.needs_chunk = True
+                return False
+            self._start_prefill(req, slot_ix, ids, n, bucket, budget,
+                                gen=gen)
             return True
         max_blocks = -(-min(max(bucket, n + budget), max_seq) // bs)
         need = min(max_blocks,
@@ -723,22 +864,188 @@ class ContinuousBatchingEngine:
         except BaseException:
             self.allocator.free(blocks)      # don't leak pool blocks
             raise
-        slot = _Slot(request=req, blocks=blocks, prompt_len=n,
-                     budget=budget, temperature=temp,
-                     ttft_ms=req.replay_ttft_ms or 0.0, tokens=gen,
-                     prompt_ids=tuple(ids), max_blocks=max_blocks)
-        req.replay_tokens = None
-        self._slots[slot_ix] = slot
-        self._set_table_row(slot_ix, self._table_row(blocks))
-        self._pos[slot_ix] = len(seq)        # the current token's position
-        self._cur[slot_ix] = gen[-1]
-        self._temps[slot_ix] = temp
         obs_spans.event(req.trace, "replay", replayed_tokens=len(seq),
                         generated=len(gen))
-        if (gen[-1] in (self.tokenizer.eos_id, self.tokenizer.pad_id)
-                or len(gen) >= budget):
-            self._finish(slot_ix)            # was already done (paranoia)
+        self._slot_go_live(req, slot_ix, blocks, prompt_len=n,
+                           prompt_ids=tuple(ids), budget=budget, temp=temp,
+                           max_blocks=max_blocks, pos=len(seq), gen=gen)
         return True
+
+    # -- chunked prefill (the in-flight scheduler citizen) -----------------
+
+    def _chunk_gate(self, bucket: int) -> bool:
+        """Whether an admission prefills CHUNKED: only prompts whose
+        bucket exceeds one chunk — a smaller prompt's monolithic prefill
+        already meets the one-chunk TBT bound, and keeps the warm
+        prefill-bucket program path."""
+        return bool(self.chunk_tokens) and bucket > self.chunk_tokens
+
+    def _start_prefill(self, req: _Request, slot_ix: int, ids: List[int],
+                       n: int, bucket: int, budget: int,
+                       gen: Optional[List[int]] = None) -> None:
+        """Reserve ``slot_ix`` and register the request as the tick's
+        in-flight chunked prefill.  No blocks yet — _advance_prefill
+        allocates per chunk, so a long prompt's pool footprint grows
+        with actual progress.  The rng splits ONCE here (same stream
+        position as a monolithic admission), and the final chunk samples
+        with it, so greedy first-token semantics are byte-identical to
+        the one-shot path."""
+        bs = self.paged.block_size
+        max_seq = self.cfg.max_seq_len
+        if gen is None:
+            seq = list(ids)
+            max_blocks = -(-min(bucket + budget, max_seq) // bs)
+        else:
+            seq = list(ids) + list(gen[:-1])
+            max_blocks = -(-min(max(bucket, n + budget), max_seq) // bs)
+        self._rng, rng = jax.random.split(self._rng)
+        temp = (self.tier.temperature if req.temperature is None
+                else req.temperature)
+        self._prefill = _Prefill(
+            request=req, slot_ix=slot_ix, seq=seq, prompt_len=n,
+            prompt_ids=tuple(ids), total=len(seq), budget=budget,
+            temperature=temp, rng=rng, max_blocks=max_blocks,
+            replay=list(gen) if gen is not None else None)
+        obs_spans.event(req.trace, "prefill_chunked", tokens=len(seq),
+                        chunk_tokens=self.chunk_tokens,
+                        replayed=bool(gen))
+
+    def _advance_prefill(self) -> bool:
+        """Spend up to ``chunk_budget`` tokens advancing the in-flight
+        prefill — the tail half of a scheduler tick (decode slots were
+        served first, so active streams stall at most one budget grant).
+        Each chunk scatters its K/V straight into the slot's pool
+        blocks via the SAME compiled (chunk, window-rung) program family
+        the prefix-reuse suffix path uses; a dry pool stalls the prefill
+        (retry next tick) rather than starving decode growth.  Returns
+        whether any chunk landed (False = stalled dry), so a solo
+        prefill's loop can back off instead of hot-spinning on an
+        allocator that nothing will refill."""
+        pf = self._prefill
+        if pf is None:
+            return True
+        progressed = False
+        req = pf.request
+        c = self.chunk_tokens
+        bs = self.paged.block_size
+        span = self.paged.blocks_per_slot * bs
+        budget_left = self.chunk_budget
+        try:
+            while pf.consumed < pf.total and budget_left >= c:
+                start = pf.consumed
+                if start + c > span:
+                    # Final sliver near the table's end: slide the chunk
+                    # back so every position stays inside the table (an
+                    # overflowing pad position would CLAMP its block
+                    # index onto a real block and corrupt live KV).  The
+                    # overlap recomputes identical K/V — harmless.
+                    start = span - c
+                end = start + c
+                need = min(pf.max_blocks, -(-min(end, pf.total) // bs))
+                if len(pf.blocks) < need:
+                    extra = self._alloc_evicting(need - len(pf.blocks))
+                    if extra is None:
+                        # Pool dry: stall, retry next tick.
+                        return progressed
+                    pf.blocks.extend(extra)
+                window = next(w for w in self._chunk_windows if w >= end)
+                k = min(end, pf.total) - start
+                tokens = np.full((1, c), self.tokenizer.pad_id, np.int32)
+                tokens[0, :k] = pf.seq[start:start + k]
+                t_chunk = time.perf_counter()
+                with obs_spans.span(req.trace, "prefill_chunk",
+                                    start=start, tokens=k,
+                                    window=window), \
+                        self.phases.phase("prefill"):
+                    first, self.pool = self._chunk_prefill_fn(c, window)(
+                        self.params, self.pool, jnp.asarray(tokens),
+                        jnp.asarray([start], np.int32),
+                        jnp.asarray([pf.total], np.int32),
+                        jnp.asarray(self._table_row(pf.blocks)), pf.rng,
+                        jnp.float32(pf.temperature))
+                    # dllm-lint: disable=transfer-host-sync -- sanctioned: the chunk IS the budgeted stall unit — its device time is exactly the TBT bound this design promises (and the histogram evidences), and the final chunk's sampled token must reach the host regardless; an async chunk would just move the same wait into the next decode tick's sync
+                    first = jax.block_until_ready(first)
+                chunk_ms = (time.perf_counter() - t_chunk) * 1000.0
+                from ..utils import roofline
+                self.phases.add_work("prefill", **roofline.prefill_work(
+                    self.cfg, end, start, wbytes=self._wbytes))
+                try:
+                    # No injection path on the engine (same pattern as
+                    # the tick histogram): the process-global registry.
+                    from ..obs import get_observability
+                    get_observability().m.prefill_chunk_ms.labels(
+                        self.tier.name).observe(chunk_ms)
+                except Exception:
+                    pass
+                pf.consumed = min(end, pf.total)
+                pf.chunks_done += 1
+                progressed = True
+                budget_left -= c
+                self._progress_t = time.monotonic()
+                if pf.consumed >= pf.total:
+                    self._finish_prefill(pf, int(first))
+                    return True
+        except BaseException as exc:       # surface to the caller
+            self._prefill = None
+            slot = self._slots[pf.slot_ix]
+            if slot is not None and slot.request is req:
+                # The final chunk had already gone live as a slot when
+                # the failure surfaced: the SLOT owns the blocks now.
+                self._fail_slot(pf.slot_ix, exc)
+                return True
+            self.allocator.free(pf.blocks)
+            req.error = exc
+            if req.token_queue is not None:
+                req.token_queue.put(None)
+            req.done.set()
+            return True
+        return progressed
+
+    def _finish_prefill(self, pf: _Prefill, first: int) -> None:
+        """Last chunk landed: the reserved slot goes live.  Cold
+        prefills emit the final chunk's sampled token exactly as the
+        monolithic path did; replays discard it and resume from the last
+        emitted token (nothing is re-emitted)."""
+        req = pf.request
+        ix = pf.slot_ix
+        self._prefill = None
+        obs_spans.annotate(req.trace, prefill_wait_ms=round(
+            (time.perf_counter() - pf.t_start) * 1000.0, 3))
+        if pf.replay is not None:
+            obs_spans.event(req.trace, "replay", replayed_tokens=pf.total,
+                            generated=len(pf.replay), chunked=True)
+            self._slot_go_live(req, ix, pf.blocks,
+                               prompt_len=pf.prompt_len,
+                               prompt_ids=pf.prompt_ids, budget=pf.budget,
+                               temp=pf.temperature,
+                               max_blocks=pf.max_blocks, pos=pf.total,
+                               gen=pf.replay)
+            return
+        ttft_ms = (time.perf_counter() - req.t_submit) * 1000.0
+        self._slot_go_live(req, ix, pf.blocks, prompt_len=pf.prompt_len,
+                           prompt_ids=pf.prompt_ids, budget=pf.budget,
+                           temp=pf.temperature, max_blocks=pf.max_blocks,
+                           pos=pf.total, first=first, ttft_ms=ttft_ms)
+
+    def _cancel_prefill(self, reason: str) -> None:
+        """Cancel-and-requeue the in-flight prefill: under pool
+        starvation the prefill yields FIRST — it has emitted nothing, so
+        requeueing it is free, while preempting a DECODING slot forces a
+        full replay.  Blocks return to the pool immediately; the request
+        re-enters at the scheduler head and restarts from chunk 0 (a
+        replay's parked tokens survive untouched, so the eventual stream
+        is still byte-identical)."""
+        pf = self._prefill
+        if pf is None:
+            return
+        self._prefill = None
+        self.allocator.free(pf.blocks)
+        self.prefill_cancelled_total += 1
+        req = pf.request
+        req.needs_chunk = True
+        obs_spans.event(req.trace, "prefill_cancelled", reason=reason,
+                        consumed_tokens=min(pf.consumed, pf.total))
+        self._head.appendleft(req)
 
     def _preempt(self, slot_ix: int) -> None:
         """Evict a RUNNING slot under block starvation: free its blocks,
@@ -785,6 +1092,14 @@ class ContinuousBatchingEngine:
                     slot.blocks.extend(extra)
                     self._set_table_row(ix, self._table_row(slot.blocks))
                     break
+                if self._prefill is not None:
+                    # The in-flight chunked prefill yields before any
+                    # DECODING slot: it has emitted nothing, so a
+                    # cancel-and-requeue costs only re-prefilling,
+                    # while preempting a decoder forces a full replay.
+                    self._cancel_prefill("kv pressure: decoding slot "
+                                         "growth starved")
+                    continue
                 victims = [j for j in active if self._slots[j] is not None]
                 if victims == [ix]:
                     # Sole occupant of a pool that cannot hold its next
@@ -863,12 +1178,33 @@ class ContinuousBatchingEngine:
     # every device sync/round-trip below either moved to a tick boundary
     # or carries a justification naming why it is sanctioned.
     def _loop(self) -> None:          # dllm-lint: hot-path
+        try:
+            self._run_scheduler()
+        finally:
+            # Scheduler-thread-owned cleanup: a still-in-flight chunked
+            # prefill re-queues at the head on exit, so stop()'s normal
+            # queue drain fails it with the engine-stopped shape without
+            # ever touching scheduler-private state from another thread
+            # (the _prefill field stays single-writer, like _slots).
+            if self._prefill is not None:
+                self._cancel_prefill("engine stopping")
+
+    def _run_scheduler(self) -> None:
         while not self._stop.is_set():
-            # Admit while there are free slots and queued requests.
+            # Admit while there are free slots and queued requests.  A
+            # head request deferred because the single chunked-prefill
+            # lane is busy stays parked (FIFO holds; re-popping it would
+            # re-tokenize a long prompt every tick for nothing).
             admitted_any = False
-            for ix in range(self.paged.max_slots):
+            head_blocked = (self._prefill is not None and self._head
+                            and self._head[0].needs_chunk)
+            for ix in (() if head_blocked
+                       else range(self.paged.max_slots)):
                 if self._slots[ix] is not None:
                     continue
+                if (self._prefill is not None
+                        and self._prefill.slot_ix == ix):
+                    continue             # reserved by the in-flight prefill
                 req = self._next_request()
                 if req is None:
                     break
@@ -895,7 +1231,23 @@ class ContinuousBatchingEngine:
                 active = [ix for ix, s in enumerate(self._slots)
                           if s is not None]
             if not active:
-                if not admitted_any:
+                if self._prefill is not None:
+                    # No decoding slots: the whole tick is prefill — a
+                    # solo long prompt advances one budget grant per
+                    # loop pass, so its TTFT approaches the monolithic
+                    # path's (per-chunk dispatch overhead aside).  A
+                    # DRY-pool stall here gets the same polite 20 Hz
+                    # retry the monolithic requeue path gets from the
+                    # idle branch below — nothing is decoding, so only
+                    # stop()/drain or a freed parked prefix can change
+                    # the allocator, and hot-spinning on it would peg
+                    # the scheduler core (the serving kv-admission gate
+                    # rejects permanently-oversized prompts upstream).
+                    if not self._advance_prefill():
+                        self._wake.wait(timeout=0.05)
+                        self._wake.clear()
+                    self._progress_t = time.monotonic()
+                elif not admitted_any:
                     # Idle is trivially "progressing": the watchdog only
                     # measures staleness while work is pending.
                     self._progress_t = time.monotonic()
@@ -1009,6 +1361,11 @@ class ContinuousBatchingEngine:
                                or self._pos[ix] >= self.cfg.max_seq_len - 1)
                     if hit_cap or hit_end:
                         self._finish(ix)
+            if self._prefill is not None:
+                # Decode slots served: spend the tick's prefill budget —
+                # the interleave that bounds active streams' TBT by one
+                # chunk grant instead of one whole prompt.
+                self._advance_prefill()
             self._progress_t = time.monotonic()  # tick completed
 
     # -- public surface (InferenceEngine parity) ---------------------------
@@ -1106,9 +1463,12 @@ class ContinuousBatchingEngine:
 
     def queue_depth(self) -> int:
         """Requests submitted but not yet admitted to a batch slot
-        (including KV-pressure deferrals and preempted replays waiting in
-        the head lane)."""
-        return self._queue.qsize() + len(self._head)
+        (including KV-pressure deferrals, preempted replays waiting in
+        the head lane, and the in-flight chunked prefill — admitted to
+        the LANE but not yet decoding, it must stay visible to routing,
+        drain, and the wait predictor)."""
+        return (self._queue.qsize() + len(self._head)
+                + (1 if self._prefill is not None else 0))
 
     def pending_work(self) -> int:
         """Queued + requeued + active requests — the drain loop's
@@ -1125,12 +1485,28 @@ class ContinuousBatchingEngine:
         guard their own state."""
         reclaimable = (self.prefix_cache.reclaimable_blocks()
                        if self.prefix_cache is not None else 0)
+        # The in-flight chunked prefill's REMAINING demand: blocks it
+        # still needs to finish prefilling.  The serving admission gate
+        # subtracts this from supply — an admission that consumed those
+        # blocks would force a prefill cancel, so they are spoken for
+        # even though the allocator still counts them free.  Advisory
+        # GIL-safe snapshot (the scheduler thread owns _prefill).
+        pf = self._prefill
+        pending = backlog = 0
+        if pf is not None:
+            done = min(pf.consumed, pf.total)
+            backlog = pf.total - done
+            pending = max(0, min(pf.max_blocks,
+                                 -(-pf.total // self.paged.block_size))
+                          - len(pf.blocks))
         return {
             "free_blocks": self.allocator.available,
             "reclaimable_blocks": reclaimable,
             "block_size": self.paged.block_size,
             "total_blocks": self.paged.num_blocks - 1,   # minus trash
             "preempted_total": self.preempted_total,
+            "prefill_pending_blocks": pending,
+            "prefill_backlog_tokens": backlog,
         }
 
     def max_demand_blocks(self) -> int:
@@ -1208,13 +1584,35 @@ class ContinuousBatchingEngine:
         point."""
         active = sum(1 for s in self._slots if s is not None)
         total = self.paged.max_slots
+        pstats = self.prefill_stats()
         return {
             "queue_depth": self.queue_depth(),
             "active_slots": active,
             "max_slots": total,
             "slot_occupancy": round(active / max(1, total), 3),
             "preempted_total": self.preempted_total,
+            # Chunked-prefill backlog rides the health()/GET /stats
+            # snapshot: an operator reading a TTFT spike sees whether a
+            # long prompt is mid-absorption.
+            "prefill_inflight": pstats["inflight"],
+            "prefill_backlog_tokens": pstats["backlog_tokens"],
         }
+
+    def prefill_stats(self) -> Dict[str, Any]:
+        """In-flight chunked-prefill snapshot: whether one is being
+        absorbed, how many prompt tokens remain (the backlog the
+        ``dllm_prefill_backlog`` gauge samples), chunk progress, and the
+        engine-life cancel count.  Advisory GIL-safe reads of state the
+        scheduler thread owns — same discipline as slot_stats."""
+        pf = self._prefill
+        if pf is None:
+            return {"inflight": 0, "backlog_tokens": 0, "chunks_done": 0,
+                    "cancelled_total": self.prefill_cancelled_total}
+        return {"inflight": 1,
+                "backlog_tokens": max(0, pf.total - min(pf.consumed,
+                                                        pf.total)),
+                "chunks_done": pf.chunks_done,
+                "cancelled_total": self.prefill_cancelled_total}
 
     def prefix_affinity(self, history) -> int:
         """Longest parked-prefix token match in the paged pool for
@@ -1280,6 +1678,27 @@ class ContinuousBatchingEngine:
                         jnp.asarray(row), rng, jnp.float32(0.0))
                     jax.block_until_ready(first)
                     beat()
+        if (self.chunk_tokens and self._buckets
+                and max(self._buckets) > self.chunk_tokens):
+            # The cold-chunk program family: one (chunk_tokens, window)
+            # program per window rung a chunked admission can cross —
+            # with the coarse rung set this is ≤3 programs, so a long
+            # prompt arriving mid-serve never pays an XLA trace on the
+            # interleave path it exists to keep smooth.
+            c = self.chunk_tokens
+            row = self._table_row([])
+            for window in self._chunk_windows:
+                if window < c:
+                    continue
+                self._rng, rng = jax.random.split(self._rng)
+                first, self.pool = self._chunk_prefill_fn(c, window)(
+                    self.params, self.pool,
+                    jnp.full((1, c), self.tokenizer.pad_id, jnp.int32),
+                    jnp.asarray([0], np.int32),
+                    jnp.asarray([1], np.int32),
+                    jnp.asarray(row), rng, jnp.float32(0.0))
+                jax.block_until_ready(first)
+                beat()
 
 
 class StreamHandle:

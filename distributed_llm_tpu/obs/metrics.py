@@ -371,6 +371,21 @@ class ServingMetrics:
             "minted, by stage (prefill|chunk_prefill|writer|decode) — "
             "decode pins at 1 under ragged attention; growth is logged",
             ("tier", "stage"))
+        # Chunked-prefill family (PR 9): long prompts are absorbed one
+        # chunk per tick between decode ticks — the chunk histogram IS
+        # the TBT bound the design promises (an active stream stalls at
+        # most one chunk grant), and the backlog gauge shows a long
+        # prompt mid-absorption behind a TTFT spike.
+        self.prefill_chunk_ms = registry.histogram(
+            "dllm_prefill_chunk_ms",
+            "Device time of one interleaved prefill chunk — the upper "
+            "bound a chunked admission adds to active streams' "
+            "time-between-tokens per tick", ("tier",))
+        self.prefill_backlog_g = registry.gauge(
+            "dllm_prefill_backlog",
+            "Prompt tokens of the in-flight chunked prefill not yet "
+            "absorbed (sampled by the system-state sampler; 0 = no "
+            "prefill in flight)", ("tier",))
         # System-state timeline family (PR 7, obs/sampler.py): the
         # background sampler mirrors its latest per-tier sample to these
         # gauges so dashboards plot the same series the timeline ring

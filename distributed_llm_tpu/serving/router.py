@@ -372,6 +372,11 @@ class Router:
                     st["kv_reclaimable_blocks"] = ks.get(
                         "reclaimable_blocks")
                     st["preempted_total"] = ks.get("preempted_total", 0)
+                    # Chunked-prefill backlog (PR 9): prompt tokens of
+                    # the in-flight prefill not yet absorbed — the
+                    # dllm_prefill_backlog gauge's source series.
+                    st["prefill_backlog_tokens"] = ks.get(
+                        "prefill_backlog_tokens", 0)
                 except Exception:
                     pass
             tick_fn = getattr(engine, "tick_stats", None)

@@ -382,7 +382,14 @@ class TierClient:
         try:
             st = stats_fn()
             supply = (int(st["free_blocks"])
-                      + int(st["reclaimable_blocks"]))
+                      + int(st["reclaimable_blocks"])
+                      # The in-flight chunked prefill's remaining block
+                      # demand is spoken for: the allocator still counts
+                      # those blocks free, but an admission that took
+                      # them would force the scheduler to cancel the
+                      # half-absorbed prompt (engine/batching.py
+                      # kv_stats).
+                      - int(st.get("prefill_pending_blocks", 0)))
             worst = getattr(engine, "max_demand_blocks", None)
             if callable(worst) and supply >= int(worst()):
                 # Pool trivially covers ANY request: skip the per-request

@@ -247,7 +247,8 @@ def test_batched_engine_slot_stats_under_load():
                 for i in range(5)]
         st = engine.slot_stats()
         assert set(st) == {"queue_depth", "active_slots", "max_slots",
-                           "slot_occupancy", "preempted_total"}
+                           "slot_occupancy", "preempted_total",
+                           "prefill_inflight", "prefill_backlog_tokens"}
         assert st["max_slots"] == 2
         for r in reqs:
             assert r.done.wait(timeout=60)

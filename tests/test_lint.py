@@ -1210,6 +1210,64 @@ def test_retrace_warmup_exempt():
     assert _lint(RetraceChecker(), {ENGINE: src}).findings == []
 
 
+def test_retrace_chunk_program_family_bounded_keys_clean():
+    """The chunked-prefill idiom (ISSUE 9): a program cache keyed by
+    bounded (bucket/chunk, window) INTS, a fixed-chunk staging buffer
+    padded to the chunk size, and a loop calling the already-built
+    wrapped function — the engine's `_chunk_prefill_fn` /
+    `_advance_prefill` shape must stay silent, or the checker would be
+    flagging the design it exists to protect."""
+    from distributed_llm_tpu.lint.checkers.retrace import RetraceChecker
+    src = """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def chunk_fn(self, chunk, window):
+            key = ("chunk", chunk, window)     # bounded rung key, not a shape
+            if key not in self._fns:
+                self._fns[key] = jax.jit(self._run)
+            return self._fns[key]
+
+        def advance(self, pf):    # dllm-lint: hot-path
+            c = self.chunk_tokens
+            while pf.consumed < pf.total:
+                k = min(pf.consumed + c, pf.total) - pf.consumed
+                tokens = np.full((1, c), self.pad_id, np.int32)  # padded
+                tokens[0, :k] = pf.seq[pf.consumed:pf.consumed + k]
+                fn = self.chunk_fn(c, self.window)
+                fn(self.params, jnp.asarray(tokens))   # warm wrapped call
+                pf.consumed += k
+    """
+    assert _lint(RetraceChecker(), {ENGINE: src}).findings == []
+
+
+def test_retrace_chunk_per_prompt_length_shapes_flagged():
+    """The naive chunked prefill this PR must NOT ship: uploading each
+    chunk at the prompt's own residual length mints one compiled program
+    per distinct prompt length — unbounded churn on the admit path."""
+    from distributed_llm_tpu.lint.checkers.retrace import RetraceChecker
+    bad = """
+        import jax.numpy as jnp
+
+        def advance(self, pf):
+            while pf.consumed < pf.total:
+                end = min(pf.consumed + self.chunk_tokens, pf.total)
+                tokens = jnp.asarray(pf.seq[pf.consumed:end])  # per-length
+                self._fn(self.params, tokens)
+                pf.consumed = end
+    """
+    result = _lint(RetraceChecker(), {ENGINE: bad})
+    assert "retrace-dynamic-shape" in _rules(result), result.findings
+
+    keyed = """
+        def chunk_fn(self, tokens, window):
+            return self._fns[(tokens.shape, window)]   # one program/shape
+    """
+    result = _lint(RetraceChecker(), {ENGINE: keyed})
+    assert _rules(result) == ["retrace-shape-cache-key"], result.findings
+
+
 # -- transfer checker --------------------------------------------------------
 
 def test_transfer_sync_in_cross_module_hot_callee_flagged():
