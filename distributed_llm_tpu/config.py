@@ -375,6 +375,19 @@ class TierConfig:
     # enable_prefix_cache=False for pure single-turn traffic.
     enable_prefix_cache: bool = True
     prefix_cache_entries: int = 2
+    # Cross-request shared-prefix KV (engine/prefix_cache.py, ISSUE 10;
+    # batched paged engines only): a prefix-cache hit PINS the parked
+    # entry and maps its pool blocks READ-ONLY into the new slot's block
+    # table (refcounted BlockAllocator.share), copying only the
+    # partially-filled boundary block into a slot-private block
+    # (copy-on-write) — N concurrent sessions over one system prompt
+    # hold ONE physical copy, so resident KV scales with unique content
+    # and a warm-prefix admission costs zero prefill compute and zero
+    # new blocks for the shared region.  Greedy outputs stay
+    # byte-identical to the cold path.  False restores the exclusive
+    # take-ownership semantics (one live session per parked prefix; a
+    # second same-prefix session misses and pays a full prefill).
+    share_prefix_kv: bool = True
     # Weight-only quantization for serving ("none" | "int8", ops/quant.py):
     # int8 halves decode's HBM weight traffic.  Dense and MoE families;
     # unsharded tiers only (sharding rules and the trainer see

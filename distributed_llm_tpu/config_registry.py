@@ -204,6 +204,13 @@ CONFIG_FIELDS: Dict[str, str] = {
                                       "(multi-turn chats).",
     "TierConfig.prefix_cache_entries": "Parked KV prefixes kept per tier "
                                        "(each pins HBM).",
+    "TierConfig.share_prefix_kv": "Prefix-cache hits on batched paged "
+                                  "engines map the parked blocks "
+                                  "read-only into N concurrent slots "
+                                  "(refcounted, copy-on-write at the "
+                                  "boundary block) instead of taking "
+                                  "exclusive ownership; False restores "
+                                  "one-live-session-per-prefix.",
     "TierConfig.quantize": "Weight-only serving quantization ('none' | "
                            "'int8').",
     "TierConfig.kv_quantize": "KV-cache quantization ('none' | 'int8'); "

@@ -130,6 +130,12 @@ class _Slot:
     # Growth cap in pool blocks (prompt bucket + decode budget): blocks
     # are materialized lazily as the sequence grows, never past this.
     max_blocks: int = 0
+    # Shared-prefix hit (ISSUE 10): the PrefixEntry this slot pinned —
+    # its leading table rows map the entry's blocks READ-ONLY (incref'd;
+    # the boundary block was COW-copied).  Unpinned on release; the
+    # block references themselves drop through the allocator's uniform
+    # refcounted free().
+    pinned_entry: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -337,14 +343,27 @@ class ContinuousBatchingEngine:
         # Session prefix reuse over pool blocks: a finished request's
         # prompt blocks are parked (ownership moves to the store) and a
         # later prompt extending it chunk-prefills only the suffix into
-        # fresh blocks.  Evicted entries return their blocks via on_evict.
+        # fresh blocks.  Evicted entries return their blocks via on_evict
+        # (a refcounted decref: blocks still mapped by live sharers or a
+        # longer parked entry stay resident).  The batch refcount reader
+        # keeps reclaimable accounting honest under sharing.
         from .prefix_cache import PrefixCache
         self.prefix_cache = (
             PrefixCache(capacity=tier.prefix_cache_entries,
                         on_evict=lambda e: self.allocator.free(
-                            e.cache["blocks"]))
+                            e.cache["blocks"]),
+                        block_refcounts=self.allocator.refcounts)
             if tier.enable_prefix_cache and tier.prefix_cache_entries > 0
             else None)
+        # Cross-request shared-prefix KV (ISSUE 10): a cache hit PINS the
+        # parked entry and maps its full blocks read-only into the new
+        # slot's table (copy-on-write at the mid-block boundary) instead
+        # of taking exclusive ownership — N concurrent same-prefix
+        # sessions hold ONE physical copy.  OFF restores the exclusive
+        # take semantics exactly.
+        self.share_prefix = bool(tier.share_prefix_kv
+                                 and self.prefix_cache is not None)
+        self._cow_fn = None
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         # Scheduler-head requeue lane: KV-pressure deferrals and preempted
         # requests go back to the FRONT (appendleft), so a starved elder
@@ -557,6 +576,38 @@ class ContinuousBatchingEngine:
                                            donate_argnums=donate, **kw)
         return self._writer_fns[nb]
 
+    def _cow_copy_fn(self):
+        """Jitted one-block COW copy (``paged_kv.copy_block``): ONE
+        compiled program for every (src, dst) pair — the block ids are
+        traced scalars, so the copy rides the bounded block-write
+        program family like the prefill writers instead of minting a
+        per-pair program on the admit path (the retrace-lint fixture
+        pair in tests/test_lint.py pins the idiom)."""
+        if self._cow_fn is None:
+            from .paged_kv import copy_block
+            self._note_compile("writer", "cow_copy")
+            donate = (0,) if jax.default_backend() != "cpu" else ()
+            kw = {}
+            if self._pool_shardings is not None:
+                kw["out_shardings"] = self._pool_shardings
+            self._cow_fn = jax.jit(copy_block, donate_argnums=donate, **kw)
+        return self._cow_fn
+
+    def _note_prefix_hit(self, kind: str) -> None:
+        """Mirror one admission's prefix-cache lookup outcome to the
+        ``dllm_prefix_hits_total{tier,kind}`` counter
+        (kind = shared | exclusive | miss).  Counted per admission
+        ATTEMPT — a KV-pressure requeue re-looks-up on re-admission,
+        matching the cache's own hit/miss stats semantics.  No
+        injection path on the engine (same pattern as the preemption
+        counter): the process-global registry."""
+        try:
+            from ..obs import get_observability
+            get_observability().m.prefix_hits.labels(
+                self.tier.name, kind).inc()
+        except Exception:
+            pass
+
     # -- scheduler ---------------------------------------------------------
 
     def _suffix_window(self, needed: int) -> int:
@@ -597,7 +648,8 @@ class ContinuousBatchingEngine:
                       max_blocks: int, pos: int,
                       first: Optional[int] = None,
                       gen: Optional[List[int]] = None,
-                      ttft_ms: float = 0.0) -> None:
+                      ttft_ms: float = 0.0,
+                      pinned_entry: Optional[Any] = None) -> None:
         """The go-live tail shared by ALL FOUR admission paths
         (monolithic/chunked x cold/replay): construct the slot, publish
         its table row and per-slot decode state, emit the primed first
@@ -614,7 +666,7 @@ class ContinuousBatchingEngine:
         slot = _Slot(request=req, blocks=blocks, prompt_len=prompt_len,
                      budget=budget, temperature=temp, ttft_ms=ttft_ms,
                      tokens=tokens, prompt_ids=prompt_ids,
-                     max_blocks=max_blocks)
+                     max_blocks=max_blocks, pinned_entry=pinned_entry)
         if gen is None:
             obs_spans.add_token(req.trace)   # the prefill's primed token
             if req.token_queue is not None:
@@ -662,14 +714,19 @@ class ContinuousBatchingEngine:
         bs = self.paged.block_size
         max_seq = self.cfg.max_seq_len
 
-        # Prefix reuse: reclaim a parked entry's blocks as this slot's
-        # leading table rows and prefill only the suffix (shared matching
-        # policy with the contiguous engine; m need not be block-aligned —
-        # the chunk overwrites its own positions and stale entry KV past
-        # n-1 is masked).
+        # Prefix reuse: a parked entry's blocks become this slot's
+        # leading table rows and only the suffix prefills (shared
+        # matching policy with the contiguous engine; m need not be
+        # block-aligned — the suffix chunk overwrites its own positions
+        # and stale entry KV past n-1 is masked).  share_prefix (the
+        # default) PINS the entry and maps its blocks read-only so N
+        # concurrent sessions ride one physical prefix; OFF takes
+        # exclusive ownership exactly as before.
         from .prefix_cache import select_reuse
         reused = select_reuse(self.prefix_cache, ids, self._reuse_buckets,
-                              max_seq)
+                              max_seq, share=self.share_prefix)
+        if self.prefix_cache is not None and reused is None:
+            self._note_prefix_hit("miss")
 
         if reused is None and self._chunk_gate(bucket):
             # Long cold prompt: chunked prefill interleaved with decode
@@ -689,21 +746,63 @@ class ContinuousBatchingEngine:
                 else req.temperature)
 
         from ..utils import roofline
+        pinned_entry = None
         if reused is not None:
             entry, m, suffix, sb = reused
-            owned = list(entry.cache["blocks"])
             cover = max(m + sb, min(n + budget, max_seq))
             need = -(-cover // bs)
-            if len(owned) < need:
-                extra = self._alloc_evicting(need - len(owned))
-                if extra is None:
-                    self.prefix_cache.untake(entry, m)
+            boundary_src = None
+            if self.share_prefix:
+                # SHARED hit: the entry stays parked (pinned); its FULL
+                # blocks map read-only into this slot's leading table
+                # rows (incref — zero compute, zero new blocks for the
+                # shared region).  The partially-filled BOUNDARY block
+                # (m mid-block) is COW-copied into the first private
+                # block below: this slot writes its suffix there, and
+                # sharers must never see it.
+                n_full = m // bs
+                shared = list(entry.cache["blocks"][:n_full])
+                if (m % bs) != 0:
+                    boundary_src = entry.cache["blocks"][n_full]
+                self.allocator.share(shared)
+                priv = self._alloc_evicting(need - n_full)
+                if priv is None:
+                    self.allocator.free(shared)       # decref only
+                    # unshare() reverses the cache's hit into a miss;
+                    # mirror that so the counter tracks cache stats.
+                    self.prefix_cache.unshare(entry, m)
+                    self._note_prefix_hit("miss")
                     return False             # KV pressure: stay queued
-                owned += extra
-            elif len(owned) > need:
-                self.allocator.free(owned[need:])
-                owned = owned[:need]
+                owned = shared + priv
+                pinned_entry = entry
+                self._note_prefix_hit("shared")
+            else:
+                # EXCLUSIVE take (share_prefix_kv=False): ownership of
+                # the entry's blocks moves to the slot; the suffix may
+                # write straight into the boundary block because nobody
+                # else maps it.
+                owned = list(entry.cache["blocks"])
+                if len(owned) < need:
+                    extra = self._alloc_evicting(need - len(owned))
+                    if extra is None:
+                        # untake() reverses the cache's hit into a miss;
+                        # mirror that so the counter tracks cache stats.
+                        self.prefix_cache.untake(entry, m)
+                        self._note_prefix_hit("miss")
+                        return False             # KV pressure: stay queued
+                    owned += extra
+                elif len(owned) > need:
+                    self.allocator.free(owned[need:])
+                    owned = owned[:need]
+                self._note_prefix_hit("exclusive")
             try:
+                if boundary_src is not None:
+                    # One compiled program for every (src, dst) pair —
+                    # priv[0] is the boundary position's table row
+                    # (need > n_full always: the suffix has >= 1 token).
+                    self.pool = self._cow_copy_fn()(
+                        self.pool, jnp.asarray(boundary_src, jnp.int32),
+                        jnp.asarray(priv[0], jnp.int32))
                 row = self._table_row(owned)
                 tokens = np.full((1, sb), self.tokenizer.pad_id, np.int32)
                 tokens[0, :len(suffix)] = suffix
@@ -721,7 +820,11 @@ class ContinuousBatchingEngine:
                 self.phases.add_work("prefill", **roofline.prefill_work(
                     self.cfg, window, window - sb, wbytes=self._wbytes))
             except BaseException:
-                self.allocator.free(owned)   # don't leak pool blocks
+                # Don't leak pool blocks (refcounted: shared blocks just
+                # decref back to their other holders).
+                self.allocator.free(owned)
+                if pinned_entry is not None:
+                    self.prefix_cache.unpin(pinned_entry)
                 raise
             blocks = owned
             max_blocks = len(owned)          # fully materialized: no growth
@@ -770,7 +873,7 @@ class ContinuousBatchingEngine:
         self._slot_go_live(req, slot_ix, blocks, prompt_len=n,
                            prompt_ids=tuple(ids), budget=budget, temp=temp,
                            max_blocks=max_blocks, pos=n, first=first,
-                           ttft_ms=ttft_ms)
+                           ttft_ms=ttft_ms, pinned_entry=pinned_entry)
         return True
 
     def _admit_replay(self, req: _Request, slot_ix: int, ids: List[int],
@@ -1148,6 +1251,11 @@ class ContinuousBatchingEngine:
 
     def _release(self, slot_ix: int, park: bool = False) -> None:
         slot = self._slots[slot_ix]
+        if slot.pinned_entry is not None and self.prefix_cache is not None:
+            # Shared-hit slot: drop the pin FIRST (the entry becomes
+            # evictable again); the block references themselves drop
+            # through the uniform refcounted free()/park below.
+            self.prefix_cache.unpin(slot.pinned_entry)
         parked = False
         if park and self.prefix_cache is not None and slot.prompt_ids:
             # Park the blocks covering the prompt (ownership moves to the
@@ -1499,6 +1607,16 @@ class ContinuousBatchingEngine:
             pending = max(0, min(pf.max_blocks,
                                  -(-pf.total // self.paged.block_size))
                           - len(pf.blocks))
+        # Sharing picture (ISSUE 10): physical blocks with >= 2 holders,
+        # the dedup factor (logical references / physical blocks — what
+        # sharing multiplied the effective pool by), and entries pinned
+        # by live sharers.  reclaimable_blocks above already excludes
+        # pinned entries and refcount>1 blocks, so the admission gate's
+        # supply view (serving/tiers.py) never promises what sharing has
+        # pinned; these fields make that view inspectable.
+        rs = self.allocator.ref_stats()
+        pinned = (self.prefix_cache.stats()["pinned_entries"]
+                  if self.prefix_cache is not None else 0)
         return {
             "free_blocks": self.allocator.available,
             "reclaimable_blocks": reclaimable,
@@ -1507,6 +1625,11 @@ class ContinuousBatchingEngine:
             "preempted_total": self.preempted_total,
             "prefill_pending_blocks": pending,
             "prefill_backlog_tokens": backlog,
+            "shared_blocks": rs["shared_blocks"],
+            "dedup_ratio": (round(rs["total_refs"]
+                                  / rs["allocated_blocks"], 4)
+                            if rs["allocated_blocks"] else 1.0),
+            "pinned_entries": pinned,
         }
 
     def max_demand_blocks(self) -> int:
@@ -1660,6 +1783,22 @@ class ContinuousBatchingEngine:
                 jnp.asarray(self._temps), rng)
             jax.block_until_ready(toks)
             beat()
+        if self.share_prefix:
+            # The COW boundary-copy program: one compiled copy serves
+            # every (src, dst) pair, warmed here so the first shared-hit
+            # admission with a mid-block boundary doesn't trace on the
+            # admit path.  Copy between two blocks allocated for the
+            # purpose — a parked warmup prefix may already own low block
+            # ids, and copying garbage INTO an owned block would corrupt
+            # parked KV.
+            blks = self.allocator.alloc(2)
+            if blks is not None:
+                self.pool = self._cow_copy_fn()(
+                    self.pool, jnp.asarray(blks[0], jnp.int32),
+                    jnp.asarray(blks[1], jnp.int32))
+                jax.block_until_ready(self.pool["k"])
+                self.allocator.free(blks)
+                beat()
         if self.prefix_cache is not None and self._buckets:
             row = self._table_row([])
             # Every (reuse suffix bucket, chunk window rung) an admit

@@ -95,10 +95,27 @@ def init_pool(cfg: ModelConfig, pcfg: PagedConfig,
 
 
 class BlockAllocator:
-    """Thread-safe free-list over pool blocks (block 0 never allocated)."""
+    """Thread-safe REFCOUNTED free-list over pool blocks (block 0 never
+    allocated).
+
+    Ownership model (ISSUE 10, cross-request shared-prefix KV): a block
+    leaves the free list with refcount 1; ``share()`` increfs it so N
+    holders — live slots mapping a shared prefix read-only, parked
+    prefix-cache entries — each own one reference; ``free()`` decrefs
+    and only a block reaching refcount 0 returns to the free list.
+    Every holder calls the SAME ``free()`` it always did, so exclusive
+    ownership (refcount 1 everywhere) behaves exactly like the
+    pre-refcount allocator.  ``available`` keeps its meaning: blocks on
+    the free list, i.e. what ``alloc`` can hand out right now.
+
+    The refcount table is guarded by the allocator lock like the free
+    list — refcount mutation outside it is a race the ``locks`` lint
+    checker's fixtures pin (a torn incref under concurrent free would
+    leak or double-free a block of live KV)."""
 
     def __init__(self, num_blocks: int):
         self._free: List[int] = list(range(1, num_blocks))
+        self._refs: Dict[int, int] = {}
         self._lock = threading.Lock()
 
     def alloc(self, n: int) -> Optional[List[int]]:
@@ -106,11 +123,78 @@ class BlockAllocator:
             if len(self._free) < n:
                 return None
             got, self._free = self._free[:n], self._free[n:]
+            for b in got:
+                self._refs[b] = 1
             return got
 
-    def free(self, blocks: List[int]) -> None:
+    def share(self, blocks: List[int]) -> None:
+        """Incref live blocks: a new holder maps them (read-only — the
+        COW contract in engine/prefix_cache.py is what keeps sharers
+        from observing each other's writes).  Sharing a block that is
+        not currently allocated is a lifecycle bug (the would-be sharer
+        is mapping freed KV), so it raises instead of minting a
+        reference to garbage."""
         with self._lock:
-            self._free.extend(b for b in blocks if b != TRASH_BLOCK)
+            bad = [b for b in blocks if self._refs.get(b, 0) < 1]
+            if bad:
+                raise ValueError(
+                    f"share() of unallocated block(s) {bad}: only live "
+                    f"blocks can gain references")
+            for b in blocks:
+                self._refs[b] += 1
+
+    def free(self, blocks: List[int]) -> None:
+        """Drop one reference per block; refcount-0 blocks return to the
+        free list.  Freeing an unallocated block raises — a double-free
+        would put the block on the free list twice and hand the same
+        physical KV tile to two sequences.  The batch is validated
+        BEFORE any decref, so a bad batch mutates nothing (a partial
+        decref would silently leak the survivors)."""
+        with self._lock:
+            live = [b for b in blocks if b != TRASH_BLOCK]
+            drops: Dict[int, int] = {}
+            for b in live:
+                drops[b] = drops.get(b, 0) + 1
+            bad = [b for b, n in drops.items()
+                   if self._refs.get(b, 0) < n]
+            if bad:
+                raise ValueError(
+                    f"free() of unallocated block(s) {sorted(bad)} "
+                    f"(double free)")
+            released: List[int] = []
+            for b, n in drops.items():
+                r = self._refs[b] - n
+                if r == 0:
+                    del self._refs[b]
+                    released.append(b)
+                else:
+                    self._refs[b] = r
+            self._free.extend(released)
+
+    def refcount(self, block: int) -> int:
+        """Current reference count (0 = free/never allocated)."""
+        with self._lock:
+            return self._refs.get(block, 0)
+
+    def refcounts(self, blocks: List[int]) -> List[int]:
+        """Batch refcount read under ONE lock acquisition — the prefix
+        cache's reclaimable accounting runs on the admission-gate and
+        sampler paths, so a per-block lock round-trip would contend
+        with the scheduler's alloc/free once per parked block."""
+        with self._lock:
+            return [self._refs.get(b, 0) for b in blocks]
+
+    def ref_stats(self) -> Dict[str, int]:
+        """One-lock snapshot of the sharing picture: allocated physical
+        blocks, total references over them, and how many are shared
+        (refcount >= 2).  ``total_refs - allocated_blocks`` is exactly
+        the pool the sharing saved (kv_stats derives dedup_ratio)."""
+        with self._lock:
+            allocated = len(self._refs)
+            total = sum(self._refs.values())
+            shared = sum(1 for r in self._refs.values() if r >= 2)
+            return {"allocated_blocks": allocated, "total_refs": total,
+                    "shared_blocks": shared}
 
     @property
     def available(self) -> int:
@@ -140,6 +224,26 @@ def write_prefill_blocks(pool: KVPool, blocks: jax.Array,
                 "vs": pool["vs"].at[:, :, blocks].set(v_sc)}
     return {"k": pool["k"].at[:, :, blocks].set(k_blk),
             "v": pool["v"].at[:, :, blocks].set(v_blk)}
+
+
+def copy_block(pool: KVPool, src: jax.Array, dst: jax.Array) -> KVPool:
+    """Copy one pool block's K/V (and int8 scales) from ``src`` to
+    ``dst`` — the copy-on-write boundary step of shared-prefix KV
+    (engine/prefix_cache.py): a slot joining a shared prefix whose
+    matched length ends mid-block gets a PRIVATE copy of that partial
+    block, writes its own suffix there, and the sharers never see it.
+
+    ``src``/``dst`` are traced int32 scalars, so ONE compiled program
+    serves every (src, dst) pair — the block-write program family stays
+    bounded exactly like the prefill writers (a per-pair or per-length
+    wrap would re-trace on the admit path; the retrace lint fixtures in
+    tests/test_lint.py pin the idiom)."""
+    out = {"k": pool["k"].at[:, :, dst].set(pool["k"][:, :, src]),
+           "v": pool["v"].at[:, :, dst].set(pool["v"][:, :, src])}
+    if "ks" in pool:
+        out["ks"] = pool["ks"].at[:, :, dst].set(pool["ks"][:, :, src])
+        out["vs"] = pool["vs"].at[:, :, dst].set(pool["vs"][:, :, src])
+    return out
 
 
 def chunk_prefill_paged(

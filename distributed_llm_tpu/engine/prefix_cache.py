@@ -14,38 +14,67 @@ processed.  Owning the KV cache lets us fix that the TPU way:
   ``transformer.chunk_prefill`` — prefill cost drops from O(total) to
   O(delta), which is what bounds TTFT on deep conversations.
 
-Entries hold real HBM buffers, so capacity is small and LRU.  A reclaimed
-entry is REMOVED from the cache (the jitted suffix-prefill donates its
-buffers); the engine re-parks the updated cache after decoding.  Matching is
-exact-prefix on token ids — tail-truncated prompts simply miss (the prefix
-property is broken by truncation, and correctness never depends on a hit).
+Entries hold real HBM buffers, so capacity is small and LRU.  Two reuse
+modes (ISSUE 10):
 
-Thread safety: a plain lock around the entry list; the arrays themselves are
-only touched by the engine that reclaimed them.
+- **take** (exclusive, the contiguous engine and paged engines with
+  ``TierConfig.share_prefix_kv=False``): a reclaimed entry is REMOVED
+  from the cache (the jitted suffix-prefill donates its buffers); the
+  engine re-parks the updated cache after decoding.
+- **share** (paged engines, the default): a hit PINS the entry in place
+  and the caller maps its pool blocks read-only into the new slot's
+  block table (``BlockAllocator.share`` increfs them) — N concurrent
+  slots ride ONE physical copy of a common system prompt, so resident
+  KV scales with unique content.  The copy-on-write rule: the matched
+  length's partially-filled BOUNDARY block is copied into a slot-private
+  block before the slot writes its suffix there (``paged_kv.copy_block``)
+  — sharers only ever map blocks nobody writes.  ``unpin`` drops the pin
+  when the slot releases; pinned entries are skipped by every eviction
+  path (pop_oldest, put's replace/capacity sweeps) because evicting an
+  entry under live sharers would drop the cache's reference while the
+  sharers still map the blocks.
+
+Matching is exact-prefix on token ids — tail-truncated prompts simply miss
+(the prefix property is broken by truncation, and correctness never
+depends on a hit).
+
+Thread safety: a plain lock around the entry list (pin counts mutate
+under it too); the arrays themselves are only touched by the engine that
+reclaimed them, and shared pool blocks only ever read.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 
 @dataclasses.dataclass
 class PrefixEntry:
     ids: Tuple[int, ...]     # prompt token ids whose KV the cache holds
     cache: Any               # KVCache pytree [L,1,S_max,N_kv,D]
+    # Live sharers currently mapping this entry's pool blocks (share
+    # mode): >0 makes the entry ineligible for eviction and exclusive
+    # take.  Guarded by the owning cache's lock.
+    pins: int = 0
 
 
 def select_reuse(store: "Optional[PrefixCache]", ids: Sequence[int],
                  buckets: Sequence[int], max_seq: int,
-                 allow_long_suffix: bool = False):
-    """Shared take + suffix-bucket policy for both engines.
+                 allow_long_suffix: bool = False, share: bool = False):
+    """Shared take/share + suffix-bucket policy for both engines.
 
     Returns (entry, matched_len, suffix_ids, suffix_bucket) when a parked
     prefix can be extended within ``buckets``/``max_seq``, else None (any
-    taken entry is restored).  Keeping the policy here means the contiguous
-    and paged engines cannot drift apart on matching rules.
+    taken/pinned entry is restored/unpinned).  Keeping the policy here
+    means the contiguous and paged engines cannot drift apart on matching
+    rules.
+
+    ``share=True`` uses the pinning hit (``store.share``) instead of the
+    exclusive take: the entry stays in the cache for other concurrent
+    sessions and the caller must ``unpin`` when its slot releases (or
+    ``unshare`` if it turns out it cannot use the hit).
 
     ``allow_long_suffix``: when no single bucket holds the suffix, return
     suffix_bucket=None instead of restoring — the caller (contiguous
@@ -54,7 +83,10 @@ def select_reuse(store: "Optional[PrefixCache]", ids: Sequence[int],
     """
     if store is None or not buckets:
         return None
-    entry, m = store.take(ids, max_len=max_seq - buckets[0])
+    if share:
+        entry, m = store.share(ids, max_len=max_seq - buckets[0])
+    else:
+        entry, m = store.take(ids, max_len=max_seq - buckets[0])
     if entry is None:
         return None
     suffix = ids[m:]
@@ -65,7 +97,10 @@ def select_reuse(store: "Optional[PrefixCache]", ids: Sequence[int],
         span = m + -(-len(suffix) // cb) * cb
         if allow_long_suffix and span <= max_seq:
             return entry, m, suffix, None
-        store.untake(entry, m)   # caller goes cold
+        if share:                # caller goes cold
+            store.unshare(entry, m)
+        else:
+            store.untake(entry, m)
         return None
     return entry, m, suffix, sb
 
@@ -74,7 +109,9 @@ class PrefixCache:
     """Small LRU of (token-id prefix → KV cache) for one engine."""
 
     def __init__(self, capacity: int = 4, min_prefix: int = 4,
-                 on_evict=None):
+                 on_evict=None,
+                 block_refcounts: Optional[
+                     Callable[[List[int]], List[int]]] = None):
         # min_prefix is in TOKENS of the serving tokenizer: 4 subword ids
         # ≈ 14 chars of prompt (engine/bpe.py) — short enough that a
         # one-line opener parks a reusable prefix, long enough that the
@@ -86,16 +123,32 @@ class PrefixCache:
         """``on_evict(entry)`` is called for every entry dropped by put()/
         clear()/pop_oldest() — the paged engine uses it to return the
         entry's pool blocks to the allocator (HBM-array entries just get
-        garbage-collected)."""
+        garbage-collected; with refcounting a "return" is a decref, so an
+        evicted entry whose blocks live slots still share releases only
+        the cache's own reference).
+
+        ``block_refcounts(blocks) -> [int]`` (paged engines: the
+        allocator's BATCH refcount reader — one lock acquisition per
+        entry, because reclaimable accounting runs on the admission-gate
+        and sampler paths) makes ``reclaimable_blocks`` honest under
+        sharing: evicting an entry only frees its refcount-1 blocks, so
+        only those may be promised to the KV-admission gate."""
         self.capacity = capacity
         self.min_prefix = min_prefix
         self.on_evict = on_evict
+        self.block_refcounts = block_refcounts
         self._entries: List[PrefixEntry] = []   # LRU order: oldest first
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
-        # Token count actually skipped via reuse (for /stats).
-        self.tokens_saved = 0
+        # Hit/​tokens-skipped accounting split by reuse kind (ISSUE 10
+        # small fix: the old single counter only credited exclusive
+        # takes).  ``stats()`` reports the split AND their sum under the
+        # historical ``tokens_saved`` key.
+        self.hits_exclusive = 0
+        self.hits_shared = 0
+        self.tokens_saved_exclusive = 0
+        self.tokens_saved_shared = 0
 
     def take(self, ids: Sequence[int],
              max_len: Optional[int] = None) -> Tuple[Optional[PrefixEntry], int]:
@@ -110,38 +163,104 @@ class PrefixCache:
         suffix bucket).  Partial reuse of a longer entry is sound: KV at
         position i depends only on tokens 0..i, so the first m positions
         serve any prompt sharing that m-token prefix.
+
+        PINNED entries are skipped: exclusive ownership means the taker
+        will WRITE into the boundary block, which live sharers still map.
         """
-        ids = tuple(ids)
-        cap = len(ids) - 1
-        if max_len is not None:
-            cap = min(cap, max_len)
         with self._lock:
-            best_i, best_len = -1, 0
-            for i, e in enumerate(self._entries):
-                bound = min(len(e.ids), cap)
-                if bound < max(self.min_prefix, best_len + 1):
-                    continue
-                # True longest COMMON prefix: an entry that diverges
-                # partway (edited/regenerated turn) still donates the
-                # shared part — KV at position i depends only on tokens
-                # 0..i, so any common prefix is reusable.
-                if e.ids[:bound] == ids[:bound]:
-                    m = bound
-                else:
-                    m = 0
-                    for x, y in zip(e.ids[:bound], ids[:bound]):
-                        if x != y:
-                            break
-                        m += 1
-                if m >= max(self.min_prefix, best_len + 1):
-                    best_i, best_len = i, m
+            best_i, best_len = self._best_match(ids, max_len,
+                                                skip_pinned=True)
             if best_i < 0:
                 self.misses += 1
                 return None, 0
             entry = self._entries.pop(best_i)
             self.hits += 1
-            self.tokens_saved += best_len
+            self.hits_exclusive += 1
+            self.tokens_saved_exclusive += best_len
             return entry, best_len
+
+    def _best_match(self, ids: Sequence[int], max_len: Optional[int],
+                    skip_pinned: bool = False) -> Tuple[int, int]:
+        """(entry index, matched length) of the longest parked common
+        prefix of ``ids``, or (-1, 0) — THE matching policy, shared by
+        take/share/peek so the three modes can never drift on matching
+        rules (lock held by the caller).
+
+        True longest COMMON prefix: an entry that diverges partway
+        (edited/regenerated turn) still donates the shared part — KV at
+        position i depends only on tokens 0..i, so any common prefix is
+        reusable.  matched length is capped at len(ids)-1 (the caller
+        always needs >= 1 suffix token to forward) and at ``max_len``
+        (suffix-bucket headroom)."""
+        ids = tuple(ids)
+        cap = len(ids) - 1
+        if max_len is not None:
+            cap = min(cap, max_len)
+        best_i, best_len = -1, 0
+        for i, e in enumerate(self._entries):
+            if skip_pinned and e.pins > 0:
+                continue
+            bound = min(len(e.ids), cap)
+            if bound < max(self.min_prefix, best_len + 1):
+                continue
+            if e.ids[:bound] == ids[:bound]:
+                m = bound
+            else:
+                m = 0
+                for x, y in zip(e.ids[:bound], ids[:bound]):
+                    if x != y:
+                        break
+                    m += 1
+            if m >= max(self.min_prefix, best_len + 1):
+                best_i, best_len = i, m
+        return best_i, best_len
+
+    def share(self, ids: Sequence[int],
+              max_len: Optional[int] = None
+              ) -> Tuple[Optional[PrefixEntry], int]:
+        """Pinning twin of ``take()``: the longest parked common prefix
+        of ``ids``, left IN the cache with its pin count raised — the
+        caller maps the entry's blocks read-only (incref via
+        ``BlockAllocator.share``) and copies the boundary block before
+        writing (the COW rule).  Same matching/cap semantics as take();
+        unlike take(), already-pinned entries remain eligible (that is
+        the whole point: N concurrent sessions pin one entry).  The hit
+        touches LRU order — a prefix under live sharing is the hottest
+        thing in the cache.  Callers pair every share() with exactly one
+        ``unpin`` (slot released) or ``unshare`` (hit unusable)."""
+        with self._lock:
+            best_i, best_len = self._best_match(ids, max_len)
+            if best_i < 0:
+                self.misses += 1
+                return None, 0
+            entry = self._entries.pop(best_i)
+            self._entries.append(entry)      # LRU touch, stays parked
+            entry.pins += 1
+            self.hits += 1
+            self.hits_shared += 1
+            self.tokens_saved_shared += best_len
+            return entry, best_len
+
+    def unpin(self, entry: PrefixEntry) -> None:
+        """Drop one sharer's pin (slot finished/preempted/failed): the
+        entry becomes evictable again once its last pin drops.  The
+        sharer's block REFERENCES are the allocator's business
+        (``free()`` decrefs them) — this only updates eviction
+        eligibility."""
+        with self._lock:
+            entry.pins = max(0, entry.pins - 1)
+
+    def unshare(self, entry: PrefixEntry, matched_len: int) -> None:
+        """Undo a share(): the caller found it could not use the hit
+        (no suffix bucket, or no private blocks for the remainder) and
+        never mapped the entry's blocks.  Unpins and reverses the hit
+        accounting — the mirror of ``untake`` for the pinning mode."""
+        with self._lock:
+            entry.pins = max(0, entry.pins - 1)
+            self.hits -= 1
+            self.hits_shared -= 1
+            self.tokens_saved_shared -= matched_len
+            self.misses += 1
 
     def peek(self, ids: Sequence[int],
              max_len: Optional[int] = None) -> int:
@@ -151,26 +270,9 @@ class PrefixCache:
         its LRU order, or its stats.  ``max_len`` mirrors take()'s cap
         (the engine's suffix-bucket headroom) so affinity scores never
         overstate what a subsequent take() could actually reuse."""
-        ids = tuple(ids)
-        cap = len(ids) - 1
-        if max_len is not None:
-            cap = min(cap, max_len)
-        best = 0
         with self._lock:
-            for e in self._entries:
-                bound = min(len(e.ids), cap)
-                if bound < max(self.min_prefix, best + 1):
-                    continue
-                if e.ids[:bound] == ids[:bound]:
-                    m = bound
-                else:
-                    m = 0
-                    for x, y in zip(e.ids[:bound], ids[:bound]):
-                        if x != y:
-                            break
-                        m += 1
-                best = max(best, m)
-        return best if best >= self.min_prefix else 0
+            _, best = self._best_match(ids, max_len)
+        return best
 
     def untake(self, entry: PrefixEntry, matched_len: int) -> None:
         """Undo a take(): the caller found it could not use the reclaimed
@@ -182,14 +284,30 @@ class PrefixCache:
         evicted: List[PrefixEntry] = []
         with self._lock:
             self.hits -= 1
-            self.tokens_saved -= matched_len
+            self.hits_exclusive -= 1
+            self.tokens_saved_exclusive -= matched_len
             self.misses += 1
             self._entries.append(entry)
-            while len(self._entries) > self.capacity:
-                evicted.append(self._entries.pop(0))
+            self._evict_over_capacity(evicted)
         for e in evicted:          # same drop contract as put()/clear()
             if self.on_evict is not None:
                 self.on_evict(e)
+
+    def _evict_over_capacity(self, evicted: List[PrefixEntry]) -> None:
+        """Pop oldest UNPINNED entries until within capacity (lock held
+        by the caller; put/untake call this right after appending).  The
+        just-appended LAST entry is never the victim — evicting the
+        entry a put() just published would waste the publish — and
+        pinned entries are skipped, so an all-pinned cache tolerates
+        transient over-capacity (bounded by pins + 1: evicting under
+        live sharers is never sound, and pins drop as sharing slots
+        finish)."""
+        while len(self._entries) > self.capacity:
+            ix = next((i for i, e in enumerate(self._entries[:-1])
+                       if e.pins == 0), None)
+            if ix is None:
+                return
+            evicted.append(self._entries.pop(ix))
 
     def put(self, ids: Sequence[int], cache: Any) -> bool:
         """Park a cache whose first len(ids) positions hold KV for ``ids``.
@@ -201,51 +319,80 @@ class PrefixCache:
         evicted: List[PrefixEntry] = []
         with self._lock:
             # Replace any entry this one extends (or duplicates): the longer
-            # prefix serves every prompt the shorter one could.
+            # prefix serves every prompt the shorter one could.  PINNED
+            # entries stay — live sharers map their blocks, and under
+            # refcounting two entries owning references to the same
+            # physical blocks is sound (each eviction releases only its
+            # own reference).
             keep = []
             for e in self._entries:
-                (evicted if ids[:len(e.ids)] == e.ids else keep).append(e)
+                extends = ids[:len(e.ids)] == e.ids and e.pins == 0
+                (evicted if extends else keep).append(e)
             keep.append(PrefixEntry(ids, cache))
-            while len(keep) > self.capacity:
-                evicted.append(keep.pop(0))
             self._entries = keep
+            self._evict_over_capacity(evicted)
         for e in evicted:
             if self.on_evict is not None:
                 self.on_evict(e)
         return True
 
     def pop_oldest(self) -> Optional[PrefixEntry]:
-        """Evict (and return, after on_evict) the LRU entry — used by the
-        paged engine to reclaim pool blocks under admission pressure."""
+        """Evict (and return, after on_evict) the LRU UNPINNED entry —
+        used by the paged engine to reclaim pool blocks under admission
+        pressure.  Entries with live sharers are skipped: their blocks
+        could not reach the free list anyway (the sharers hold
+        references), so evicting them would only burn a warm prefix."""
         with self._lock:
-            if not self._entries:
+            ix = next((i for i, e in enumerate(self._entries)
+                       if e.pins == 0), None)
+            if ix is None:
                 return None
-            entry = self._entries.pop(0)
+            entry = self._entries.pop(ix)
         if self.on_evict is not None:
             self.on_evict(entry)
         return entry
 
     def reclaimable_blocks(self) -> int:
-        """Total pool blocks held by parked entries — the eviction
-        headroom KV-aware admission (serving/tiers.py) may promise.
-        Paged engines park ``{"blocks": [...]}`` caches; the contiguous
-        engine's HBM-array entries hold no pool blocks and count 0."""
+        """Pool blocks an eviction sweep could ACTUALLY return to the
+        free list — the headroom KV-aware admission (serving/tiers.py)
+        may promise.  Paged engines park ``{"blocks": [...]}`` caches;
+        the contiguous engine's HBM-array entries hold no pool blocks
+        and count 0.  Under sharing the count excludes (a) pinned
+        entries — eviction skips them — and (b) any block with
+        refcount > 1 (``block_refcounts`` injected by the engine):
+        evicting its entry releases only the cache's reference while a
+        live slot or another entry keeps the block resident, so
+        promising it to admission would overstate supply."""
         with self._lock:
             total = 0
             for e in self._entries:
+                if e.pins > 0:
+                    continue
                 blocks = (e.cache.get("blocks")
                           if isinstance(e.cache, dict) else None)
-                if blocks:
+                if not blocks:
+                    continue
+                if self.block_refcounts is None:
                     total += len(blocks)
+                else:
+                    total += sum(1 for r in self.block_refcounts(blocks)
+                                 if r == 1)
             return total
 
     def stats(self) -> dict:
         with self._lock:
             return {
                 "entries": len(self._entries),
+                "pinned_entries": sum(1 for e in self._entries
+                                      if e.pins > 0),
                 "hits": self.hits,
+                "hits_exclusive": self.hits_exclusive,
+                "hits_shared": self.hits_shared,
                 "misses": self.misses,
-                "tokens_saved": self.tokens_saved,
+                "tokens_saved": (self.tokens_saved_exclusive
+                                 + self.tokens_saved_shared),
+                "tokens_saved_exclusive": self.tokens_saved_exclusive,
+                "tokens_saved_shared": self.tokens_saved_shared,
             }
 
     def clear(self) -> None:

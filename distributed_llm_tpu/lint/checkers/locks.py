@@ -28,6 +28,17 @@ Three rules over ``serving/`` + ``engine/`` + ``obs/``:
   inconsistent-discipline race (the checker stays silent on attributes
   never guarded anywhere: those are presumed single-writer by design,
   e.g. a scheduler thread's private state with GIL-safe snapshot reads).
+  Container mutation through the attribute (``self._refs[b] = ...``,
+  ``del self._refs[b]``) counts as a write: the refcounted allocator's
+  table (ISSUE 10) races exactly this way — a bare incref against a
+  locked reaper — while the attribute binding itself never changes.
+  The rule fires only when the WORKER holds a lock at some write site
+  (a discipline exists but missed a site); a worker whose writes are
+  ALL bare is presumed single-writer even if another site locks, since
+  that shape is statically indistinguishable from the scheduler's
+  owned-state pattern (bare `_slots` everywhere + a post-join read
+  under the unrelated lifecycle lock) — the deliberate-limit fixture
+  in tests/test_lint.py pins this tradeoff.
 
 Heuristics are deliberately name-based where cross-module types are
 unknowable statically; intended violations carry inline suppressions
@@ -196,6 +207,22 @@ class _FuncScan(ast.NodeVisitor):
             is_write = isinstance(node.ctx, (ast.Store, ast.Del))
             self.attr_accesses.append(
                 (node.attr, node, is_write, self._held_now()))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # Mutating a container THROUGH a self attribute
+        # (``self._refs[b] = ...``, ``del self._refs[b]``,
+        # ``self._refs[b] += 1``) is a WRITE to the shared state the
+        # attribute names, even though the attribute itself is only
+        # loaded — the refcount-table shape (ISSUE 10): a bare incref
+        # racing a locked reaper tears the count.  Rebinding-only
+        # tracking missed this class entirely.
+        if (isinstance(node.ctx, (ast.Store, ast.Del))
+                and isinstance(node.value, ast.Attribute)
+                and isinstance(node.value.value, ast.Name)
+                and node.value.value.id == "self"):
+            self.attr_accesses.append(
+                (node.value.attr, node, True, self._held_now()))
         self.generic_visit(node)
 
 
@@ -422,7 +449,7 @@ class LockChecker(Checker):
                    if i.class_name}
         for cls in sorted(classes):
             guarded: Dict[str, Set[str]] = {}
-            worker_writes: Set[str] = set()
+            worker_guarded_writes: Set[str] = set()
             bare: List[Tuple[str, ast.AST, str]] = []
             for qual, scan in scans.items():
                 info = syms.functions[qual]
@@ -433,16 +460,25 @@ class LockChecker(Checker):
                         scan, parents):
                     if held:
                         guarded.setdefault(attr, set()).update(held)
-                    if is_write and qual in worker_funcs and not is_init:
-                        worker_writes.add(attr)
+                    # The discipline signal: the WORKER code itself
+                    # locks this attr at some write site.  A worker
+                    # that never locks it anywhere is the presumed
+                    # single-writer pattern (the batching scheduler's
+                    # slot list with GIL-safe snapshot reads, where an
+                    # unrelated lifecycle lock happens to be held at a
+                    # post-join site) — mixed-guard is about a
+                    # discipline that EXISTS but missed a site.
+                    if (is_write and held and qual in worker_funcs
+                            and not is_init):
+                        worker_guarded_writes.add(attr)
                     if not held and not is_init:
                         bare.append((attr, node, qual))
             for attr, node, qual in bare:
-                if attr in guarded and attr in worker_writes:
+                if attr in worker_guarded_writes:
                     locks = ", ".join(sorted(guarded[attr]))
                     findings.append(Finding(
                         "lock-mixed-guard", mod.relpath, node.lineno,
                         f"`self.{attr}` is written from worker-thread "
-                        f"code and guarded by {locks} elsewhere, but "
-                        f"accessed here without any lock"))
+                        f"code under {locks}, but accessed here without "
+                        f"any lock"))
         return findings

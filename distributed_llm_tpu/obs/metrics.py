@@ -407,7 +407,28 @@ class ServingMetrics:
         self.kv_reclaimable_blocks_g = registry.gauge(
             "dllm_kv_reclaimable_blocks",
             "Pool blocks reclaimable by evicting parked prefixes "
-            "(sampled)", ("tier",))
+            "(sampled; under shared-prefix KV only refcount-1 blocks of "
+            "unpinned entries count — what an eviction sweep could "
+            "actually free)", ("tier",))
+        # Shared-prefix KV family (ISSUE 10): how much physical pool the
+        # refcounted copy-on-write sharing is saving, and what kind of
+        # prefix-cache hits admissions are taking.
+        self.kv_shared_blocks_g = registry.gauge(
+            "dllm_kv_shared_blocks",
+            "Physical pool blocks with >= 2 holders (live slots mapping "
+            "a shared prefix read-only and/or parked entries; sampled)",
+            ("tier",))
+        self.kv_dedup_ratio_g = registry.gauge(
+            "dllm_kv_dedup_ratio",
+            "Logical block references / physical allocated blocks — the "
+            "factor shared-prefix KV multiplies the effective pool by "
+            "(1.0 = nothing shared; sampled)", ("tier",))
+        self.prefix_hits = registry.counter(
+            "dllm_prefix_hits_total",
+            "Prefix-cache lookup outcomes on the batched admit path, "
+            "per admission attempt (shared = pinned read-only mapping, "
+            "exclusive = take-ownership reuse, miss = cold prefill)",
+            ("tier", "kind"))
         self.tier_draining_g = registry.gauge(
             "dllm_tier_draining",
             "1 while the tier is gracefully draining, else 0 (sampled)",

@@ -55,6 +55,8 @@ _GAUGE_FIELDS = (
     ("max_slots", "max_slots_g"),
     ("kv_free_blocks", "kv_free_blocks_g"),
     ("kv_reclaimable_blocks", "kv_reclaimable_blocks_g"),
+    ("kv_shared_blocks", "kv_shared_blocks_g"),
+    ("kv_dedup_ratio", "kv_dedup_ratio_g"),
     ("prefill_backlog_tokens", "prefill_backlog_g"),
     ("draining", "tier_draining_g"),
     ("decode_tick_p50_ms", "decode_tick_p50_g"),

@@ -371,6 +371,12 @@ class Router:
                     st["kv_free_blocks"] = ks.get("free_blocks")
                     st["kv_reclaimable_blocks"] = ks.get(
                         "reclaimable_blocks")
+                    # Shared-prefix KV (ISSUE 10): physical blocks with
+                    # multiple holders and the dedup factor — the
+                    # dllm_kv_shared_blocks / dllm_kv_dedup_ratio
+                    # gauges' source series.
+                    st["kv_shared_blocks"] = ks.get("shared_blocks", 0)
+                    st["kv_dedup_ratio"] = ks.get("dedup_ratio", 1.0)
                     st["preempted_total"] = ks.get("preempted_total", 0)
                     # Chunked-prefill backlog (PR 9): prompt tokens of
                     # the in-flight prefill not yet absorbed — the

@@ -381,6 +381,11 @@ class TierClient:
             return None, None
         try:
             st = stats_fn()
+            # reclaimable_blocks is pin- and refcount-aware (ISSUE 10):
+            # parked entries with live sharers, and parked blocks whose
+            # eviction would only drop one of several references, are
+            # already excluded by the engine's PrefixCache — the gate
+            # never promises supply that sharing has pinned.
             supply = (int(st["free_blocks"])
                       + int(st["reclaimable_blocks"])
                       # The in-flight chunked prefill's remaining block

@@ -114,6 +114,16 @@ def engine_stats(engine) -> Dict[str, Any]:
             entry["work"] = work
     if getattr(engine, "prefix_cache", None) is not None:
         entry["prefix_cache"] = engine.prefix_cache.stats()
+    kv_fn = getattr(engine, "kv_stats", None)
+    if callable(kv_fn):
+        # Pool-pressure + sharing snapshot (ISSUE 10): free/reclaimable
+        # supply as the admission gate sees it, plus shared/pinned block
+        # counts and the dedup ratio — GET /stats shows WHAT the KV gate
+        # is gating on, inspectable without a metrics scrape.
+        try:
+            entry["kv"] = kv_fn()
+        except Exception:
+            pass
     if hasattr(engine, "acceptance_rate"):
         entry["speculative_acceptance_rate"] = round(
             engine.acceptance_rate, 4)

@@ -257,6 +257,100 @@ def test_lock_mixed_guard_ignores_never_guarded_scheduler_state():
     assert _lint(LockChecker(), {ENGINE: src}).findings == []
 
 
+def test_lock_mixed_guard_flags_refcount_mutation_outside_allocator_lock():
+    """ISSUE 10 regression shape: the refcounted BlockAllocator's
+    ``_refs`` table is written from scheduler-thread-reachable code
+    under the allocator lock — a bare mutation site elsewhere (a torn
+    incref racing a concurrent free) must flag."""
+    bad = """
+        import threading
+
+        class Allocator:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._refs = {}
+                self._free = []
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                with self._lock:
+                    for b, r in list(self._refs.items()):
+                        if r == 0:
+                            del self._refs[b]
+
+            def share(self, blocks):
+                for b in blocks:
+                    self._refs[b] = self._refs[b] + 1   # bare incref
+    """
+    result = _lint(LockChecker(), {ENGINE: bad})
+    assert "lock-mixed-guard" in _rules(result), result.findings
+    assert any("_refs" in f.message for f in result.findings)
+
+
+def test_lock_mixed_guard_refcount_mutation_under_lock_clean():
+    """Near-miss: every ``_refs`` touch under the allocator lock — the
+    shipped BlockAllocator shape — stays silent."""
+    src = """
+        import threading
+
+        class Allocator:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._refs = {}
+                self._free = []
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                with self._lock:
+                    for b, r in list(self._refs.items()):
+                        if r == 0:
+                            del self._refs[b]
+
+            def share(self, blocks):
+                with self._lock:
+                    for b in blocks:
+                        self._refs[b] = self._refs[b] + 1
+    """
+    assert _lint(LockChecker(), {ENGINE: src}).findings == []
+
+
+def test_lock_mixed_guard_all_bare_worker_writes_presumed_single_writer():
+    """DELIBERATE LIMIT (pinned so a future edit is a conscious choice):
+    a worker whose writes to an attr are ALL bare is presumed
+    single-writer even when some OTHER site touches the attr under a
+    lock.  The shapes are statically indistinguishable: the batching
+    scheduler owns `_slots` bare everywhere while stop() reads it under
+    the (unrelated) lifecycle lock AFTER joining the thread — flagging
+    that pattern would force suppressions on the engine's core design.
+    The rule therefore keys on the worker itself locking at some write
+    site ("a discipline exists but missed a site"); writer-always-bare
+    races need the worker to adopt a lock before the checker can see
+    the inconsistency."""
+    src = """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._slots = {}
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                self._slots[0] = object()    # bare: scheduler-owned
+
+            def stop(self):
+                with self._lock:             # lifecycle lock, post-join
+                    return len(self._slots)
+    """
+    assert _lint(LockChecker(), {ENGINE: src}).findings == []
+
+
 def test_lock_checker_manual_release_ends_held_region():
     """acquire/try/finally-release then blocking work must not flag:
     the held region ends at the release."""
@@ -1266,6 +1360,53 @@ def test_retrace_chunk_per_prompt_length_shapes_flagged():
     """
     result = _lint(RetraceChecker(), {ENGINE: keyed})
     assert _rules(result) == ["retrace-shape-cache-key"], result.findings
+
+
+def test_retrace_cow_copy_per_admission_wrap_flagged():
+    """The COW boundary copy this PR must NOT ship (ISSUE 10): wrapping
+    the one-block copy per admission re-traces on the admit path — the
+    copy must ride the cached block-write program family (block ids are
+    traced scalars, ONE program for every (src, dst) pair)."""
+    from distributed_llm_tpu.lint.checkers.retrace import RetraceChecker
+    bad = """
+        from functools import partial
+
+        import jax
+
+        def _copy(pool, *, src, dst):
+            return pool["k"].at[:, :, dst].set(pool["k"][:, :, src])
+
+        def admit(self, pool, src, dst):    # dllm-lint: hot-path
+            # fresh trace per (src, dst) pair — unbounded program churn
+            return jax.jit(partial(_copy, src=src, dst=dst))(pool)
+    """
+    result = _lint(RetraceChecker(), {ENGINE: bad})
+    assert "retrace-per-call-wrap" in _rules(result), result.findings
+
+
+def test_retrace_cow_copy_cached_block_write_family_clean():
+    """Near-miss: the shipped idiom — copy_block jitted ONCE into a
+    cached program (src/dst are traced scalar ARGS, not closure
+    constants), reused by every shared-hit admission — must stay
+    silent like the prefill writers it rides next to."""
+    from distributed_llm_tpu.lint.checkers.retrace import RetraceChecker
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        def copy_block(pool, src, dst):
+            return pool["k"].at[:, :, dst].set(pool["k"][:, :, src])
+
+        def cow_fn(self):
+            if self._cow_fn is None:
+                self._cow_fn = jax.jit(copy_block)   # minted once
+            return self._cow_fn
+
+        def admit(self, pool, src, dst):    # dllm-lint: hot-path
+            return self.cow_fn()(pool, jnp.asarray(src, jnp.int32),
+                                 jnp.asarray(dst, jnp.int32))
+    """
+    assert _lint(RetraceChecker(), {ENGINE: src}).findings == []
 
 
 # -- transfer checker --------------------------------------------------------
