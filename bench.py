@@ -342,6 +342,26 @@ def compact(result: dict) -> dict:
         }.items() if v is not None}
         if cm:
             out["shared"] = cm
+    pf = result.get("profile")
+    if isinstance(pf, dict) and not pf.get("skipped"):
+        # One number each (BENCHMARKS.md r14): worst per-tier phase
+        # coverage (>= 0.95 bar), the attribution-conservation ratio
+        # (~1.0), decode/emit phase p50s for the first profiled tier,
+        # and the trace artifact's event count.
+        tiers_pf = pf.get("tiers") or {}
+        first = next(iter(tiers_pf.values()), {}) if tiers_pf else {}
+        phases = first.get("phases") or {}
+        cm = {k: v for k, v in {
+            "cov": pf.get("coverage"),
+            "attr": pf.get("attribution_ratio"),
+            "ticks": first.get("ticks"),
+            "decode_p50": (phases.get("decode") or {}).get("p50_ms"),
+            "emit_p50": (phases.get("emit") or {}).get("p50_ms"),
+            "events": pf.get("trace_events"),
+            "err": (pf.get("error") or "")[:80] or None,
+        }.items() if v is not None}
+        if cm:
+            out["profile"] = cm
     strategies = result.get("per_strategy")
     if isinstance(strategies, dict):
         # t50/t95 = trace-derived p50/p95 TTFT, tbt50 = trace-derived
@@ -1372,6 +1392,152 @@ def shared_prefix_phase(k_sessions: int = 4, beat=lambda: None) -> dict:
     return out
 
 
+def profile_phase(n_requests: int = 12, beat=lambda: None,
+                  trace_path: str = "BENCH_profile_trace.json") -> dict:
+    """Tick-forensics leg (ISSUE 11): serve a small session-keyed mix
+    through the full Router pipeline with the tick-phase profiler on,
+    then read back WHERE the milliseconds went and WHO pays.
+
+    Reports: the per-phase p50/p95 SELF-time table over the engine's
+    profiler ring (admit / prefill / cow_copy / table_upload / decode /
+    emit / chunk_prefill — BENCHMARKS.md r14 defines the columns), the
+    coverage fraction (stamped phase self-time / tick wall — the
+    acceptance bar is >= 0.95; below it the leg sets ``error``), the
+    attribution-conservation ratio (sum of per-request
+    ``device_time_ms`` / the profiler's lifetime decode self-time — the
+    even per-tick split must re-add to what the ticks cost; bar 5%),
+    the per-(tier, strategy, session) cost ledger head, and the Chrome-
+    trace artifact (``trace_path``) validated by JSON round-trip with
+    per-tier tick timestamps checked monotonic, viewable in
+    chrome://tracing / ui.perfetto.dev."""
+    import json as _json
+    import sys
+
+    from distributed_llm_tpu.config import tiny_batched_cluster
+    from distributed_llm_tpu.obs import Observability
+    from distributed_llm_tpu.serving.router import Router
+
+    print("[bench] tick-forensics profile leg", file=sys.stderr,
+          flush=True)
+    obs = Observability(slow_ms=None)
+    router = Router(strategy="heuristic", benchmark_mode=True,
+                    cluster=tiny_batched_cluster(), observability=obs)
+    out: dict = {}
+    try:
+        queries = [
+            "What is the capital of France",
+            "Explain photosynthesis briefly",
+            "Name a large river in Africa",
+        ]
+        errors = 0
+        t0 = time.perf_counter()
+        for i in range(n_requests):
+            hist = [{"role": "user",
+                     "content": f"{queries[i % len(queries)]} (v{i})"}]
+            resp, _, _ = router.route_query(hist,
+                                            session_id=f"s{i % 3}")
+            if not resp.get("ok", True):
+                errors += 1
+            beat()
+        wall = time.perf_counter() - t0
+        out["requests"] = n_requests
+        out["errors"] = errors
+        out["req_per_s"] = round(n_requests / max(wall, 1e-9), 3)
+
+        # Per-phase table + coverage, per tier with a live profiler.
+        tiers: dict = {}
+        attributed_den = 0.0
+        for name, tier in router.tiers.items():
+            engine = getattr(tier.server_manager, "_engine", None)
+            prof = getattr(engine, "profiler", None)
+            if prof is None or not getattr(prof, "enabled", False):
+                continue
+            st = prof.phase_stats()
+            tiers[name] = {
+                "ticks": st["ticks"],
+                "coverage": st["coverage"],
+                "phases": st["phases"],
+            }
+            attributed_den += prof.total_ms("decode")
+            beat()
+        out["tiers"] = tiers
+        if not tiers:
+            # DLLM_PROFILE=0 in the environment: no profiler is a
+            # CONFIGURED state, not a failed leg — report the same
+            # skip shape the budget path uses instead of a phantom
+            # coverage error.
+            out["skipped"] = ("no live profiler (DLLM_PROFILE=0 "
+                              "disables the leg's subject)")
+            return out
+        coverages = [t["coverage"] for t in tiers.values()
+                     if t.get("coverage") is not None]
+        out["coverage"] = min(coverages) if coverages else None
+
+        # Attribution conservation: what the requests were billed vs
+        # what the decode phases measured (5% bar, tests pin it too).
+        fam = obs.metrics.get("dllm_device_time_ms_total")
+        attributed = (sum(c.value for c in fam.children().values())
+                      if fam is not None else 0.0)
+        out["attributed_device_ms"] = round(attributed, 3)
+        out["decode_phase_ms"] = round(attributed_den, 3)
+        if attributed_den > 0:
+            out["attribution_ratio"] = round(attributed / attributed_den,
+                                             4)
+        out["cost_head"] = router.cost_snapshot()[:4]
+
+        # The Chrome-trace artifact: round-trip through JSON, then
+        # check per-tier tick slices are timestamp-monotonic in seq
+        # order (the schema contract GET /debug/trace promises).
+        trace = router.profiler_trace()
+        blob = _json.dumps(trace)
+        parsed = _json.loads(blob)
+        events = parsed.get("traceEvents", [])
+        ok_schema = all(
+            ("name" in e and "ph" in e and "pid" in e and "tid" in e
+             and (e["ph"] == "M" or (e.get("ts", -1) >= 0
+                                     and e.get("dur", 0) >= 0)))
+            for e in events)
+        by_tid: dict = {}
+        for e in events:
+            if e.get("ph") == "X" and e.get("name") == "tick":
+                by_tid.setdefault(e["tid"], []).append(e)
+        monotonic = all(
+            all(a["args"]["seq"] < b["args"]["seq"]
+                and a["ts"] <= b["ts"]
+                for a, b in zip(ticks, ticks[1:]))
+            for ticks in by_tid.values())
+        out["trace_events"] = len(events)
+        out["trace_schema_ok"] = bool(ok_schema and monotonic)
+        try:
+            with open(trace_path, "w") as f:
+                f.write(blob)
+            out["trace_artifact"] = trace_path
+        except OSError as exc:
+            out["trace_artifact_error"] = str(exc)[:120]
+
+        # Acceptance bars (ISSUE 11): phases must explain >= 95% of the
+        # tick wall, attribution must re-add to the decode cost within
+        # 5%, and the export must be schema-valid.
+        problems = []
+        if out["coverage"] is None or out["coverage"] < 0.95:
+            problems.append(f"phase coverage {out['coverage']} < 0.95")
+        ratio = out.get("attribution_ratio")
+        if ratio is None or abs(ratio - 1.0) > 0.05:
+            problems.append(f"attribution ratio {ratio} outside 5%")
+        if not out["trace_schema_ok"]:
+            problems.append("chrome-trace schema/monotonicity check "
+                            "failed")
+        if errors:
+            problems.append(f"{errors} request error(s)")
+        if problems:
+            out["error"] = "; ".join(problems)[:300]
+    finally:
+        for tier in router.tiers.values():
+            tier.server_manager.stop_server()
+    beat()
+    return out
+
+
 def concurrent_phase(cluster, n_requests: int = 12, n_sequential: int = 4,
                      slots: int = 4, max_new: int = 32, repeat: int = 3,
                      beat=lambda: None) -> dict:
@@ -2372,6 +2538,23 @@ def run(progress: "Progress" = None, budget: "Budget" = None) -> dict:
     else:
         shared = {"skipped": budget.skip_stamp()}
     progress.section("shared", shared)
+    progress.flush_compact()
+
+    # Tick-forensics profile leg (ISSUE 11): a session-keyed mix through
+    # the full Router with the tick-phase profiler on — per-phase
+    # p50/p95 self-time table (coverage >= 0.95 of tick wall or the leg
+    # errors), attribution conservation (billed device_time_ms re-adds
+    # to the decode phase total within 5%), the per-(tier, strategy,
+    # session) cost ledger head, and the Chrome-trace artifact
+    # (BENCH_profile_trace.json) — BENCHMARKS.md r14 "profile leg".
+    if budget.allows(60):
+        try:
+            profile = profile_phase(beat=progress.beat)
+        except Exception as exc:          # never lose the headline line
+            profile = {"error": str(exc)[:200]}
+    else:
+        profile = {"skipped": budget.skip_stamp()}
+    progress.section("profile", profile)
     progress.flush_compact()
 
     # Open-loop SLO goodput leg right after the skew leg (ISSUE 7; same

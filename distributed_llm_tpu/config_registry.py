@@ -84,6 +84,13 @@ ENV_VARS: Dict[str, EnvVar] = {v.name: v for v in (
        "files changed vs this ref; whole-project checkers (locks, "
        "retrace, transfer, thread_lifecycle, config_drift) auto-widen "
        "to full reporting because their verdicts cross files."),
+    _e("DLLM_PROFILE", "1", "obs/profiler.py",
+       "'0' disables the batched engines' tick-phase profiler AND the "
+       "per-request device-time/KV-residency attribution (zero-cost "
+       "null object); default on (measured <= 1% of tick p50)."),
+    _e("DLLM_PROFILE_TICKS", "512", "obs/profiler.py",
+       "Tick-phase profiler ring capacity in tick records per engine "
+       "(GET /debug/trace exports the ring's span)."),
     _e("DLLM_OBS_SLOW_MS", "30000", "obs/__init__.py",
        "Global flight-recorder slow-request threshold in ms; '0'/'off' "
        "disables the slow trigger (failed/degraded still record)."),

@@ -47,6 +47,7 @@ from .. import models
 from ..models import transformer
 from ..obs import metrics as obs_metrics
 from ..obs import spans as obs_spans
+from ..obs.profiler import make_profiler
 from ..serving.errors import error_dict
 from .inference import (GenerationResult, prepare_prompt, trim_at_eos,
                         upgrade_attention_impl)
@@ -229,6 +230,20 @@ class ContinuousBatchingEngine:
         # Recent decode-tick device times in ms (ring; bench skew leg and
         # tests read it — the obs histogram is the scrapeable twin).
         self.tick_ms: "deque[float]" = deque(maxlen=512)
+        # Tick-phase profiler (ISSUE 11, obs/profiler.py): per-pass phase
+        # breakdown ring + per-request decode-time/KV-residency
+        # attribution.  DLLM_PROFILE=0 swaps in the shared zero-cost
+        # null object; every stamp below and the attribution branch in
+        # the tick gate on it.
+        self.profiler = make_profiler(tier.name)
+        # Per-slot KV-residency weight cache (Σ 1/refcount over the
+        # slot's blocks): a refcount relevant to a LIVE slot can only
+        # change through an event that also rewrites a table row
+        # (admission/share, growth, finish/park, preempt), so the cache
+        # is invalidated with the device-table caches in _set_table_row
+        # and the attribution loop pays one dict lookup per slot per
+        # tick instead of an allocator-locked refcount scan.
+        self._kv_weights: Dict[int, float] = {}
         # Distinct compiled programs minted per stage (prefill buckets,
         # chunk (bucket, window) pairs, writers, decode widths) — the
         # compile-churn surface ISSUE 6 bounds: logged on growth and
@@ -451,6 +466,10 @@ class ContinuousBatchingEngine:
         if key in seen:
             return
         seen.add(key)
+        # Stitch the compile onto the profiler timeline: a mid-serve
+        # trace stalls every active slot, and the tick record it lands
+        # next to shows exactly which tick paid for it.
+        self.profiler.event("compile", stage=stage, key=str(key))
         logger.info(
             "tier %s: compiling %s program %r (%d %s programs so far)",
             self.tier.name, stage, key, len(seen), stage)
@@ -632,6 +651,10 @@ class ContinuousBatchingEngine:
         self._tables[ix] = row
         self._tables_dev = None
         self._tables_dev_w.clear()
+        # Any row change can mean a refcount change for some slot's
+        # shared blocks (a sharer joined or left): recompute weights
+        # lazily at the next attribution pass.
+        self._kv_weights.clear()
 
     def _alloc_evicting(self, n_blocks: int) -> Optional[List[int]]:
         """Allocate, evicting parked prefix entries (LRU) under pressure:
@@ -800,9 +823,10 @@ class ContinuousBatchingEngine:
                     # One compiled program for every (src, dst) pair —
                     # priv[0] is the boundary position's table row
                     # (need > n_full always: the suffix has >= 1 token).
-                    self.pool = self._cow_copy_fn()(
-                        self.pool, jnp.asarray(boundary_src, jnp.int32),
-                        jnp.asarray(priv[0], jnp.int32))
+                    with self.profiler.phase("cow_copy"):
+                        self.pool = self._cow_copy_fn()(
+                            self.pool, jnp.asarray(boundary_src, jnp.int32),
+                            jnp.asarray(priv[0], jnp.int32))
                 row = self._table_row(owned)
                 tokens = np.full((1, sb), self.tokenizer.pad_id, np.int32)
                 tokens[0, :len(suffix)] = suffix
@@ -810,13 +834,16 @@ class ContinuousBatchingEngine:
                               if w >= m + sb)
                 with obs_spans.span(req.trace, "prefill", reused_tokens=m,
                                     suffix_bucket=sb), \
-                        self.phases.phase("prefill"):
+                        self.phases.phase("prefill"), \
+                        self.profiler.phase("prefill"):
                     first, self.pool = self._chunk_prefill_fn(sb, window)(
                         self.params, self.pool, jnp.asarray(tokens),
                         jnp.asarray([m], np.int32), jnp.asarray([n], np.int32),
                         jnp.asarray(row), rng, jnp.float32(temp))
                     # dllm-lint: disable=transfer-host-sync -- sanctioned: the FIRST token must reach the host NOW (TTFT is the SLO and the value seeds the slot) — one sync per admission, never per tick
                     first = int(jax.block_until_ready(first))
+                self.profiler.event("host_sync",
+                                    site="prefill_first_token")
                 self.phases.add_work("prefill", **roofline.prefill_work(
                     self.cfg, window, window - sb, wbytes=self._wbytes))
             except BaseException:
@@ -847,7 +874,8 @@ class ContinuousBatchingEngine:
                 tokens[0, :n] = ids
 
                 with obs_spans.span(req.trace, "prefill", bucket=bucket), \
-                        self.phases.phase("prefill"):
+                        self.phases.phase("prefill"), \
+                        self.profiler.phase("prefill"):
                     first, k_all, v_all = self._prefill_fn(bucket)(
                         self.params, jnp.asarray(tokens),
                         jnp.asarray([n], np.int32), rng, jnp.float32(temp))
@@ -859,6 +887,8 @@ class ContinuousBatchingEngine:
                         k_all, v_all)
                     # dllm-lint: disable=transfer-host-sync -- sanctioned: the FIRST token must reach the host NOW (TTFT is the SLO and the value seeds the slot) — one sync per admission, never per tick
                     first = int(jax.block_until_ready(first))
+                self.profiler.event("host_sync",
+                                    site="prefill_first_token")
                 self.phases.add_work("prefill", **roofline.prefill_work(
                     self.cfg, bucket, 0, wbytes=self._wbytes))
             except BaseException:
@@ -941,7 +971,8 @@ class ContinuousBatchingEngine:
             tokens[0, :len(seq)] = seq
             with obs_spans.span(req.trace, "prefill", bucket=bucket,
                                 replayed_tokens=len(gen)), \
-                    self.phases.phase("prefill"):
+                    self.phases.phase("prefill"), \
+                    self.profiler.phase("prefill"):
                 first, k_all, v_all = self._prefill_fn(bucket)(
                     self.params, jnp.asarray(tokens),
                     jnp.asarray([len(seq)], np.int32), rng,
@@ -1059,7 +1090,8 @@ class ContinuousBatchingEngine:
                 with obs_spans.span(req.trace, "prefill_chunk",
                                     start=start, tokens=k,
                                     window=window), \
-                        self.phases.phase("prefill"):
+                        self.phases.phase("prefill"), \
+                        self.profiler.phase("chunk_prefill"):
                     first, self.pool = self._chunk_prefill_fn(c, window)(
                         self.params, self.pool, jnp.asarray(tokens),
                         jnp.asarray([start], np.int32),
@@ -1317,7 +1349,13 @@ class ContinuousBatchingEngine:
                 if req is None:
                     break
                 try:
-                    if not self._admit(req, ix):
+                    # The admission phase covers tokenize + slot/block
+                    # bookkeeping; the prefill/COW device calls inside
+                    # stamp their own (nested) phases, so self-times
+                    # stay disjoint.
+                    with self.profiler.phase("admit"):
+                        admitted = self._admit(req, ix)
+                    if not admitted:
                         # No KV blocks yet: back to the scheduler HEAD so
                         # the starved elder re-admits before newer work.
                         self._head.appendleft(req)
@@ -1351,16 +1389,28 @@ class ContinuousBatchingEngine:
                     # the allocator, and hot-spinning on it would peg
                     # the scheduler core (the serving kv-admission gate
                     # rejects permanently-oversized prompts upstream).
-                    if not self._advance_prefill():
+                    progressed = self._advance_prefill()
+                    # Commit BEFORE any idle wait: the 50 ms backoff is
+                    # not tick work, and folding it into the record's
+                    # wall would collapse the coverage metric exactly
+                    # when pool pressure makes the timeline interesting.
+                    self.profiler.commit(0)
+                    if not progressed:
                         self._wake.wait(timeout=0.05)
                         self._wake.clear()
                     self._progress_t = time.monotonic()
                 elif not admitted_any:
                     # Idle is trivially "progressing": the watchdog only
-                    # measures staleness while work is pending.
+                    # measures staleness while work is pending.  Commit
+                    # any stamped work (a failed KV-pressure admission)
+                    # before sleeping, for the same coverage reason.
                     self._progress_t = time.monotonic()
+                    self.profiler.commit(0)
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
+                else:
+                    # Admitted-and-already-finished pass: no wait ran.
+                    self.profiler.commit(0)
                 continue
 
             try:
@@ -1373,7 +1423,8 @@ class ContinuousBatchingEngine:
                     # upload is cached until a table row changes.
                     wb = self.paged.blocks_per_slot
                     if self._tables_dev is None:
-                        self._tables_dev = jnp.asarray(self._tables)
+                        with self.profiler.phase("table_upload"):
+                            self._tables_dev = jnp.asarray(self._tables)
                     tables_arg = self._tables_dev
                 else:
                     # Dense windowed tick: bound the per-step pool gather
@@ -1389,12 +1440,14 @@ class ContinuousBatchingEngine:
                     if tables_arg is None:
                         # One upload per (table-change, rung), not one
                         # per tick — same policy as the ragged cache.
-                        # dllm-lint: disable=retrace-dynamic-shape -- bounded by design: wb only takes values from the validated bucket ladder, so this is the dense rung-ladder program family PR 6 documents (ragged mode removes it); the cache above bounds the UPLOADS to one per table change
-                        tables_arg = jnp.asarray(self._tables[:, :wb])
+                        with self.profiler.phase("table_upload"):
+                            # dllm-lint: disable=retrace-dynamic-shape -- bounded by design: wb only takes values from the validated bucket ladder, so this is the dense rung-ladder program family PR 6 documents (ragged mode removes it); the cache above bounds the UPLOADS to one per table change
+                            tables_arg = jnp.asarray(self._tables[:, :wb])
                         self._tables_dev_w[wb] = tables_arg
                 self._note_compile("decode", wb)
                 t_tick = time.perf_counter()
-                with self.phases.phase("decode"):
+                with self.phases.phase("decode"), \
+                        self.profiler.phase("decode"):
                     toks, self.pool = self._decode_step()(
                         self.params, self.pool, tables_arg,
                         jnp.asarray(self._pos), jnp.asarray(self._cur),
@@ -1410,6 +1463,30 @@ class ContinuousBatchingEngine:
                         if self.ragged
                         else ("paged_decode_q8" if q8 else "paged_decode"))
                 self.tick_ms.append(tick_ms)
+                if self.profiler.enabled:
+                    # Per-request cost attribution (ISSUE 11): the
+                    # tick's device time divides evenly across the slots
+                    # it served (one fused call decodes them together —
+                    # an even split is the honest division of a shared
+                    # program), and each slot bills blocks-held × 1 tick
+                    # of KV residency, shared prefix blocks at
+                    # 1/refcount each (PR 10's dedup lowers the bill).
+                    # Sums are conserved by construction: per tick the
+                    # shares add back up to tick_ms (tests pin 5%).
+                    share = tick_ms / len(active)
+                    for ix in active:
+                        slot = self._slots[ix]
+                        trace = slot.request.trace
+                        if trace is None:
+                            continue     # direct engine use: unbilled
+                        kv_ticks = self._kv_weights.get(ix)
+                        if kv_ticks is None:
+                            kv_ticks = 0.0
+                            for r in self.allocator.refcounts(
+                                    slot.blocks):
+                                kv_ticks += 1.0 / (r if r > 0 else 1)
+                            self._kv_weights[ix] = kv_ticks
+                        obs_spans.charge(trace, share, kv_ticks)
                 try:
                     # No injection path on the engine (same pattern as
                     # the preemption counter): the process-global
@@ -1443,38 +1520,44 @@ class ContinuousBatchingEngine:
                 # in-flight requests and keep serving new ones.
                 for ix in active:
                     self._fail_slot(ix, exc)
+                self.profiler.commit(len(active))
                 continue
 
-            for t in range(toks.shape[0]):
-                for ix in active:
-                    slot = self._slots[ix]
-                    if slot is None:
-                        continue             # finished at an earlier t
-                    tok = int(toks[t, ix])
-                    slot.tokens.append(tok)
-                    # Tick-granular decode timeline: a tick's T tokens
-                    # stamp together because that is when they become
-                    # observable (one device call per tick).  One list
-                    # append per token — no span objects on this path.
-                    obs_spans.add_token(slot.request.trace)
-                    if slot.request.token_queue is not None:
-                        slot.request.token_queue.put(tok)
-                    self._pos[ix] += 1
-                    self._cur[ix] = tok
-                    hit_cap = len(slot.tokens) >= slot.budget
-                    # PAD ends generation like EOS: trim_at_eos truncates
-                    # the result there, so streaming past it would diverge.
-                    hit_end = (tok in (self.tokenizer.eos_id,
-                                       self.tokenizer.pad_id)
-                               or self._pos[ix] >= self.cfg.max_seq_len - 1)
-                    if hit_cap or hit_end:
-                        self._finish(ix)
+            with self.profiler.phase("emit"):
+                for t in range(toks.shape[0]):
+                    for ix in active:
+                        slot = self._slots[ix]
+                        if slot is None:
+                            continue         # finished at an earlier t
+                        tok = int(toks[t, ix])
+                        slot.tokens.append(tok)
+                        # Tick-granular decode timeline: a tick's T
+                        # tokens stamp together because that is when
+                        # they become observable (one device call per
+                        # tick).  One list append per token — no span
+                        # objects on this path.
+                        obs_spans.add_token(slot.request.trace)
+                        if slot.request.token_queue is not None:
+                            slot.request.token_queue.put(tok)
+                        self._pos[ix] += 1
+                        self._cur[ix] = tok
+                        hit_cap = len(slot.tokens) >= slot.budget
+                        # PAD ends generation like EOS: trim_at_eos
+                        # truncates the result there, so streaming past
+                        # it would diverge.
+                        hit_end = (tok in (self.tokenizer.eos_id,
+                                           self.tokenizer.pad_id)
+                                   or self._pos[ix]
+                                   >= self.cfg.max_seq_len - 1)
+                        if hit_cap or hit_end:
+                            self._finish(ix)
             if self._prefill is not None:
                 # Decode slots served: spend the tick's prefill budget —
                 # the interleave that bounds active streams' TBT by one
                 # chunk grant instead of one whole prompt.
                 self._advance_prefill()
             self._progress_t = time.monotonic()  # tick completed
+            self.profiler.commit(len(active))
 
     # -- public surface (InferenceEngine parity) ---------------------------
 
@@ -1686,15 +1769,23 @@ class ContinuousBatchingEngine:
         ticks: List[float] = []
         for _ in range(3):
             try:
-                ticks = sorted(self.tick_ms)
+                ticks = list(self.tick_ms)
                 break
             except RuntimeError:
                 continue
         if not ticks:
             return {"n": 0, "p50_ms": None, "p95_ms": None}
+        # ONE snapshot, ONE sort, reused for every quantile: this runs
+        # at the sampler's 4 Hz per tier, and nearest_rank's internal
+        # sort per quantile re-sorted the whole 512-entry ring twice
+        # per collect on top of the snapshot sort (the ISSUE 11 small
+        # fix) — the <1 ms/sample budget has to survive rings and tier
+        # counts growing.
+        ticks.sort()
 
         def pct(q: float) -> float:
-            return round(obs_metrics.nearest_rank(ticks, q), 3)
+            return round(obs_metrics.nearest_rank(ticks, q,
+                                                  presorted=True), 3)
 
         return {"n": len(ticks), "p50_ms": pct(0.5), "p95_ms": pct(0.95)}
 

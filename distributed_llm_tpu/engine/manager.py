@@ -334,6 +334,17 @@ class EngineManager:
             else:
                 with self._wedged_lock:
                     self._wedged_seen = False
+        # Tick-forensics sideband (ISSUE 11): whether the engine's
+        # profiler is live, how many ticks it has recorded, and the
+        # recent phase-coverage fraction — GET /stats (which embeds
+        # health()) shows at a glance whether /debug/trace will have
+        # anything to say.  Advisory GIL-safe ring reads, no locks.
+        prof = getattr(engine, "profiler", None)
+        if prof is not None and getattr(prof, "enabled", False):
+            try:
+                entry["profile"] = prof.summary()
+            except Exception:
+                pass
         admission = getattr(self, "admission", None)
         if admission is not None:
             adm = admission.snapshot()

@@ -12,6 +12,9 @@ AST per file and ONE whole-project call graph per run
 - ``config_drift``      DLLM_* env vars + config fields vs the registry
 - ``span_discipline``   span enter/exit pairing (PR 3)
 - ``obs_discipline``    the SLO monitor's single-feed-site contract
+- ``profiler_discipline``  no tick-profiler stamps inside the traced
+                        closure (they'd bake a trace-time constant
+                        into the compiled program)
 - ``retrace``           compile-churn hazards at jit/pallas roots — the
                         static half of PR 6's one-decode-program pin
 - ``transfer``          host↔device sync/round-trip discipline on

@@ -15,7 +15,8 @@ from .config_drift import ConfigDriftChecker
 from .error_shape import ErrorShapeChecker
 from .jit_purity import JitPurityChecker
 from .locks import LockChecker
-from .obs_discipline import ObsDisciplineChecker
+from .obs_discipline import (ObsDisciplineChecker,
+                             ProfilerDisciplineChecker)
 from .retrace import RetraceChecker
 from .span_discipline import SpanDisciplineChecker
 from .thread_lifecycle import ThreadLifecycleChecker
@@ -30,6 +31,7 @@ def all_checkers() -> List[Checker]:
         ConfigDriftChecker(),
         SpanDisciplineChecker(),
         ObsDisciplineChecker(),
+        ProfilerDisciplineChecker(),
         RetraceChecker(),
         TransferChecker(),
         ThreadLifecycleChecker(),

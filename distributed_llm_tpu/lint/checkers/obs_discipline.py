@@ -1,31 +1,52 @@
-"""Observability-feed discipline: the SLO monitor has ONE feed site.
+"""Observability-feed discipline: the SLO monitor has ONE feed site,
+and profiler stamps never run at trace time.
 
-``SLOMonitor.record_request`` (obs/slo.py) counts a finished request
-into the sliding goodput windows.  Its correctness contract is
-exactly-once-per-request, which the serving stack gets structurally by
-feeding it ONLY from ``Router._finish_request`` — the single exit that
-already runs exactly once on every path of both pipelines (sync,
-stream, exception).  A second feed site anywhere in serving/ or
-engine/ would double-count requests, halve every goodput reading, and
-fire phantom overload incidents — and nothing at runtime would look
-obviously wrong.
+Rule ``slo-feed-outside-finish``: ``SLOMonitor.record_request``
+(obs/slo.py) counts a finished request into the sliding goodput
+windows.  Its correctness contract is exactly-once-per-request, which
+the serving stack gets structurally by feeding it ONLY from
+``Router._finish_request`` — the single exit that already runs exactly
+once on every path of both pipelines (sync, stream, exception).  A
+second feed site anywhere in serving/ or engine/ would double-count
+requests, halve every goodput reading, and fire phantom overload
+incidents — and nothing at runtime would look obviously wrong.
+Matching is receiver-chain-based (the chain must contain a ``slo``
+segment), so an unrelated object's ``record_request`` method does not
+false-positive.
 
-Rule ``slo-feed-outside-finish``: any call ``<...>.slo.record_request(...)``
-(or bare ``slo.record_request(...)``) in the instrumented layers must
-appear inside a function named ``_finish_request``.  Matching is
-receiver-chain-based (the chain must contain a ``slo`` segment), so an
-unrelated object's ``record_request`` method does not false-positive.
+Rule ``profiler-hook-in-traced-code`` (ISSUE 11): the tick-phase
+profiler (obs/profiler.py) stamps ``perf_counter`` around device-call
+seams ON THE HOST.  A profiler call inside a jit/pjit/shard_map/
+pallas-traced function runs at TRACE time: it bakes one stamp-time
+constant into the compiled program, measures nothing on any subsequent
+execution, and silently skews every phase table built from it.  Any
+call on a receiver chain containing a ``profiler``/``prof`` segment is
+flagged when the enclosing function is in the PROJECT-WIDE traced
+closure (lint/symbols.py ``traced_closure`` — the same set the retrace
+checker reasons over), anywhere in the repo, not just the serving
+scope.  Deliberate limits, same conservatism as the call graph: a
+profiler object reached through a differently-named local
+(``p = self.profiler; p.phase(...)``) is not matched — the repo idiom
+is always the attribute chain — and only functions the closure can
+prove traced are checked, so the rule adds no false findings.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import List, Optional
+from typing import Iterable, List, Optional
 
 from ..core import Checker, Finding, Project
+from ..symbols import project_symbols
 
 FEED_ATTR = "record_request"
 ALLOWED_FUNC = "_finish_request"
+
+# Receiver-chain segments that mark a tick-profiler stamp.  "prof" is
+# included for the conventional local name in helper signatures
+# (obs/profiler.py's own docs use it); anything else is a deliberate
+# limit documented above.
+PROFILER_SEGMENTS = {"profiler", "prof"}
 
 
 def _chain(node: ast.expr) -> List[str]:
@@ -47,7 +68,36 @@ def _is_slo_feed(call: ast.Call) -> bool:
     return "slo" in _chain(fn.value)
 
 
+def _is_profiler_call(call: ast.Call) -> bool:
+    fn = call.func
+    if not isinstance(fn, ast.Attribute):
+        return False
+    return bool(PROFILER_SEGMENTS & set(_chain(fn.value)))
+
+
+def _own_nodes(node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body WITHOUT descending into nested def/async
+    def (each is its own call-graph function and, when traced, its own
+    closure member — descending would double-report).  Lambdas ARE
+    walked: they are not separate graph nodes, so this is their only
+    chance to be seen."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
 class ObsDisciplineChecker(Checker):
+    """The per-file rule (``slo-feed-outside-finish``): its verdict
+    depends only on the file a finding lands in, so ``--changed`` may
+    filter it to changed files.  The traced-closure profiler rule lives
+    in its own whole-project checker below — folding it in here would
+    widen THIS rule's reporting too and break the changed-files-only
+    contract."""
+
     name = "obs_discipline"
     rules = ("slo-feed-outside-finish",)
     scope = ("distributed_llm_tpu/serving", "distributed_llm_tpu/engine")
@@ -59,6 +109,8 @@ class ObsDisciplineChecker(Checker):
                 continue
             self._visit(mod.tree, None, mod.relpath, findings)
         return findings
+
+    # -- slo-feed-outside-finish -------------------------------------------
 
     def _visit(self, node: ast.AST, func: Optional[str], path: str,
                findings: List[Finding]) -> None:
@@ -80,3 +132,38 @@ class ObsDisciplineChecker(Checker):
                     f"requests exactly once, on the router's single "
                     f"completion exit; a second feed site double-counts"))
             self._visit(child, child_func, path, findings)
+
+
+class ProfilerDisciplineChecker(Checker):
+    """``profiler-hook-in-traced-code``, as its own checker: the traced
+    closure crosses modules (a jit root in engine/ can reach a helper
+    in ops/), so an edit in one file can create a finding in another —
+    ``whole_project`` widens it under ``--changed``.  Kept separate
+    from ObsDisciplineChecker so that widening does not leak onto the
+    per-file slo-feed rule."""
+
+    name = "profiler_discipline"
+    rules = ("profiler-hook-in-traced-code",)
+    scope = ("distributed_llm_tpu",)
+    whole_project = True
+
+    def check(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        ps = project_symbols(project)
+        traced = ps.traced_closure()
+        for gid in sorted(traced):
+            gf = ps.functions.get(gid)
+            if gf is None:
+                continue
+            for node in _own_nodes(gf.info.node):
+                if isinstance(node, ast.Call) and _is_profiler_call(node):
+                    findings.append(Finding(
+                        "profiler-hook-in-traced-code", gf.relpath,
+                        node.lineno,
+                        f"profiler stamp inside traced code "
+                        f"(`{gf.qualname}` is jit/pallas-reachable): "
+                        f"perf_counter runs once at TRACE time and "
+                        f"bakes a constant into the compiled program — "
+                        f"stamp around the device call on the host "
+                        f"side instead"))
+        return findings

@@ -1,4 +1,4 @@
-"""Span-discipline checker — migrated from scripts/check_span_discipline.py.
+"""Span-discipline checker — every span enter has a structural exit.
 
 Every span ENTER must have a matching EXIT on every return/raise path.
 obs/spans.py makes that structural — spans are context managers — so the
@@ -14,9 +14,11 @@ instrumented layers (serving/, engine/):
   itself; long-lived work that cannot be ``with``-scoped uses the token
   timeline / completion-callback pattern instead (obs/spans.py).
 
-``check_source`` / ``check_tree`` keep the original script's string-list
-API so scripts/check_span_discipline.py stays a thin back-compat shim
-(tests/test_obs.py drives exactly that surface).
+``check_source`` / ``check_tree`` keep the original standalone script's
+string-list API (tests/test_obs.py drives exactly that surface; the
+``scripts/check_span_discipline.py`` delegation shim it once backed was
+removed in ISSUE 11 — ``python -m distributed_llm_tpu.lint`` is the one
+CLI).
 """
 
 from __future__ import annotations
@@ -75,7 +77,7 @@ class SpanDisciplineChecker(Checker):
         return findings
 
 
-# -- legacy string-list API (scripts/check_span_discipline.py shim) ----------
+# -- legacy string-list API (tests/test_obs.py's back-compat pin) ------------
 
 def check_source(src: str, path: str = "<string>") -> List[str]:
     """Violation strings for one module's source (empty = clean).

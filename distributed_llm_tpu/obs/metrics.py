@@ -39,17 +39,25 @@ DEFAULT_BUCKETS_MS: Tuple[float, ...] = (
     1000, 2000, 5000, 10000, 20000, 60000, 120000)
 
 
-def nearest_rank(values: Iterable[float], q: float) -> Optional[float]:
+def nearest_rank(values: Iterable[float], q: float,
+                 presorted: bool = False) -> Optional[float]:
     """Nearest-rank percentile over raw samples — rank
     ``round(q * (n - 1))`` of the sorted values, None when empty.  The
     ONE rank rule shared by the decode tick ring
     (``ContinuousBatchingEngine.tick_stats``), the per-request TBT
-    cadence criterion (``RequestTrace.tbt_p95_ms``) and the open-loop
-    bench leg, so "p95" means the same thing in the sampler gauges, the
-    SLO verdicts, and the bench artifact.  (Histogram.quantile is the
-    OTHER estimator — bucket interpolation over the log ladder — used
-    where raw samples are not retained.)"""
-    vs = sorted(values)
+    cadence criterion (``RequestTrace.tbt_p95_ms``), the tick-phase
+    profiler (obs/profiler.py) and the open-loop bench leg, so "p95"
+    means the same thing in the sampler gauges, the SLO verdicts, and
+    the bench artifact.  (Histogram.quantile is the OTHER estimator —
+    bucket interpolation over the log ladder — used where raw samples
+    are not retained.)
+
+    ``presorted=True`` skips the sort for callers that already hold a
+    sorted list and read several quantiles from it (tick_stats runs on
+    the 4 Hz sampler path per tier — sorting the 512-entry ring once
+    per quantile per collect was the ISSUE 11 small fix).  ``values``
+    must then be an indexable sorted sequence."""
+    vs = values if presorted else sorted(values)
     if not vs:
         return None
     ix = min(len(vs) - 1, int(q * (len(vs) - 1) + 0.5))
@@ -453,6 +461,33 @@ class ServingMetrics:
             "Rising-edge overload incidents (tier goodput under the "
             "floor); each lands in the flight recorder with a timeline "
             "slice", ("tier",))
+        # Tick-forensics family (ISSUE 11, obs/profiler.py): per-request
+        # device-time / KV-residency attribution aggregated at the
+        # router's exactly-once completion exit, plus sampled per-phase
+        # tick breakdown gauges — the accounting substrate per-tenant
+        # quotas and goodput-per-replica-second economics bill against.
+        self.device_time = registry.counter(
+            "dllm_device_time_ms_total",
+            "Attributed decode device time (each tick's device ms "
+            "divided across the slots it served), per serving tier, "
+            "strategy and session ('-' = sessionless)",
+            ("tier", "strategy", "session"))
+        self.kv_block_ticks = registry.counter(
+            "dllm_kv_block_ticks_total",
+            "Attributed KV residency: pool blocks held x decode ticks, "
+            "shared prefix blocks charged 1/refcount to each holder",
+            ("tier", "strategy", "session"))
+        self.tick_phase_p50_g = registry.gauge(
+            "dllm_tick_phase_p50_ms",
+            "p50 per-tick SELF time of one scheduler phase (admit|"
+            "prefill|cow_copy|table_upload|decode|emit|chunk_prefill) "
+            "over the profiler ring's recent tail (sampled)",
+            ("tier", "phase"))
+        self.profile_coverage_g = registry.gauge(
+            "dllm_profile_coverage",
+            "Fraction of tick wall time covered by stamped phase self-"
+            "times (sampled; the bench profile leg pins >= 0.95)",
+            ("tier",))
 
 
 _BREAKER_STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}

@@ -60,6 +60,7 @@ _GAUGE_FIELDS = (
     ("prefill_backlog_tokens", "prefill_backlog_g"),
     ("draining", "tier_draining_g"),
     ("decode_tick_p50_ms", "decode_tick_p50_g"),
+    ("profile_coverage", "profile_coverage_g"),
 )
 
 
@@ -161,6 +162,20 @@ class SystemStateSampler:
                     getattr(m, attr).labels(name).set(float(val))
                 except Exception:
                     pass
+            # Tick-phase breakdown (ISSUE 11): the collect callback
+            # hands a {phase: p50_self_ms} dict; each phase is its own
+            # gauge child so dashboards plot the tick's composition as
+            # stacked series.
+            phases = st.get("tick_phases")
+            if isinstance(phases, dict):
+                for phase, val in phases.items():
+                    if val is None:
+                        continue
+                    try:
+                        m.tick_phase_p50_g.labels(name, phase).set(
+                            float(val))
+                    except Exception:
+                        pass
 
     # -- read --------------------------------------------------------------
 

@@ -36,8 +36,8 @@ re-binds it there (serving/tiers.py worker threads) or attaches it to
 the work item (engine/batching.py ``_Request.trace``).
 
 Span-exit discipline: spans are context managers and are ONLY entered
-via ``with`` (enforced statically over serving/ and engine/ by
-scripts/check_span_discipline.py, which runs in tier-1) — so every
+via ``with`` (enforced statically over serving/ and engine/ by the
+``span_discipline`` lint checker, which runs in tier-1) — so every
 enter has a matching exit on every return/raise path by construction.
 The two request-lifetime spans that cannot be ``with``-scoped (a
 stream's decode outlives the function that opened it) are therefore
@@ -159,7 +159,7 @@ class RequestTrace:
     rather than contribute consumer-paced values."""
 
     __slots__ = ("root", "request_id", "attrs", "token_times", "_lock",
-                 "_t_wall")
+                 "_t_wall", "device_time_ms", "kv_block_ticks")
 
     def __init__(self, name: str = "request", **attrs: Any):
         self._lock = threading.Lock()
@@ -167,6 +167,14 @@ class RequestTrace:
         self.attrs: Dict[str, Any] = dict(attrs)
         self.token_times: List[float] = []
         self._t_wall = time.time()
+        # Per-request cost attribution (ISSUE 11, obs/profiler.py): the
+        # batched engine charges each decode tick's device time evenly
+        # across the slots it served, and blocks-held × ticks (shared
+        # prefix blocks at 1/refcount each).  Single-writer (the
+        # scheduler thread) float accumulators — plain adds, GIL-safe,
+        # read at the router's exactly-once completion exit.
+        self.device_time_ms: float = 0.0
+        self.kv_block_ticks: float = 0.0
         self.root = Span(name, self)
 
     # -- producers ---------------------------------------------------------
@@ -254,6 +262,13 @@ class RequestTrace:
         tbt = self.tbt_ms()
         if tbt is not None:
             out["tbt_ms"] = round(tbt, 3)
+        # Cost attribution rides every serialized trace (so flight-
+        # recorder entries carry who-paid-what), but only once the
+        # engine actually charged something — sequential engines and
+        # DLLM_PROFILE=0 runs keep their historical shape.
+        if self.device_time_ms or self.kv_block_ticks:
+            out["device_time_ms"] = round(self.device_time_ms, 3)
+            out["kv_block_ticks"] = round(self.kv_block_ticks, 3)
         return out
 
 
@@ -306,6 +321,17 @@ def annotate(trace: Optional[RequestTrace], **attrs: Any) -> None:
 def add_token(trace: Optional[RequestTrace]) -> None:
     if trace is not None:
         trace.token_times.append(time.perf_counter())
+
+
+def charge(trace: Optional[RequestTrace], device_ms: float,
+           kv_block_ticks: float = 0.0) -> None:
+    """Accumulate one tick's attributed cost onto a request (no-op when
+    trace is None — direct engine use stays uninstrumented).  Called
+    once per active slot per decode tick from the scheduler thread;
+    plain float adds, no lock (single writer, GIL-atomic)."""
+    if trace is not None:
+        trace.device_time_ms += device_ms
+        trace.kv_block_ticks += kv_block_ticks
 
 
 # =============================================================================

@@ -74,7 +74,8 @@ def create_app(router: Optional[Router] = None,
             return err
 
         try:
-            response_data, tokens, device = state["router"].route_query(snapshot)
+            response_data, tokens, device = state["router"].route_query(
+                snapshot, session_id=session_id)
 
             if isinstance(response_data, dict):
                 reply = response_data.get("response", "")
@@ -214,7 +215,8 @@ def create_app(router: Optional[Router] = None,
             return err
 
         try:
-            routed = state["router"].route_query_stream(snapshot)
+            routed = state["router"].route_query_stream(
+                snapshot, session_id=session_id)
         except Exception as exc:
             logger.exception("stream routing failed")
             _rollback_user_turn(history, turn)
@@ -307,6 +309,19 @@ def create_app(router: Optional[Router] = None,
         return static_response(
             body, "text/plain; version=0.0.4; charset=utf-8")
 
+    @app.route("/debug/trace", methods=["GET"])
+    def debug_trace():
+        """Chrome-trace/Perfetto JSON of every live engine's tick-phase
+        profiler ring (obs/profiler.py): ticks as slices, phases as
+        nested child slices with self-times, compile/host-sync instants
+        stitched in.  Load it in chrome://tracing or ui.perfetto.dev —
+        the "why did that tick cost 40 ms" surface.  Empty traceEvents
+        when no profiler is live (DLLM_PROFILE=0, sequential tiers)."""
+        router_ = state["router"]
+        fn = getattr(router_, "profiler_trace", None)
+        body = fn() if callable(fn) else {"traceEvents": []}
+        return jsonify(body)
+
     @app.route("/stats", methods=["GET"])
     def stats():
         """Observability snapshot (SURVEY.md §5.5): routing-cache health,
@@ -387,6 +402,13 @@ def create_app(router: Optional[Router] = None,
             "slo": (router_.slo.snapshot()
                     if getattr(router_, "slo", None) is not None
                     else None),
+            # Per-(tier, strategy, session) attributed cost (ISSUE 11):
+            # decode device time + KV block-ticks from the bounded
+            # ledger _finish_request feeds — who pays for the ticks,
+            # in one call.
+            "cost": (router_.cost_snapshot()
+                     if callable(getattr(router_, "cost_snapshot", None))
+                     else None),
         }
         if request.args.get("timeline") == "1":
             # The system-state timeline ring (obs/sampler.py): per-tier

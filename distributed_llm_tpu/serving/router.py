@@ -179,6 +179,27 @@ class Router:
                 period_s=sample_ms / 1000.0,
                 capacity=env_int("DLLM_OBS_TIMELINE_SAMPLES", 240))
 
+        # Bounded per-(tier, strategy, session) cost ledger (ISSUE 11):
+        # the GET /stats-inspectable aggregate of the attribution the
+        # _finish_request exit feeds to the dllm_device_time_ms_total /
+        # dllm_kv_block_ticks_total families.  Insertion-ordered with
+        # oldest-key eviction past the cap, so a session flood cannot
+        # grow it without bound (the metric families keep the full
+        # label space; this is the one-call operator view).
+        self._cost_lock = threading.Lock()
+        self._cost_ledger: "Dict[Tuple[str, str, str], Dict[str, float]]" \
+            = {}
+        self._cost_ledger_cap = 256
+        # Session METRIC-LABEL guard: session_id is client-controlled
+        # at the /chat edge, and a Prometheus label value mints a
+        # permanent counter child — without a bound, one adversarial
+        # client (or just organic session churn) grows the registry and
+        # the /metrics payload forever.  First N distinct sessions keep
+        # their own label (truncated); the rest aggregate under
+        # "~overflow".  The ledger evicts; label children cannot.
+        self._session_labels: set = set()
+        self._session_label_cap = 256
+
         self.enable_response_cache = (
             not benchmark_mode
             and bool(self.config.get("enable_response_cache", False)))
@@ -391,6 +412,20 @@ class Router:
                     st["decode_tick_p50_ms"] = tick_fn().get("p50_ms")
                 except Exception:
                     pass
+            # Tick-phase profiler (ISSUE 11): per-phase p50 self-times
+            # over the ring's recent tail + the coverage fraction —
+            # advisory ring reads, bounded to the last 128 records so
+            # the sampler's <1 ms budget holds as rings grow.
+            prof = getattr(engine, "profiler", None)
+            if prof is not None and getattr(prof, "enabled", False):
+                try:
+                    ps = prof.phase_stats(last=128)
+                    st["tick_phases"] = {
+                        name: s.get("p50_ms")
+                        for name, s in ps["phases"].items()}
+                    st["profile_coverage"] = ps.get("coverage")
+                except Exception:
+                    pass
             st["draining"] = bool(getattr(tier.server_manager, "draining",
                                           False))
             b = breaker_snap.get(name)
@@ -398,6 +433,75 @@ class Router:
                 st["breaker"] = b.get("state")
             out[name] = st
         return out
+
+    def _session_label(self, raw: Any) -> str:
+        """The bounded metric-label form of a client session id: '-'
+        when absent, truncated to 64 chars, and capped at
+        ``_session_label_cap`` DISTINCT values per router — later
+        sessions aggregate under '~overflow' so a label-minting client
+        cannot grow the metric registry without bound."""
+        if not raw:
+            return "-"
+        s = str(raw)[:64]
+        with self._cost_lock:
+            if s in self._session_labels:
+                return s
+            if len(self._session_labels) < self._session_label_cap:
+                self._session_labels.add(s)
+                return s
+        return "~overflow"
+
+    def _note_cost(self, tier: str, strategy: str, session: str,
+                   device_ms: float, kv_ticks: float) -> None:
+        """Fold one finished request's attributed cost into the bounded
+        ledger (oldest key evicted past the cap — dict insertion order
+        is the age order; a re-charged key keeps its slot)."""
+        key = (tier, strategy, session)
+        with self._cost_lock:
+            entry = self._cost_ledger.get(key)
+            if entry is None:
+                while len(self._cost_ledger) >= self._cost_ledger_cap:
+                    self._cost_ledger.pop(
+                        next(iter(self._cost_ledger)))
+                entry = self._cost_ledger[key] = {
+                    "device_time_ms": 0.0, "kv_block_ticks": 0.0,
+                    "requests": 0}
+            entry["device_time_ms"] += device_ms
+            entry["kv_block_ticks"] += kv_ticks
+            entry["requests"] += 1
+
+    def cost_snapshot(self) -> List[Dict[str, Any]]:
+        """The GET /stats ``cost`` block: attributed device time and KV
+        block-ticks per (tier, strategy, session), most expensive
+        first."""
+        with self._cost_lock:
+            rows = [
+                {"tier": k[0], "strategy": k[1], "session": k[2],
+                 "device_time_ms": round(v["device_time_ms"], 3),
+                 "kv_block_ticks": round(v["kv_block_ticks"], 3),
+                 "requests": int(v["requests"])}
+                for k, v in self._cost_ledger.items()]
+        rows.sort(key=lambda r: r["device_time_ms"], reverse=True)
+        return rows
+
+    def profiler_trace(self) -> Dict[str, Any]:
+        """The GET /debug/trace body: every live engine's tick-phase
+        ring + compile/host-sync events rendered as one Chrome-trace/
+        Perfetto JSON document (obs/profiler.chrome_trace).  Advisory
+        ring snapshots — never the lifecycle lock; tiers without a
+        profiler (remote, sequential, DLLM_PROFILE=0) contribute
+        nothing."""
+        from ..obs import profiler as obs_profiler
+        by_tier: Dict[str, Dict[str, Any]] = {}
+        for name, tier in self.tiers.items():
+            engine = getattr(tier.server_manager, "_engine", None)
+            prof = getattr(engine, "profiler", None)
+            if prof is not None and getattr(prof, "enabled", False):
+                try:
+                    by_tier[name] = prof.snapshot()
+                except Exception:
+                    pass
+        return obs_profiler.chrome_trace(by_tier)
 
     def _obs_state_snapshot(self) -> Dict[str, Any]:
         """Cheap serving-state snapshot attached to flight-recorder
@@ -470,6 +574,22 @@ class Router:
         self.slo.record_request(strategy, which, ok=ok and not degraded,
                                 ttft_ms=ttft, tbt_p95_ms=tbt_p95,
                                 cache_hit=cache_hit)
+        # Per-request cost attribution (ISSUE 11): the batched engine
+        # charged decode device time + KV block-ticks onto the trace;
+        # this exactly-once exit aggregates them per (tier, strategy,
+        # session) — the metric families quotas (ROADMAP 4) and
+        # goodput-per-replica-second economics (ROADMAP 5) bill
+        # against, plus the bounded /stats cost ledger.
+        dev_ms = getattr(trace, "device_time_ms", 0.0)
+        kv_ticks = getattr(trace, "kv_block_ticks", 0.0)
+        if dev_ms or kv_ticks:
+            session = self._session_label(trace.attrs.get("session"))
+            m.device_time.labels(which or "none", strategy,
+                                 session).inc(dev_ms)
+            m.kv_block_ticks.labels(which or "none", strategy,
+                                    session).inc(kv_ticks)
+            self._note_cost(which or "none", strategy, session,
+                            dev_ms, kv_ticks)
         reason = self.obs.recorder.classify(ok, degraded, dur)
         if reason is not None:
             m.flight_records.labels(reason).inc()
@@ -921,7 +1041,8 @@ class Router:
         overhead_ms = (time.perf_counter() - t0) * 1000.0
         return device, method, confidence, reasoning, cache_hit, overhead_ms
 
-    def route_query(self, history: List[Dict[str, Any]]
+    def route_query(self, history: List[Dict[str, Any]],
+                    session_id: Optional[str] = None
                     ) -> Tuple[Dict[str, Any], int, str]:
         """Instrumented entry: creates the request's span tree (obs/),
         binds it for this thread (tiers/engines pick it up via
@@ -929,9 +1050,13 @@ class Router:
         request's metrics and — when failed/degraded/slow — its flight-
         recorder entry.  The pipeline itself is ``_route_query_inner``;
         the reference contract (return shape, error semantics) is
-        untouched."""
+        untouched.  ``session_id`` (optional, additive — the serving
+        edge passes its /chat session) keys the per-session cost
+        attribution; None aggregates under '-'."""
         self._ensure_sampler()
         trace = self.obs.trace(strategy=self.query_router.strategy)
+        if session_id:
+            trace.annotate(session=str(session_id))
         with use_trace(trace):
             try:
                 response, tokens, which = self._route_query_inner(
@@ -1103,7 +1228,8 @@ class Router:
             out["overflow_dropped_messages"] = overflow_dropped
         return out, tokens, which
 
-    def route_query_stream(self, history: List[Dict[str, Any]]
+    def route_query_stream(self, history: List[Dict[str, Any]],
+                           session_id: Optional[str] = None
                            ) -> "RoutedStream":
         """Streaming twin of ``route_query``: same decision stage
         (``_decide`` incl. the ctx-size fallback), the same circuit-
@@ -1119,6 +1245,8 @@ class Router:
         self._ensure_sampler()
         trace = self.obs.trace(strategy=self.query_router.strategy,
                                stream=True)
+        if session_id:
+            trace.annotate(session=str(session_id))
         with use_trace(trace):
             try:
                 return self._route_stream_inner(trace, history)

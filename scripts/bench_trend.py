@@ -1,0 +1,242 @@
+#!/usr/bin/env python3
+"""Cross-round bench trend table — the first tooling over BENCH_r*.json.
+
+Every driver round leaves a ``BENCH_rNN.json`` capture ({cmd, n, rc,
+tail, parsed} — ``parsed`` is the bench's compact FINAL line) and the
+current working tree usually holds a ``BENCH_partial.json`` (the
+detail dump bench.py checkpoints mid-run and rewrites with a
+``"final": true`` marker on completion).  Until now nothing compared
+rounds: a 2x regression in ``trend_req_per_s`` between r12 and r14
+would only be found by reading JSON by hand.
+
+This script prints a per-metric trend table across all rounds (oldest
+first, the finalized partial as the in-flight round), and flags
+regressions on the PINNED cross-round comparables:
+
+- ``trend_req_per_s``  (higher is better — the tiny_batched random-init
+  closed-loop rate, the one number BENCHMARKS.md designates comparable
+  across rounds),
+- ``skew_tick_ratio``  (lower is better — ragged/dense decode-tick p50;
+  crossing 1.0 means the fused kernel LOST),
+- ``openloop.knee``    (higher is better — the open-loop goodput knee).
+
+A pinned metric regresses when the newest value is worse than the
+median of the prior rounds by more than ``--threshold`` (default 25% —
+the tiny-CPU box's repeat spread is huge, see BENCHMARKS.md r11; the
+flag is a "go look", not a verdict).  Exit code: 1 when any pinned
+metric regressed, else 0 — wire-able into CI as a soft gate.
+
+Both artifact shapes are understood: the compact FINAL line (round
+captures; ``trend_req_per_s`` top-level, ``openloop.knee`` nested) and
+the full detail dump (the finalized partial; ``trend.trend_req_per_s``,
+``skew.tick_p50_ratio_ragged_over_dense``, ``openloop.knee_req_per_s``).
+A ``BENCH_partial.json`` WITHOUT the ``"final": true`` marker is a dead
+partial from an interrupted run and is skipped with a note — its
+numbers describe an unknown fraction of a round.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# (metric, higher_is_better): the regression-flagged comparables.
+PINNED: Tuple[Tuple[str, bool], ...] = (
+    ("trend_req_per_s", True),
+    ("skew_tick_ratio", False),
+    ("openloop.knee", True),
+)
+
+# Context rows printed (no flags): the headline and accuracy travel
+# with the pinned numbers so a trend break can be read in context.
+CONTEXT = ("value", "routing_accuracy", "mixed.tbt95_ratio",
+           "shared.peak_ratio", "profile.coverage")
+
+
+def _get(doc: Any, *path: str) -> Optional[Any]:
+    for key in path:
+        if not isinstance(doc, dict):
+            return None
+        doc = doc.get(key)
+    return doc if isinstance(doc, (int, float)) else None
+
+
+# Extraction: first matching path wins — compact FINAL shape first
+# (the round captures), then the detail-dump shape (finalized partial).
+_PATHS: Dict[str, Tuple[Tuple[str, ...], ...]] = {
+    "trend_req_per_s": (("trend_req_per_s",), ("trend", "median"),
+                        ("trend", "trend_req_per_s")),
+    "skew_tick_ratio": (("skew_tick_ratio",),
+                        ("skew", "tick_p50_ratio_ragged_over_dense")),
+    "openloop.knee": (("openloop", "knee"),
+                      ("openloop", "knee_req_per_s"),
+                      ("knee_req_per_s",)),
+    "value": (("value",),),
+    "routing_accuracy": (("routing_accuracy",),),
+    "mixed.tbt95_ratio": (("mixed", "tbt95_ratio"),
+                          ("mixed", "chunked", "tbt95_ratio")),
+    "shared.peak_ratio": (("shared", "peak_ratio"),),
+    "profile.coverage": (("profile", "coverage"),),
+}
+
+
+def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
+    """Pull every known metric out of one artifact (compact or detail
+    shape); missing metrics are simply absent."""
+    out: Dict[str, float] = {}
+    for name, paths in _PATHS.items():
+        for path in paths:
+            val = _get(doc, *path)
+            if val is not None:
+                out[name] = float(val)
+                break
+    return out
+
+
+def load_rounds(directory: str = ".") -> Tuple[List[Tuple[str, Dict[str,
+                                                                    float]]],
+                                               List[str]]:
+    """(ordered [(label, metrics)], notes).  Rounds come from
+    ``BENCH_r*.json`` sorted by round number; a FINALIZED
+    ``BENCH_partial.json`` appends as the in-flight round."""
+    rounds: List[Tuple[str, Dict[str, float]]] = []
+    notes: List[str] = []
+
+    def round_key(path: str) -> Tuple[int, str]:
+        m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+        return (int(m.group(1)) if m else 10**9, path)
+
+    for path in sorted(glob.glob(os.path.join(directory, "BENCH_r*.json")),
+                       key=round_key):
+        label = re.sub(r"^BENCH_|\.json$", "",
+                       os.path.basename(path))
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as exc:
+            notes.append(f"{label}: unreadable ({exc})")
+            continue
+        # Driver capture shape: the compact FINAL line is under
+        # "parsed" (None when that round's tail wasn't parseable —
+        # r02/r05 are real examples); a bare artifact is used as-is.
+        payload = doc.get("parsed") if isinstance(doc, dict) \
+            and "parsed" in doc else doc
+        if not isinstance(payload, dict):
+            notes.append(f"{label}: no parsed FINAL line — skipped")
+            continue
+        rounds.append((label, extract_metrics(payload)))
+
+    partial = os.path.join(directory, "BENCH_partial.json")
+    if os.path.exists(partial):
+        try:
+            with open(partial, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as exc:
+            notes.append(f"partial: unreadable ({exc})")
+            doc = None
+        if isinstance(doc, dict):
+            if doc.get("final") is True:
+                rounds.append(("partial", extract_metrics(doc)))
+            else:
+                notes.append("partial: no \"final\": true marker — "
+                             "interrupted run's leftovers, skipped")
+    return rounds, notes
+
+
+def flag_regressions(rounds: List[Tuple[str, Dict[str, float]]],
+                     threshold: float) -> List[str]:
+    """Pinned metrics where the NEWEST value is worse than the median
+    of the prior rounds by more than ``threshold`` (fractional)."""
+    flags: List[str] = []
+    for metric, higher_better in PINNED:
+        series = [(label, m[metric]) for label, m in rounds
+                  if metric in m]
+        if len(series) < 2:
+            continue
+        label, latest = series[-1]
+        baseline = statistics.median(v for _, v in series[:-1])
+        if baseline <= 0:
+            continue
+        ratio = latest / baseline
+        regressed = (ratio < 1.0 - threshold if higher_better
+                     else ratio > 1.0 + threshold)
+        if regressed:
+            arrow = "dropped to" if higher_better else "rose to"
+            flags.append(
+                f"REGRESSION {metric}: {label} {arrow} {latest:g} "
+                f"({ratio:.2f}x the prior-round median {baseline:g})")
+    return flags
+
+
+def trend_table(rounds: List[Tuple[str, Dict[str, float]]]) -> str:
+    """Fixed-width per-metric table, rounds as columns oldest-first."""
+    metrics = [m for m, _ in PINNED] + [m for m in CONTEXT
+                                        if any(m in r for _, r in rounds)]
+    labels = [label for label, _ in rounds]
+    name_w = max([len(m) for m in metrics] + [8])
+    col_w = max([len(lb) for lb in labels] + [8]) + 1
+    lines = [" " * name_w + "".join(lb.rjust(col_w) for lb in labels)]
+    for metric in metrics:
+        cells = []
+        for _, vals in rounds:
+            v = vals.get(metric)
+            cells.append(("-" if v is None else f"{v:g}").rjust(col_w))
+        pin = " *" if metric in {m for m, _ in PINNED} else ""
+        lines.append(metric.ljust(name_w) + "".join(cells) + pin)
+    lines.append("")
+    lines.append("(* = pinned cross-round comparable, regression-flagged)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="scripts/bench_trend.py",
+        description="per-metric trend table over BENCH_r*.json rounds "
+                    "with regression flags on the pinned comparables")
+    parser.add_argument("--dir", default=".",
+                        help="directory holding the BENCH artifacts "
+                             "(default: .)")
+    parser.add_argument("--threshold", type=float, default=0.25,
+                        help="fractional worsening vs the prior-round "
+                             "median that flags a pinned metric "
+                             "(default 0.25)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the rounds/flags as one JSON object "
+                             "instead of the table")
+    args = parser.parse_args(argv)
+
+    rounds, notes = load_rounds(args.dir)
+    if not rounds:
+        print("bench_trend: no usable BENCH_r*.json rounds found in "
+              f"{args.dir!r}", file=sys.stderr)
+        for note in notes:
+            print(f"  note: {note}", file=sys.stderr)
+        return 2
+    flags = flag_regressions(rounds, args.threshold)
+    if args.json:
+        print(json.dumps({
+            "rounds": [{"round": label, **vals} for label, vals in rounds],
+            "regressions": flags,
+            "notes": notes,
+        }, indent=2))
+    else:
+        print(trend_table(rounds))
+        for note in notes:
+            print(f"note: {note}")
+        for flag in flags:
+            print(flag)
+        if not flags:
+            print(f"no regressions on pinned metrics "
+                  f"(threshold {args.threshold:.0%}, "
+                  f"{len(rounds)} round(s))")
+    return 1 if flags else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

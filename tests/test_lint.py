@@ -830,6 +830,64 @@ def test_obs_discipline_near_miss_unrelated_record_request():
     assert _lint(ObsDisciplineChecker(), {SERVING: src}).findings == []
 
 
+def test_obs_discipline_flags_profiler_stamp_in_traced_code():
+    """ISSUE 11: a profiler stamp inside a jit-traced function runs at
+    TRACE time — it bakes one perf_counter constant into the compiled
+    program and measures nothing after.  Flagged via the project-wide
+    traced closure, whatever module it lands in."""
+    from distributed_llm_tpu.lint.checkers.obs_discipline import \
+        ProfilerDisciplineChecker
+    bad = """
+        import jax
+
+        def build(profiler):
+            def run(x):
+                profiler.event("compile", stage="decode")
+                return x
+            return jax.jit(run)
+
+        class Engine:
+            def _decode_step(self):
+                def step(params, pool):
+                    with self.profiler.phase("decode"):
+                        return params
+                return jax.jit(step)
+    """
+    result = _lint(ProfilerDisciplineChecker(), {ENGINE: bad})
+    assert _rules(result) == ["profiler-hook-in-traced-code"] * 2
+    assert all("TRACE time" in f.message for f in result.findings)
+    # Its whole_project widening must NOT ride on the per-file slo rule
+    # (they are separate checkers precisely so --changed keeps
+    # filtering slo-feed findings to changed files).
+    from distributed_llm_tpu.lint.checkers.obs_discipline import \
+        ObsDisciplineChecker
+    assert ProfilerDisciplineChecker.whole_project is True
+    assert ObsDisciplineChecker.whole_project is False
+
+
+def test_obs_discipline_near_miss_profiler_on_host_side():
+    """Precision: stamping AROUND a jitted call on the host side — the
+    exact idiom the engine uses — and a profiler call in the (untraced)
+    function that merely DEFINES a jit root must both stay silent."""
+    from distributed_llm_tpu.lint.checkers.obs_discipline import \
+        ProfilerDisciplineChecker
+    src = """
+        import jax
+
+        def tick(profiler, fn, x):
+            with profiler.phase("decode"):    # host side, around the call
+                return jax.jit(fn)(x)
+
+        class Engine:
+            def _decode_step(self):
+                def run(params):
+                    return params
+                self.profiler.event("compile", stage="decode")  # host
+                return jax.jit(run)
+    """
+    assert _lint(ProfilerDisciplineChecker(), {ENGINE: src}).findings == []
+
+
 # -- suppression machinery ---------------------------------------------------
 
 def test_suppression_with_justification_silences_finding():

@@ -359,24 +359,20 @@ def test_stats_debug_returns_flight_recorder(obs_client):
 # -- span discipline (satellite: CI static pass) -----------------------------
 
 def test_span_discipline_pass_is_clean():
-    import importlib.util
-    import os
-    spec = importlib.util.spec_from_file_location(
-        "check_span_discipline",
-        os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "scripts",
-            "check_span_discipline.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    violations = mod.check_tree()
+    # Pinned directly at the lint framework checker (the
+    # scripts/check_span_discipline.py delegation shim from PR 4 is
+    # gone — `python -m distributed_llm_tpu.lint` is the one CLI).
+    from distributed_llm_tpu.lint.checkers.span_discipline import (
+        check_source, check_tree)
+    violations = check_tree()
     assert violations == [], "\n".join(violations)
     # The checker actually catches what it claims to catch.
     bad = "def f(tr):\n    sp = tr.span('x')\n    return sp\n"
-    assert mod.check_source(bad, "bad.py")
+    assert check_source(bad, "bad.py")
     bad2 = "def f(tr):\n    tr.start_span('x')\n"
-    assert mod.check_source(bad2, "bad2.py")
+    assert check_source(bad2, "bad2.py")
     good = "def f(tr):\n    with tr.span('x') as sp:\n        pass\n"
-    assert mod.check_source(good, "good.py") == []
+    assert check_source(good, "good.py") == []
 
 
 # -- overhead budget ---------------------------------------------------------
