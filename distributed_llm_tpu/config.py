@@ -455,6 +455,40 @@ class TierConfig:
     # rung) legitimately stalls the loop for tens of seconds on chip.
     # None disables the watchdog.
     watchdog_stall_s: Optional[float] = 300.0
+    # Replicated tiers (serving/replicas.py, ISSUE 12): >1 makes the tier
+    # own that many ENGINE REPLICAS — data-parallel copies of the same
+    # model, each a full EngineManager with its own bounded admission
+    # queue, breaker sub-gate, watchdog, and drain — so aggregate
+    # throughput scales past one engine's knee as a CONFIG change.  When
+    # the tier's submesh has enough devices, each replica gets its own
+    # device slice (devices permitting: replicas x tp chips); on a
+    # single-device/CPU box the replicas are process-local engines
+    # sharing the device.  Tier-level health()/kv_stats()/slot_stats()
+    # become aggregates with a per-replica breakdown; the HealthMonitor
+    # probes and restarts replicas INDIVIDUALLY, so one wedged replica
+    # degrades capacity instead of the tier.  1 = exactly the
+    # pre-replica single-engine behavior (byte-identical).
+    replicas: int = 1
+    # Prefix-affinity replica routing (serving/replicas.py): dispatch
+    # consults each replica's parked-prefix cache (the same select_reuse
+    # longest-match the engines reuse blocks by) and routes a request to
+    # the replica already holding its prefix KV, so the PR 10
+    # shared-prefix dedup win survives going multi-replica instead of
+    # being diluted N ways by spraying same-prefix sessions across
+    # replicas.  False = pure least-loaded (queue_depth x EWMA)
+    # dispatch.  DLLM_REPLICA_POLICY overrides globally.
+    replica_affinity: bool = True
+    # Minimum parked-prefix token match that binds a request to a
+    # replica: matches below it route least-loaded (a trivial prefix is
+    # cheaper to re-prefill than a load imbalance).
+    replica_affinity_min_tokens: int = 16
+    # Affinity-override threshold in seconds: when the affine replica's
+    # predicted queue wait (queue_depth / slots x EWMA service time —
+    # PR 1's admission predictor) exceeds the least-loaded replica's by
+    # more than this, affinity yields and the request routes
+    # least-loaded — a hot replica must not starve the others to keep
+    # its cache locality.
+    replica_affinity_override_s: float = 1.0
 
     def model(self) -> ModelConfig:
         return MODEL_PRESETS[self.model_preset]

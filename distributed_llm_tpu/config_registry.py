@@ -138,6 +138,12 @@ ENV_VARS: Dict[str, EnvVar] = {v.name: v for v in (
     _e("DLLM_BENCH_PROBE_ATTEMPTS", "4", "bench.py",
        "Accelerator-health probe attempts (with backoff) before the bench "
        "surrenders the headline run to CPU."),
+    _e("DLLM_REPLICA_POLICY", None, "serving/replicas.py",
+       "Global replica-dispatch policy override for replicated tiers "
+       "('affinity' | 'load' | 'random'); unset = "
+       "TierConfig.replica_affinity decides (affinity when True, else "
+       "least-loaded).  'random' exists for the bench's dilution "
+       "comparison, not production."),
 )}
 
 
@@ -236,6 +242,24 @@ CONFIG_FIELDS: Dict[str, str] = {
     "TierConfig.watchdog_stall_s": "Decode-watchdog deadline: pending "
                                    "work with no step progress for this "
                                    "long reads as wedged.",
+    "TierConfig.replicas": ">1 gives the tier that many data-parallel "
+                           "engine replicas (own queue/breaker/watchdog/"
+                           "drain each; health and KV stats aggregate "
+                           "with per-replica breakdown).",
+    "TierConfig.replica_affinity": "Route requests to the replica "
+                                   "already holding their parked KV "
+                                   "prefix (select_reuse matching); "
+                                   "False = pure least-loaded dispatch.",
+    "TierConfig.replica_affinity_min_tokens": "Minimum parked-prefix "
+                                              "token match that binds a "
+                                              "request to a replica.",
+    "TierConfig.replica_affinity_override_s": "Affinity yields to "
+                                              "least-loaded when the "
+                                              "affine replica's "
+                                              "predicted queue wait "
+                                              "exceeds the best "
+                                              "replica's by more than "
+                                              "this many seconds.",
     # -- ClusterConfig -----------------------------------------------------
     "ClusterConfig.nano": "The weak/cheap tier's TierConfig.",
     "ClusterConfig.orin": "The strong/costly tier's TierConfig.",

@@ -1831,13 +1831,28 @@ class ContinuousBatchingEngine:
     def prefix_affinity(self, history) -> int:
         """Longest parked-prefix token match in the paged pool for
         ``history`` (non-destructive; see InferenceEngine.prefix_affinity)."""
-        if self.prefix_cache is None:
+        if self.prefix_cache is None or not self._reuse_buckets:
             return 0
+        return self.prefix_affinity_tokens(self.affinity_token_ids(history))
+
+    def affinity_token_ids(self, history) -> List[int]:
+        """Tokenize ``history`` exactly as admission would — the shared
+        half of the affinity probe, split out so replica dispatch
+        (serving/replicas.py) tokenizes ONCE and peeks every replica's
+        cache with the same ids instead of paying N tokenizations per
+        request."""
         ids, _ = prepare_prompt(self.tokenizer, history,
                                 self.tier.prefill_buckets,
                                 self.cfg.max_seq_len,
                                 self.tier.max_new_tokens)
-        if not self._reuse_buckets:
+        return ids
+
+    def prefix_affinity_tokens(self, ids: Sequence[int]) -> int:
+        """Longest parked-prefix match for already-tokenized ``ids`` —
+        the per-replica half of the affinity probe (the same
+        select_reuse/_best_match longest-prefix matching block reuse
+        runs on; non-destructive peek)."""
+        if self.prefix_cache is None or not self._reuse_buckets:
             return 0
         # Same headroom cap as select_reuse's take() — the affinity score
         # must not promise tokens a real reclaim could not use.

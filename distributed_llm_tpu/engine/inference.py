@@ -775,12 +775,23 @@ class InferenceEngine:
         (serving/router.py): the router prefers the tier already holding
         a conversation's KV over re-prefilling it cold elsewhere.  0 when
         reuse is off or nothing matches."""
-        if self.prefix_cache is None:
+        if self.prefix_cache is None or not self._reuse_buckets:
             return 0
+        return self.prefix_affinity_tokens(self.affinity_token_ids(history))
+
+    def affinity_token_ids(self, history):
+        """Tokenize ``history`` as admission would — the shared half of
+        the affinity probe (replica dispatch tokenizes once and peeks
+        every replica with the same ids; serving/replicas.py)."""
         ids, _ = prepare_prompt(self.tokenizer, history, self._buckets,
                                 self._max_seq, self.tier.max_new_tokens,
                                 allow_long=True)
-        if not self._reuse_buckets:
+        return ids
+
+    def prefix_affinity_tokens(self, ids) -> int:
+        """Longest parked-prefix match for already-tokenized ``ids``
+        (non-destructive peek; the per-replica half of the probe)."""
+        if self.prefix_cache is None or not self._reuse_buckets:
             return 0
         # Same headroom cap as select_reuse's take() — the affinity score
         # must not promise tokens a real reclaim could not use.

@@ -89,6 +89,55 @@ def _thread_like_join(call: ast.Call) -> bool:
     return True
 
 
+_POOL_WRAPPERS = {"list", "tuple", "sorted", "reversed", "enumerate"}
+
+
+def _pool_iter_chain(it: ast.expr) -> Optional[str]:
+    """The attr chain of a for-loop iterable that is a thread POOL
+    container: ``self.X`` directly, ``list(self.X)``-style wrappers, or
+    ``self.X.values()``."""
+    if isinstance(it, ast.Call):
+        if (isinstance(it.func, ast.Name)
+                and it.func.id in _POOL_WRAPPERS and len(it.args) == 1):
+            it = it.args[0]
+        elif (isinstance(it.func, ast.Attribute)
+              and it.func.attr == "values" and not it.args):
+            it = it.func.value
+    chain = attr_chain(it)
+    return chain if chain and chain.startswith("self.") else None
+
+
+def _loop_pool_vars(mod) -> Dict[int, str]:
+    """id(join-call-node) -> pool attr chain, for every ``v.join(...)``
+    whose receiver ``v`` is the loop variable of an enclosing ``for v in
+    self.X`` (or a list()/values() wrapper of it) — the worker-pool
+    reclamation idiom the per-replica drain fan-out uses."""
+    out: Dict[int, str] = {}
+    for loop in ast.walk(mod.tree):
+        if not isinstance(loop, ast.For):
+            continue
+        target = loop.target
+        var = None
+        if isinstance(target, ast.Name):
+            var = target.id
+        elif (isinstance(target, ast.Tuple) and target.elts
+              and isinstance(target.elts[-1], ast.Name)):
+            var = target.elts[-1].id          # `for i, t in enumerate(...)`
+        if var is None:
+            continue
+        pool = _pool_iter_chain(loop.iter)
+        if pool is None:
+            continue
+        for n in ast.walk(loop):
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "join"
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id == var):
+                out[id(n)] = pool
+    return out
+
+
 def _parents_of(mod) -> Dict[int, ast.AST]:
     cached = getattr(mod, "_dllm_parents", None)
     if cached is None:
@@ -130,14 +179,41 @@ class ThreadLifecycleChecker(Checker):
         rel = mod.relpath
         parents = _parents_of(mod)
 
-        # function qual -> set of attr-chain receivers joined there.
+        # function qual -> set of attr-chain receivers joined there.  A
+        # join on a FOR-loop variable iterating a self attribute (`for t
+        # in self._workers: t.join()` — the per-replica worker-pool
+        # idiom, ISSUE 12) records the POOL's chain too, so a pool
+        # drained by a stop-family loop counts as reclaimed.
         joins: Dict[str, Set[str]] = {}
+        loop_pools = _loop_pool_vars(mod)
         for qual, edges in syms.calls.items():
             for _callee, bare, node in edges:
                 if bare == "join" and isinstance(node.func, ast.Attribute) \
                         and _thread_like_join(node):
                     chain = attr_chain(node.func.value)
                     joins.setdefault(qual, set()).add(chain or "<dyn>")
+                    pool = loop_pools.get(id(node))
+                    if pool is not None:
+                        joins[qual].add(pool)
+
+        # Worker-pool appends (`t = Thread(...); self.X.append(t)` or
+        # `self.X.append(Thread(...))`): the local binding resolves to
+        # the POOL attr, so rule (b) — joined from a stop-family method
+        # — applies to pooled per-replica workers exactly as to a
+        # single `self.worker = Thread(...)`.
+        pool_appends: Dict[str, Dict[str, str]] = {}
+        for qual, edges in syms.calls.items():
+            for _callee, bare, node in edges:
+                if (bare != "append"
+                        or not isinstance(node.func, ast.Attribute)
+                        or len(node.args) != 1):
+                    continue
+                pool = attr_chain(node.func.value)
+                if not (pool and pool.startswith("self.")):
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Name):
+                    pool_appends.setdefault(qual, {})[arg.id] = pool
 
         # Assignment targets of every Thread(...) per function: a join
         # must name ITS thread (or an alias/loop variable no thread is
@@ -181,10 +257,25 @@ class ThreadLifecycleChecker(Checker):
                         continue
                 elif fn_joins:
                     continue
-                # (b) parked on self.X and joined from a stop-family
-                # method of the same class.
+                # (b) parked on self.X — directly, or pooled via
+                # `self.X.append(t)` / `self.X.append(Thread(...))` —
+                # and joined from a stop-family method of the same
+                # class (a `for t in self.X: t.join()` loop there
+                # reclaims the whole pool).
                 attr = target if target and target.startswith("self.") \
                     else None
+                if attr is None:
+                    if target is not None:
+                        attr = pool_appends.get(qual, {}).get(target)
+                    else:
+                        parent_call = parents.get(id(node))
+                        if (isinstance(parent_call, ast.Call)
+                                and isinstance(parent_call.func,
+                                               ast.Attribute)
+                                and parent_call.func.attr == "append"):
+                            chain = attr_chain(parent_call.func.value)
+                            if chain and chain.startswith("self."):
+                                attr = chain
                 reclaimed = False
                 if attr is not None and info is not None \
                         and info.class_name:

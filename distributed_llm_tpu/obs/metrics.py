@@ -488,6 +488,20 @@ class ServingMetrics:
             "Fraction of tick wall time covered by stamped phase self-"
             "times (sampled; the bench profile leg pins >= 0.95)",
             ("tier",))
+        # Replicated-tier family (ISSUE 12, serving/replicas.py): how
+        # dispatch chose among a tier's engine replicas, and how much of
+        # the tier's replica capacity is currently healthy.
+        self.replica_routed = registry.counter(
+            "dllm_replica_routed_total",
+            "Requests dispatched to a tier replica, by how the replica "
+            "was chosen (affinity|affinity_overridden|least_loaded|"
+            "random|single|breaker_fallback)",
+            ("tier", "policy"))
+        self.replica_healthy_g = registry.gauge(
+            "dllm_replica_healthy",
+            "Replicas of the tier currently serving (running, not "
+            "wedged, breaker not open) out of TierConfig.replicas "
+            "(sampled)", ("tier",))
 
 
 _BREAKER_STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}

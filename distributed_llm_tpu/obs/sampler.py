@@ -61,6 +61,7 @@ _GAUGE_FIELDS = (
     ("draining", "tier_draining_g"),
     ("decode_tick_p50_ms", "decode_tick_p50_g"),
     ("profile_coverage", "profile_coverage_g"),
+    ("replica_healthy", "replica_healthy_g"),
 )
 
 

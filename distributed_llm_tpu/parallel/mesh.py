@@ -30,6 +30,17 @@ def tp_mesh(devices: Sequence[jax.Device], tp: int,
     return jax.sharding.Mesh(chosen, (axis_name,))
 
 
+def replica_mesh(devices: Sequence[jax.Device], replicas: int,
+                 tp: int = 1) -> jax.sharding.Mesh:
+    """A 2-D ('batch', 'tp') tier mesh over the first replicas·tp
+    devices — the data-parallel replica axis (each row is one engine
+    replica's private submesh; serving/replicas.py slices it row by
+    row).  'batch' deliberately matches the P('batch') data-parallel
+    axis convention so per-replica batching reads as what it is."""
+    chosen = np.array(list(devices[:replicas * tp])).reshape(replicas, tp)
+    return jax.sharding.Mesh(chosen, ("batch", "tp"))
+
+
 def sp_tp_mesh(devices: Sequence[jax.Device], sp: int,
                tp: int) -> jax.sharding.Mesh:
     """A 2-D ('sp', 'tp') tier mesh over the first sp·tp devices —
@@ -86,12 +97,26 @@ def carve_tier_meshes(
             continue
         ep = _fit_ep(tier, remaining, tp)
         sp = _fit_sp(tier, remaining, tp) if ep == 1 else 1
+        rep = (_fit_replicas(tier, remaining, tp)
+               if ep == 1 and sp == 1 else 1)
         meshes[tier.name] = (
             ep_tp_mesh(devices[cursor:], ep, tp) if ep > 1
             else sp_tp_mesh(devices[cursor:], sp, tp) if sp > 1
+            else replica_mesh(devices[cursor:], rep, tp) if rep > 1
             else tp_mesh(devices[cursor:], tp))
-        cursor += tp * max(sp, ep)
+        cursor += tp * max(sp, ep, rep)
     return meshes
+
+
+def _fit_replicas(tier: TierConfig, available: int, tp: int) -> int:
+    """Device rows a replicated tier can claim (ISSUE 12): up to
+    ``tier.replicas`` disjoint tp-sized slices, shrinking gracefully to
+    what the box has left — replicas beyond the available slices share
+    devices process-locally (serving/replicas.py _split_devices), so a
+    short box degrades placement, never the replica count."""
+    if tier.replicas <= 1:
+        return 1
+    return max(1, min(tier.replicas, available // max(1, tp)))
 
 
 def _fit_ep(tier: TierConfig, available: int, tp: int) -> int:

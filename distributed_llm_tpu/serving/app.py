@@ -341,7 +341,19 @@ def create_app(router: Optional[Router] = None,
             # Peek without lazy-starting; remote tiers' managers
             # (serving/remote.py) have no local engine at all.
             from ..utils.telemetry import engine_stats
-            entry.update(engine_stats(getattr(mgr, "_engine", None)))
+            subs = getattr(mgr, "live_engines", None)
+            if callable(subs):
+                # Replicated tier (ISSUE 12): per-replica engine stats
+                # nested under their replica keys, plus the manager's
+                # summed kv picture at tier level.
+                entry["replica_engines"] = {
+                    key: engine_stats(engine) for key, engine in subs()}
+                kv_fn = getattr(mgr, "kv_stats", None)
+                agg = kv_fn() if callable(kv_fn) else None
+                if agg:
+                    entry["kv"] = agg
+            else:
+                entry.update(engine_stats(getattr(mgr, "_engine", None)))
             tiers[name] = entry
         try:
             cache_stats = router_.query_router.get_cache_stats()

@@ -115,47 +115,125 @@ class HealthMonitor:
             return "failed", {**health, "ok": False}
         return "running", health
 
+    def _account_probe(self, key: str, state: str,
+                       health: Dict[str, Any]
+                       ) -> Tuple[Dict[str, Any], bool]:
+        """Fold one probe result into the failure/restart accounting
+        under the monitor lock; returns (snapshot entry, restart?).
+        ``key`` is a tier name or a replica key ("nano/r0") — replicas
+        carry their own failure streaks and restart counters, so one
+        flapping replica never consumes its siblings' probe budget."""
+        wedged = bool(health.get("wedged"))
+        restart = False
+        with self._lock:
+            if state == "running":
+                self._fail_counts[key] = 0
+                self._seen_running[key] = True
+            elif state == "failed" and (self._seen_running.get(key)
+                                        or wedged):
+                if wedged:
+                    # Decode watchdog: stalled step progress is
+                    # DIRECT wedge evidence (manager health flipped
+                    # past tier.watchdog_stall_s) — restart through
+                    # the existing bounded path NOW instead of
+                    # waiting out probe-count escalation.  (A wedged
+                    # engine necessarily ran, so seen_running is not
+                    # required.)
+                    self._fail_counts[key] = max(
+                        self._fail_counts.get(key, 0) + 1,
+                        self.max_failures)
+                else:
+                    self._fail_counts[key] = \
+                        self._fail_counts.get(key, 0) + 1
+                restart = (self.auto_restart
+                           and self._fail_counts[key] >= self.max_failures)
+            entry = {**health, "state": state,
+                     "consecutive_failures": self._fail_counts.get(key, 0),
+                     "restarts": self._restarts.get(key, 0),
+                     "restarts_abandoned":
+                         self._restarts_abandoned.get(key, 0)}
+            self._last[key] = entry
+        return entry, restart
+
+    def _probe_replicated(self, name: str, tier, managers,
+                          breaker, to_restart) -> Dict[str, Any]:
+        """Probe a replicated tier's replicas INDIVIDUALLY: each replica
+        keeps its own failure streak and restart target, so one wedged
+        replica restarts alone while the survivors keep serving — the
+        tier-level entry aggregates (ok while any replica runs).  A
+        successful replica restart force-closes only THAT replica's
+        breaker sub-gate (ReplicatedTierClient.reset_replica); the
+        tier-level breaker recovers through its own canary."""
+        reps: Dict[str, Dict[str, Any]] = {}
+        states: List[str] = []
+        for i, sub in enumerate(managers):
+            rkey = f"{name}/r{i}"
+            state, health = self._probe_tier(rkey, sub)
+            entry, restart = self._account_probe(rkey, state, health)
+            if restart:
+                def _on_restarted(tc=tier, idx=i):
+                    fn = getattr(tc, "reset_replica", None)
+                    if callable(fn):
+                        fn(idx)
+                to_restart.append((rkey, sub, _on_restarted))
+            reps[rkey] = entry
+            states.append(state)
+        running = sum(1 for s in states if s == "running")
+        if running:
+            tier_state = "running"
+        elif states and all(s == "draining" for s in states):
+            tier_state = "draining"
+        elif states and all(s == "stopped" for s in states):
+            tier_state = "stopped"
+        else:
+            tier_state = "failed"
+        tier_entry = {
+            "ok": running > 0,
+            "state": tier_state,
+            "healthy_replicas": running,
+            "replica_count": len(managers),
+            "degraded": 0 < running < len(managers),
+            "replicas": reps,
+        }
+        with self._lock:
+            self._last[name] = tier_entry
+        if breaker is not None and tier_state != "draining":
+            try:
+                breaker.note_probe(name, running > 0)
+            except Exception:
+                pass
+        return tier_entry
+
     def probe_once(self) -> Dict[str, Dict[str, Any]]:
         """One liveness pass.  Restarts (outside the lock — it can compile
         for tens of seconds) only tiers that were seen running and then
-        failed ``max_consecutive_failures`` probes in a row."""
+        failed ``max_consecutive_failures`` probes in a row; replicated
+        tiers probe and restart per replica."""
         snapshot: Dict[str, Dict[str, Any]] = {}
-        to_restart: List[Tuple[str, Any]] = []
+        # (key, manager, on-restarted callback or None)
+        to_restart: List[Tuple[str, Any, Any]] = []
 
         breaker = getattr(self.router, "breaker", None)
         for name, tier in self.router.tiers.items():
             mgr = tier.server_manager
+            subs = getattr(mgr, "replica_managers", None)
+            if callable(subs):
+                snapshot[name] = self._probe_replicated(
+                    name, tier, subs(), breaker, to_restart)
+                continue
             state, health = self._probe_tier(name, mgr)
-            wedged = bool(health.get("wedged"))
-            with self._lock:
-                if state == "running":
-                    self._fail_counts[name] = 0
-                    self._seen_running[name] = True
-                elif state == "failed" and (self._seen_running.get(name)
-                                            or wedged):
-                    if wedged:
-                        # Decode watchdog: stalled step progress is
-                        # DIRECT wedge evidence (manager health flipped
-                        # past tier.watchdog_stall_s) — restart through
-                        # the existing bounded path NOW instead of
-                        # waiting out probe-count escalation.  (A wedged
-                        # engine necessarily ran, so seen_running is not
-                        # required.)
-                        self._fail_counts[name] = max(
-                            self._fail_counts.get(name, 0) + 1,
-                            self.max_failures)
-                    else:
-                        self._fail_counts[name] = \
-                            self._fail_counts.get(name, 0) + 1
-                    if (self.auto_restart
-                            and self._fail_counts[name] >= self.max_failures):
-                        to_restart.append((name, mgr))
-                entry = {**health, "state": state,
-                         "consecutive_failures": self._fail_counts.get(name, 0),
-                         "restarts": self._restarts.get(name, 0),
-                         "restarts_abandoned":
-                             self._restarts_abandoned.get(name, 0)}
-                self._last[name] = entry
+            entry, restart = self._account_probe(name, state, health)
+            if restart:
+                def _on_restarted(n=name, b=breaker):
+                    # A successful restart voids the failure streak that
+                    # opened the tier's circuit: force-close so traffic
+                    # returns without waiting out the cooldown.
+                    if b is not None:
+                        try:
+                            b.reset(n)
+                        except Exception:
+                            pass
+                to_restart.append((name, mgr, _on_restarted))
             snapshot[name] = entry
             # Half-open probing rides the liveness cadence: a healthy
             # probe of an OPEN tier past its cooldown advances the
@@ -169,7 +247,7 @@ class HealthMonitor:
                 except Exception:
                     pass
 
-        for name, mgr in to_restart:
+        for name, mgr, on_restarted in to_restart:
             prev = self._restarting.get(name)
             if prev is not None and prev.is_alive():
                 logger.warning("tier %s restart still in flight — not "
@@ -178,7 +256,7 @@ class HealthMonitor:
             logger.warning("tier %s unhealthy after %d probes — restarting",
                            name, self.max_failures)
 
-            def _restart(name=name, mgr=mgr):
+            def _restart(name=name, mgr=mgr, on_restarted=on_restarted):
                 try:
                     mgr.stop_server()
                     mgr.start_server()
@@ -188,12 +266,14 @@ class HealthMonitor:
                         if name in self._last:
                             self._last[name]["restarts"] = \
                                 self._restarts[name]
-                    # A successful restart voids the failure streak that
-                    # opened the tier's circuit: force-close so traffic
-                    # returns without waiting out the cooldown.
-                    if breaker is not None:
+                    # A successful restart voids the failure streak: the
+                    # callback force-closes the right circuit (the tier's
+                    # for flat tiers, only THAT replica's sub-gate for a
+                    # replicated tier) so traffic returns without waiting
+                    # out the cooldown.
+                    if on_restarted is not None:
                         try:
-                            breaker.reset(name)
+                            on_restarted()
                         except Exception:
                             pass
                 except Exception as exc:

@@ -1,0 +1,639 @@
+"""Replicated tiers — N data-parallel engine replicas behind one tier.
+
+Until ISSUE 12 a tier was exactly ONE engine, so aggregate throughput was
+capped at one engine's knee and "scale out" meant an architecture change.
+``TierConfig.replicas > 1`` makes the tier own N full ``EngineManager``
+replicas — the TPU-serving data-parallel shape (per-replica batching over
+a mesh axis; the Gemma-on-TPU comparison in PAPERS.md): when the tier's
+submesh has enough devices each replica gets its own device slice
+(``replicas × tp`` chips, the ``P('batch')`` data-parallel carve), and on
+a single-device/CPU box the replicas are process-local engines sharing
+the device.  Every replica keeps the WHOLE single-engine machinery it
+had before — bounded admission queue + EWMA wait predictor (PR 1),
+watchdog (PR 2), drain (PR 5), chunked prefill (PR 9), shared-prefix KV
+(PR 10), tick profiler (PR 11) — because each replica IS a TierClient
+over an EngineManager, just not the only one.
+
+Dispatch picks a replica by a two-level policy:
+
+1. **Prefix affinity** (``TierConfig.replica_affinity``): the request is
+   tokenized ONCE and every live replica's parked-prefix cache is peeked
+   with the same ids — the identical ``select_reuse``/longest-match the
+   engines reuse blocks by (engine/prefix_cache.py), so the host-side
+   "which replica holds this prefix" map is exactly the caches
+   themselves, never a second bookkeeping structure that could drift.
+   A match of at least ``replica_affinity_min_tokens`` binds the request
+   to that replica — a session (or a same-system-prompt sibling) lands
+   where its blocks are parked, so the PR 10 dedup/warm-TTFT win
+   survives going multi-replica instead of being diluted N ways.
+2. **Least-loaded** otherwise: smallest predicted queue wait
+   (queue_depth / slots × EWMA service time — PR 1's admission
+   predictor), ties broken by in-flight count then round-robin.  An
+   affine replica whose predicted wait exceeds the least-loaded's by
+   more than ``replica_affinity_override_s`` is OVERRIDDEN — cache
+   locality must not starve the other replicas behind one hot queue.
+
+Each replica has its own breaker sub-gate (serving/breaker.py, keyed
+``r0..rN-1``, thresholds from the cluster's breaker config): dispatch
+skips open replicas, stream/sync verdicts feed back per replica, and
+admission rejections stay breaker-neutral (healthy backpressure — the
+PR 2 rule).  Tier-level ``health()`` / ``kv_stats()`` / ``slot_stats()``
+aggregate across replicas with a per-replica breakdown, and the
+HealthMonitor probes/restarts replicas INDIVIDUALLY — one wedged
+replica degrades capacity (``healthy_replicas``/``replica_count``)
+instead of the tier.
+
+``replicas = 1`` never builds any of this: build_tiers keeps the plain
+TierClient/EngineManager path, byte-identical to pre-replica behavior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..config import ClusterConfig, TierConfig
+from ..config_registry import env_str
+from ..engine.manager import EngineManager
+from ..obs import get_observability
+from ..obs import spans as obs_spans
+from ..obs.spans import current_trace
+from ..utils.faults import FaultInjector
+from .breaker import CircuitBreaker, OPEN
+from .errors import is_error_shape
+from .tiers import TierClient
+
+logger = logging.getLogger(__name__)
+
+_POLICIES = ("affinity", "load", "random")
+
+
+def replica_name(i: int) -> str:
+    return f"r{i}"
+
+
+def _split_devices(devices: List, n: int, tp: int) -> List[List]:
+    """Per-replica device groups: when the tier's submesh has at least
+    ``n × tp`` devices each replica gets its own contiguous ``tp``-chip
+    slice (the data-parallel carve — replicas are the 'batch' axis of
+    the SNIPPETS.md NamedSharding/P('batch') shape, realized as disjoint
+    submeshes because each replica runs its own engine); otherwise every
+    replica shares the whole group (process-local replicas — the CPU /
+    single-chip box)."""
+    per = max(1, tp)
+    if len(devices) >= n * per:
+        return [devices[i * per:(i + 1) * per] for i in range(n)]
+    if per == 1 and devices:
+        # Fewer devices than replicas: pin each replica to ONE device
+        # round-robin (an unsharded replica must never grow a mesh just
+        # because the box is short — extra replicas time-share).
+        return [[devices[i % len(devices)]] for i in range(n)]
+    return [list(devices) for _ in range(n)]
+
+
+class ReplicaSetManager:
+    """The EngineManager-shaped facade over a tier's N replica managers.
+
+    Everything that used to talk to ``tier.server_manager`` — the bench
+    harness's start/stop between configs, Router.drain, GET /health —
+    keeps working: lifecycle verbs fan out to every replica, liveness
+    reads aggregate, and ``health()``/``kv_stats()``/``slot_stats()``
+    return tier-level aggregates carrying a per-replica breakdown.
+    Probe-surface methods stay lock-free exactly like EngineManager's
+    (each sub-manager's health/is_server_running already are)."""
+
+    def __init__(self, tier: TierConfig, managers: Sequence[EngineManager]):
+        self.tier = tier
+        self.managers = list(managers)
+
+    # -- replica access -----------------------------------------------------
+
+    def replica_managers(self) -> List[EngineManager]:
+        """The per-replica EngineManagers — the HealthMonitor's probe and
+        restart targets (one wedged replica restarts alone)."""
+        return list(self.managers)
+
+    def live_engines(self) -> List[Tuple[str, Any]]:
+        """(replica key, engine) for every RUNNING replica — the obs
+        surfaces' iteration point (profiler trace, sampler, /stats).
+        Never lazy-starts an engine."""
+        out = []
+        for i, mgr in enumerate(self.managers):
+            engine = getattr(mgr, "_engine", None)
+            if engine is not None:
+                out.append((replica_name(i), engine))
+        return out
+
+    # -- lifecycle (ServerManager surface) ----------------------------------
+
+    def start_server(self, beat=None) -> None:
+        """Start every replica (idempotent per replica).  Serial on
+        purpose: replica 0's warmup populates the XLA compile cache the
+        siblings then hit warm, and concurrent cold compiles of the same
+        programs would just contend."""
+        for mgr in self.managers:
+            mgr.start_server(beat=beat)
+
+    def stop_server(self) -> None:
+        for mgr in self.managers:
+            mgr.stop_server()
+
+    def drain(self, timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """Drain every replica CONCURRENTLY and wait them all out — the
+        tier is drained only when its last replica is (each replica
+        stops admitting immediately, so the concurrent fan-out never
+        extends the deadline past one replica's drain_timeout_s plus
+        join slack).  Returns the aggregate summary with the per-replica
+        breakdown."""
+        timeout = (timeout_s if timeout_s is not None
+                   else self.tier.drain_timeout_s)
+        t0 = time.monotonic()
+        # Every key pre-populated BEFORE the workers start: a worker
+        # abandoned past the join bound may still finish later, and its
+        # write must be a value OVERWRITE (safe under the GIL), never a
+        # size-changing insert racing the summary's iteration below.
+        results: Dict[str, Any] = {
+            replica_name(i): {"error": "Request failed: replica drain "
+                              "did not return within the join bound"}
+            for i in range(len(self.managers))}
+        threads = []
+        for i, mgr in enumerate(self.managers):
+            def _drain(key=replica_name(i), mgr=mgr):
+                try:
+                    results[key] = mgr.drain(timeout_s=timeout)
+                except Exception as exc:   # a dead replica must not
+                    results[key] = {"error": f"Request failed: {exc}"}
+            t = threading.Thread(target=_drain, daemon=True,
+                                 name=f"drain-{self.tier.name}-r{i}")
+            threads.append(t)
+            t.start()
+        deadline = time.monotonic() + max(0.0, float(timeout)) + 30.0
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        summary = {
+            "draining_started": True,
+            "in_flight_at_start": sum(
+                int(r.get("in_flight_at_start", 0))
+                for r in results.values() if isinstance(r, dict)),
+            "drained": sum(int(r.get("drained", 0))
+                           for r in results.values()
+                           if isinstance(r, dict)),
+            "aborted": sum(int(r.get("aborted", 0))
+                           for r in results.values()
+                           if isinstance(r, dict)),
+            "waited_s": round(time.monotonic() - t0, 3),
+            "replicas": dict(results),      # snapshot, not the live dict
+        }
+        return summary
+
+    @property
+    def draining(self) -> bool:
+        """The TIER is draining only when every replica is: a partially
+        drained tier still serves traffic on the survivors."""
+        return bool(self.managers) and all(m.draining
+                                           for m in self.managers)
+
+    def is_server_running(self) -> bool:
+        return any(m.is_server_running() for m in self.managers)
+
+    def engine(self):
+        """Single-engine compatibility accessor (bench legs and tests
+        that introspect ``server_manager.engine()``): replica 0's
+        engine, lazy-started like EngineManager.engine()."""
+        return self.managers[0].engine()
+
+    # -- aggregate observability --------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        """Tier-level health = aggregate over per-replica health():
+        ``ok`` while ANY replica serves (one wedged replica is degraded
+        capacity, not a dead tier), ``wedged`` only when every replica
+        is, capacity counters, and the full per-replica breakdown."""
+        reps: Dict[str, Dict[str, Any]] = {}
+        for i, mgr in enumerate(self.managers):
+            try:
+                reps[replica_name(i)] = mgr.health()
+            except Exception as exc:
+                reps[replica_name(i)] = {"ok": False,  # dllm-lint: disable=error-shape -- health-probe snapshot (GET /health surface), not the tier error path
+                                         "error": str(exc)[:200]}
+        healthy = sum(1 for h in reps.values() if h.get("ok"))
+        running = sum(1 for h in reps.values() if h.get("uptime_s"))
+        entry: Dict[str, Any] = {
+            "ok": healthy > 0,
+            "draining": self.draining,
+            "tier": self.tier.name,
+            "model": self.tier.model_preset,
+            "uptime_s": max((h.get("uptime_s") or 0.0)
+                            for h in reps.values()) if reps else 0.0,
+            "devices": None,
+            "replica_count": len(self.managers),
+            "healthy_replicas": healthy,
+            "degraded": 0 < healthy < len(self.managers),
+            "queue_depth": sum(int(h.get("queue_depth") or 0)
+                               for h in reps.values()),
+            "active_slots": sum(int(h.get("active_slots") or 0)
+                                for h in reps.values()),
+            "max_slots": sum(int(h.get("max_slots") or 0)
+                             for h in reps.values()),
+            "replicas": reps,
+        }
+        devices = [d for h in reps.values()
+                   for d in (h.get("devices") or ())]
+        if devices:
+            entry["devices"] = devices
+        if entry["max_slots"]:
+            entry["slot_occupancy"] = round(
+                entry["active_slots"] / entry["max_slots"], 3)
+        if reps and all(h.get("wedged") for h in reps.values()):
+            # Every replica stalled: the tier as a whole is wedged (the
+            # per-replica watchdog verdicts still drive the individual
+            # restarts — this flag is the operator's summary).
+            entry["ok"] = False
+            entry["wedged"] = True
+        if running and not healthy:
+            entry["error"] = "no healthy replica (all wedged or failed)"
+        return entry
+
+    def kv_stats(self) -> Optional[Dict[str, Any]]:
+        """Summed block-pool picture over the live paged replicas, with
+        the per-replica breakdown; None when no live replica has a paged
+        pool (sequential engines).  ``dedup_ratio`` reports the MAX
+        across replicas — the per-replica ratios are the meaningful
+        series (block pools are disjoint; averaging them would hide a
+        replica whose pool sharing collapsed)."""
+        reps: Dict[str, Dict[str, Any]] = {}
+        for key, engine in self.live_engines():
+            fn = getattr(engine, "kv_stats", None)
+            if callable(fn):
+                try:
+                    reps[key] = fn()
+                except Exception:
+                    pass
+        if not reps:
+            return None
+        summed = ("free_blocks", "reclaimable_blocks", "total_blocks",
+                  "preempted_total", "prefill_pending_blocks",
+                  "prefill_backlog_tokens", "shared_blocks",
+                  "pinned_entries")
+        out: Dict[str, Any] = {k: sum(int(r.get(k, 0))
+                                      for r in reps.values())
+                               for k in summed}
+        first = next(iter(reps.values()))
+        out["block_size"] = first.get("block_size")
+        out["dedup_ratio"] = max(float(r.get("dedup_ratio", 1.0))
+                                 for r in reps.values())
+        out["replicas"] = reps
+        return out
+
+    def slot_stats(self) -> Dict[str, Any]:
+        """Summed occupancy over live replicas with per-replica rows."""
+        reps: Dict[str, Dict[str, Any]] = {}
+        for key, engine in self.live_engines():
+            fn = getattr(engine, "slot_stats", None)
+            if callable(fn):
+                try:
+                    reps[key] = fn()
+                except Exception:
+                    pass
+        summed = ("queue_depth", "active_slots", "max_slots",
+                  "preempted_total", "prefill_inflight",
+                  "prefill_backlog_tokens")
+        out: Dict[str, Any] = {k: sum(int(r.get(k, 0))
+                                      for r in reps.values())
+                               for k in summed}
+        out["slot_occupancy"] = round(
+            out["active_slots"] / max(1, out["max_slots"]), 3)
+        out["replicas"] = reps
+        return out
+
+    def prefix_affinity(self, history) -> int:
+        """Best parked-prefix match across the live replicas — the
+        tier-level probe the Router's cross-TIER affinity steering
+        consults (serving/router.py _apply_prefix_affinity): the tier
+        holds a conversation's prefix if ANY replica does.  Tokenizes
+        once, peeks each replica (non-destructive)."""
+        best = 0
+        ids = None
+        for _key, engine in self.live_engines():
+            peek = getattr(engine, "prefix_affinity_tokens", None)
+            if not callable(peek):
+                continue
+            try:
+                if ids is None:
+                    ids = engine.affinity_token_ids(history)
+                best = max(best, int(peek(ids)))
+            except Exception:
+                continue
+        return best
+
+
+class _ReplicaStream:
+    """Stream wrapper feeding the replica breaker its COMPLETION verdict
+    (the same rule as the Router's tier-level on_done: setup only proves
+    one primed token, so a mid-decode death must reach the breaker as
+    the failure it is; a consumer disconnect is not the replica's
+    fault).  Transparent to RoutedStream: iteration and ``.result``
+    forward to the tier handle."""
+
+    def __init__(self, handle, on_done):
+        self._handle = handle
+        self._on_done = on_done
+        self._fired = False
+
+    def _fire(self, ok: bool) -> None:
+        if not self._fired:
+            self._fired = True
+            try:
+                self._on_done(ok)
+            except Exception:
+                pass
+
+    def __iter__(self):
+        try:
+            for delta in self._handle:
+                yield delta
+        except GeneratorExit:
+            self._fire(True)              # client disconnect: replica fine
+            raise
+        except BaseException:
+            self._fire(False)
+            raise
+        self._fire(True)
+
+    @property
+    def result(self):
+        return self._handle.result
+
+
+class ReplicatedTierClient:
+    """The tier client over N replica TierClients — same surface as
+    TierClient (``process`` / ``process_stream`` / ``load_snapshot`` /
+    ``server_manager`` / ``tier`` / ``name``), with dispatch choosing a
+    replica per request (module docstring: affinity → least-loaded, with
+    the per-replica breaker veto)."""
+
+    def __init__(
+        self,
+        tier: TierConfig,
+        cluster: ClusterConfig,
+        mesh=None,
+        devices: Optional[List] = None,
+        fault_injector: Optional[FaultInjector] = None,
+        warmup_on_start: bool = True,
+        seed: int = 0,
+    ):
+        if tier.replicas < 1:
+            raise ValueError(f"tier {tier.name}: replicas must be >= 1, "
+                             f"got {tier.replicas}")
+        if tier.ep > 1 or tier.sp > 1:
+            # Replica submeshes are tp-only: silently serving without
+            # the configured expert/sequence sharding would look like
+            # ep/sp is in effect while it is not (same warn-and-degrade
+            # policy as _fit_sp's engine-mismatch rule).
+            logger.warning(
+                "tier %s: ep=%d sp=%d IGNORED — replicated tiers build "
+                "tp-only submeshes per replica (replicas=%d wins); set "
+                "replicas=1 to keep expert/sequence parallelism",
+                tier.name, tier.ep, tier.sp, tier.replicas)
+        self.tier = tier
+        self.name = tier.name
+        self.faults = fault_injector
+        n = tier.replicas
+        devs = (list(mesh.devices.flat) if mesh is not None
+                else list(devices or []))
+        groups = _split_devices(devs, n, tier.tp)
+        self.clients: List[TierClient] = []
+        managers: List[EngineManager] = []
+        for i in range(n):
+            # Replica-suffixed tier identity for the ENGINE side: logs,
+            # per-replica metric labels (dllm_decode_tick_ms{tier=
+            # "nano/r0"}, the per-replica compiled-programs gauge the
+            # bench leg pins), profiler timelines.  The CLIENT keeps the
+            # base name: error shapes, fault targeting, and trace spans
+            # must stay byte-identical to the single-replica tier.
+            rtier = dataclasses.replace(
+                tier, name=f"{tier.name}/{replica_name(i)}")
+            group = groups[i] if i < len(groups) else devs
+            if len(group) > 1:
+                from ..parallel.mesh import tp_mesh
+                # Multi-device group = this replica's own TP submesh,
+                # at the TIER's tp degree (a short box sharing devices
+                # must not inflate tp past the config).
+                mgr = EngineManager(
+                    rtier,
+                    mesh=tp_mesh(group, min(max(1, tier.tp), len(group))),
+                    seed=seed, warmup_on_start=warmup_on_start)
+            else:
+                mgr = EngineManager(rtier,
+                                    devices=(group or None), seed=seed,
+                                    warmup_on_start=warmup_on_start)
+            client = TierClient(rtier, mgr, fault_injector)
+            client.name = tier.name       # base-name error/fault identity
+            managers.append(mgr)
+            self.clients.append(client)
+        self.server_manager = ReplicaSetManager(tier, managers)
+        # Per-replica breaker sub-gate: same thresholds as the cluster's
+        # tier-level breaker; breaker_failures=0 disables both.  The
+        # tier-level breaker (Router) still owns whole-tier shedding —
+        # this one only steers dispatch AWAY from a failing replica
+        # while the survivors keep the tier closed.
+        self.breaker = CircuitBreaker(
+            [replica_name(i) for i in range(n)],
+            failure_threshold=getattr(cluster, "breaker_failures", 0),
+            cooldown_s=getattr(cluster, "breaker_cooldown_s", 30.0))
+        self._rr_lock = threading.Lock()
+        self._rr = 0
+        self._rng = random.Random(seed ^ 0x5EED)
+        self._last_client: Optional[TierClient] = None
+        # Observability sink, lazily resolved so tests/bench can inject
+        # a fresh registry after construction (same pattern as the
+        # manager's global fallbacks).
+        self.obs = None
+
+    # -- dispatch policy ----------------------------------------------------
+
+    def _policy(self) -> str:
+        raw = (env_str("DLLM_REPLICA_POLICY") or "").strip().lower()
+        if raw in _POLICIES:
+            return raw
+        return "affinity" if self.tier.replica_affinity else "load"
+
+    def _predicted_waits(self) -> List[Tuple[float, int]]:
+        """(predicted queue wait s, inflight) per replica — PR 1's
+        admission predictor (queue_depth / slots × EWMA service time)
+        read from each replica's own controller."""
+        out = []
+        for c in self.clients:
+            snap = c.admission.snapshot()
+            ewma_s = (snap.get("ewma_service_ms") or 0.0) / 1000.0
+            wait = (snap["queue_depth"] / max(1, snap["slots"])) * ewma_s
+            out.append((wait, int(snap["inflight"])))
+        return out
+
+    def _affinity_scores(self, history) -> List[int]:
+        """Parked-prefix match tokens per replica: tokenize ONCE with
+        the first live engine, peek every live replica's cache with the
+        same ids (stopped replicas score 0 — the probe never starts an
+        engine)."""
+        scores = [0] * len(self.clients)
+        ids = None
+        for i, c in enumerate(self.clients):
+            engine = getattr(c.server_manager, "_engine", None)
+            peek = getattr(engine, "prefix_affinity_tokens", None)
+            if not callable(peek) \
+                    or getattr(engine, "prefix_cache", None) is None:
+                continue            # no cache → never pay tokenization
+            try:
+                if ids is None:
+                    ids = engine.affinity_token_ids(history)
+                scores[i] = int(peek(ids))
+            except Exception:
+                scores[i] = 0
+        return scores
+
+    def _pick_replica(self, history) -> Tuple[int, str]:
+        """(replica index, how) — how ∈ {single, affinity,
+        affinity_overridden, least_loaded, random, breaker_fallback}."""
+        n = len(self.clients)
+        if n == 1:
+            return 0, "single"
+        waits = self._predicted_waits()
+        with self._rr_lock:
+            rr = self._rr
+            self._rr += 1
+            # Drawn under the lock even when unused: Random isn't
+            # thread-safe, and drawing unconditionally keeps the
+            # sequence deterministic per request index.
+            shuffled = self._rng.sample(range(n), n)
+        order = sorted(range(n),
+                       key=lambda i: (waits[i][0], waits[i][1],
+                                      (i - rr) % n))
+        how = "least_loaded"
+        policy = self._policy()
+        if policy == "random":
+            order = shuffled
+            how = "random"
+        elif policy == "affinity":
+            scores = self._affinity_scores(history)
+            best = max(range(n), key=lambda i: (scores[i], -waits[i][0]))
+            if scores[best] >= self.tier.replica_affinity_min_tokens:
+                least = order[0]
+                if (waits[best][0] - waits[least][0]
+                        <= self.tier.replica_affinity_override_s):
+                    order.remove(best)
+                    order.insert(0, best)
+                    how = "affinity"
+                else:
+                    # The affine replica is too hot: locality yields to
+                    # load — re-prefilling elsewhere beats queuing here.
+                    how = "affinity_overridden"
+        for idx in order:
+            if self.breaker.allow(replica_name(idx)):
+                return idx, (how if idx == order[0]
+                             else "breaker_fallback")
+        # Every replica's circuit is open within cooldown: dispatch the
+        # best candidate anyway — whole-tier shedding is the Router's
+        # tier-level breaker's job, and a tier with replicas=1 has no
+        # replica gate at all (parity).
+        return order[0], "breaker_fallback"
+
+    def _note_route(self, idx: int, how: str) -> None:
+        obs_spans.annotate(current_trace(), replica=replica_name(idx),
+                           replica_policy=how)
+        try:
+            m = (self.obs or get_observability()).m
+            m.replica_routed.labels(self.name, how).inc()
+        except Exception:
+            pass
+
+    def _feed_breaker(self, idx: int, raw: Any) -> None:
+        """Sync/setup outcome → the replica breaker.  Admission
+        rejections are breaker-neutral (healthy backpressure; the PR 2
+        rule) but repay a half-open canary permit."""
+        key = replica_name(idx)
+        if is_error_shape(raw):
+            if "admission rejected" in str(raw.get("error", "")):
+                self.breaker.release_probe(key)
+            else:
+                self.breaker.record(key, False)
+        else:
+            self.breaker.record(key, True)
+
+    def reset_replica(self, idx: int) -> None:
+        """Force-close one replica's circuit (the HealthMonitor calls
+        this after successfully restarting that replica's engine)."""
+        self.breaker.reset(replica_name(idx))
+
+    def healthy_replicas(self) -> int:
+        """Replicas currently able to serve: running, not draining, not
+        watchdog-stalled, circuit not open.  Lock-free advisory reads
+        only (the sampler calls this at cadence)."""
+        n = 0
+        for i, mgr in enumerate(self.server_manager.managers):
+            if not mgr.is_server_running() or mgr.draining:
+                continue
+            if self.breaker.state(replica_name(i)) == OPEN:
+                continue
+            engine = getattr(mgr, "_engine", None)
+            stall = getattr(engine, "progress_stall_s", None)
+            deadline = self.tier.watchdog_stall_s
+            if callable(stall) and deadline is not None:
+                try:
+                    if float(stall()) > deadline:
+                        continue
+                except Exception:
+                    pass
+            n += 1
+        return n
+
+    # -- request surface (TierClient parity) --------------------------------
+
+    def process(self, history) -> Dict[str, Any]:
+        idx, how = self._pick_replica(history)
+        self._note_route(idx, how)
+        client = self.clients[idx]
+        self._last_client = client
+        raw = client.process(history)
+        self._feed_breaker(idx, raw)
+        return raw
+
+    def process_stream(self, history):
+        idx, how = self._pick_replica(history)
+        self._note_route(idx, how)
+        client = self.clients[idx]
+        self._last_client = client
+        handle = client.process_stream(history)
+        if is_error_shape(handle):
+            self._feed_breaker(idx, handle)
+            return handle
+        key = replica_name(idx)
+        return _ReplicaStream(
+            handle, lambda ok: self.breaker.record(key, ok))
+
+    def load_snapshot(self) -> Dict[str, Any]:
+        """Tier-level load = sum over replicas (the queue-aware perf
+        strategy and the cross-host load allgather read ONE row per
+        tier; the per-replica split is dispatch's private signal)."""
+        out = {"queue_depth": 0, "active_slots": 0, "max_slots": 0}
+        for c in self.clients:
+            snap = c.load_snapshot()
+            for k in out:
+                out[k] += int(snap.get(k, 0))
+        return out
+
+    @property
+    def last_result(self):
+        c = self._last_client
+        return c.last_result if c is not None else None
+
+    @property
+    def admission(self):
+        """The last-dispatched replica's controller (back-compat shim
+        for tests poking ``tier.admission``); per-replica controllers
+        live on each client in ``self.clients``."""
+        c = self._last_client or self.clients[0]
+        return c.admission

@@ -384,55 +384,129 @@ class Router:
                     st.update(snap_fn())
                 except Exception:
                     pass
-            engine = getattr(tier.server_manager, "_engine", None)
-            kv_fn = getattr(engine, "kv_stats", None)
-            if callable(kv_fn):
-                try:
-                    ks = kv_fn()
-                    st["kv_free_blocks"] = ks.get("free_blocks")
-                    st["kv_reclaimable_blocks"] = ks.get(
-                        "reclaimable_blocks")
-                    # Shared-prefix KV (ISSUE 10): physical blocks with
-                    # multiple holders and the dedup factor — the
-                    # dllm_kv_shared_blocks / dllm_kv_dedup_ratio
-                    # gauges' source series.
-                    st["kv_shared_blocks"] = ks.get("shared_blocks", 0)
-                    st["kv_dedup_ratio"] = ks.get("dedup_ratio", 1.0)
-                    st["preempted_total"] = ks.get("preempted_total", 0)
-                    # Chunked-prefill backlog (PR 9): prompt tokens of
-                    # the in-flight prefill not yet absorbed — the
-                    # dllm_prefill_backlog gauge's source series.
-                    st["prefill_backlog_tokens"] = ks.get(
-                        "prefill_backlog_tokens", 0)
-                except Exception:
-                    pass
-            tick_fn = getattr(engine, "tick_stats", None)
-            if callable(tick_fn):
-                try:
-                    st["decode_tick_p50_ms"] = tick_fn().get("p50_ms")
-                except Exception:
-                    pass
-            # Tick-phase profiler (ISSUE 11): per-phase p50 self-times
-            # over the ring's recent tail + the coverage fraction —
-            # advisory ring reads, bounded to the last 128 records so
-            # the sampler's <1 ms budget holds as rings grow.
-            prof = getattr(engine, "profiler", None)
-            if prof is not None and getattr(prof, "enabled", False):
-                try:
-                    ps = prof.phase_stats(last=128)
-                    st["tick_phases"] = {
-                        name: s.get("p50_ms")
-                        for name, s in ps["phases"].items()}
-                    st["profile_coverage"] = ps.get("coverage")
-                except Exception:
-                    pass
-            st["draining"] = bool(getattr(tier.server_manager, "draining",
-                                          False))
+            mgr = tier.server_manager
+            subs = getattr(mgr, "live_engines", None)
+            if callable(subs):
+                # Replicated tier (ISSUE 12): the tier-level entry reads
+                # the AGGREGATE kv picture from the ReplicaSetManager
+                # (summed pools, max dedup) plus the healthy-capacity
+                # fraction; each replica then gets its OWN entry keyed
+                # "tier/rN" so every gauge family grows a per-replica
+                # series and the timeline carries the breakdown.  ONE
+                # kv_stats pass per sample: the aggregate call already
+                # returns the per-replica breakdown, which the replica
+                # entries reuse instead of re-reading each pool.
+                agg_kv = None
+                kv_fn = getattr(mgr, "kv_stats", None)
+                if callable(kv_fn):
+                    try:
+                        agg_kv = kv_fn()
+                    except Exception:
+                        agg_kv = None
+                st.update(self._collect_engine_state(mgr, kv=agg_kv))
+                healthy_fn = getattr(tier, "healthy_replicas", None)
+                if callable(healthy_fn):
+                    try:
+                        st["replica_healthy"] = int(healthy_fn())
+                    except Exception:
+                        pass
+                sub_mgrs = mgr.replica_managers()
+                rb = getattr(tier, "breaker", None)
+                st["replica_count"] = len(sub_mgrs)
+                rep_kv = (agg_kv or {}).get("replicas") or {}
+                for key, engine in subs():
+                    rst = self._collect_engine_state(
+                        engine, kv=rep_kv.get(key))
+                    slots_fn = getattr(engine, "slot_stats", None)
+                    if callable(slots_fn):
+                        try:
+                            ss = slots_fn()
+                            rst["queue_depth"] = ss.get("queue_depth")
+                            rst["active_slots"] = ss.get("active_slots")
+                            rst["max_slots"] = ss.get("max_slots")
+                        except Exception:
+                            pass
+                    try:
+                        sub = sub_mgrs[int(key.lstrip("r"))]
+                        rst["draining"] = bool(sub.draining)
+                    except (ValueError, IndexError):
+                        pass
+                    if rb is not None:
+                        rst["breaker"] = rb.state(key)
+                    out[f"{name}/{key}"] = rst
+            else:
+                engine = getattr(mgr, "_engine", None)
+                st.update(self._collect_engine_state(engine))
+            st["draining"] = bool(getattr(mgr, "draining", False))
             b = breaker_snap.get(name)
             if b is not None:
                 st["breaker"] = b.get("state")
             out[name] = st
         return out
+
+    _KV_FETCH = object()      # sentinel: "read kv_stats off the engine"
+
+    @staticmethod
+    def _collect_engine_state(engine, kv=_KV_FETCH
+                              ) -> Dict[str, Any]:  # dllm-lint: hot-path
+        """One engine's (or a ReplicaSetManager aggregate's) sampler
+        fields — the per-entry half of ``_sampler_collect``, shared by
+        the flat tier path, the replicated tier-level aggregate, and the
+        per-replica entries.  ``kv`` overrides the kv_stats read with a
+        precomputed dict (or None = no pool) so the replicated path pays
+        ONE pool read per sample, not two.  Same lock-free discipline:
+        advisory own-locked reads only, never the lifecycle lock."""
+        st: Dict[str, Any] = {}
+        ks = None
+        if kv is Router._KV_FETCH:
+            kv_fn = getattr(engine, "kv_stats", None)
+            if callable(kv_fn):
+                try:
+                    ks = kv_fn()
+                except Exception:
+                    ks = None
+        else:
+            ks = kv
+        if isinstance(ks, dict) and ks:
+            try:
+                st["kv_free_blocks"] = ks.get("free_blocks")
+                st["kv_reclaimable_blocks"] = ks.get(
+                    "reclaimable_blocks")
+                # Shared-prefix KV (ISSUE 10): physical blocks with
+                # multiple holders and the dedup factor — the
+                # dllm_kv_shared_blocks / dllm_kv_dedup_ratio
+                # gauges' source series.
+                st["kv_shared_blocks"] = ks.get("shared_blocks", 0)
+                st["kv_dedup_ratio"] = ks.get("dedup_ratio", 1.0)
+                st["preempted_total"] = ks.get("preempted_total", 0)
+                # Chunked-prefill backlog (PR 9): prompt tokens of
+                # the in-flight prefill not yet absorbed — the
+                # dllm_prefill_backlog gauge's source series.
+                st["prefill_backlog_tokens"] = ks.get(
+                    "prefill_backlog_tokens", 0)
+            except Exception:
+                pass
+        tick_fn = getattr(engine, "tick_stats", None)
+        if callable(tick_fn):
+            try:
+                st["decode_tick_p50_ms"] = tick_fn().get("p50_ms")
+            except Exception:
+                pass
+        # Tick-phase profiler (ISSUE 11): per-phase p50 self-times
+        # over the ring's recent tail + the coverage fraction —
+        # advisory ring reads, bounded to the last 128 records so
+        # the sampler's <1 ms budget holds as rings grow.
+        prof = getattr(engine, "profiler", None)
+        if prof is not None and getattr(prof, "enabled", False):
+            try:
+                ps = prof.phase_stats(last=128)
+                st["tick_phases"] = {
+                    name: s.get("p50_ms")
+                    for name, s in ps["phases"].items()}
+                st["profile_coverage"] = ps.get("coverage")
+            except Exception:
+                pass
+        return st
 
     def _session_label(self, raw: Any) -> str:
         """The bounded metric-label form of a client session id: '-'
@@ -494,13 +568,22 @@ class Router:
         from ..obs import profiler as obs_profiler
         by_tier: Dict[str, Dict[str, Any]] = {}
         for name, tier in self.tiers.items():
-            engine = getattr(tier.server_manager, "_engine", None)
-            prof = getattr(engine, "profiler", None)
-            if prof is not None and getattr(prof, "enabled", False):
-                try:
-                    by_tier[name] = prof.snapshot()
-                except Exception:
-                    pass
+            mgr = tier.server_manager
+            subs = getattr(mgr, "live_engines", None)
+            if callable(subs):
+                # Replicated tier: one synthetic trace thread PER
+                # REPLICA ("nano/r0", "nano/r1", ...) so Perfetto shows
+                # the replicas' tick timelines side by side.
+                engines = [(f"{name}/{key}", eng) for key, eng in subs()]
+            else:
+                engines = [(name, getattr(mgr, "_engine", None))]
+            for label, engine in engines:
+                prof = getattr(engine, "profiler", None)
+                if prof is not None and getattr(prof, "enabled", False):
+                    try:
+                        by_tier[label] = prof.snapshot()
+                    except Exception:
+                        pass
         return obs_profiler.chrome_trace(by_tier)
 
     def _obs_state_snapshot(self) -> Dict[str, Any]:
@@ -630,8 +713,7 @@ class Router:
             if (name not in order or device not in order
                     or order.index(name) <= order.index(device)):
                 continue                 # upgrade-only: skip weaker tiers
-            engine = getattr(tier.server_manager, "_engine", None)
-            probe = getattr(engine, "prefix_affinity", None)
+            probe = self._tier_affinity_probe(tier)
             if callable(probe):
                 try:
                     scores[name] = int(probe(history))
@@ -640,9 +722,7 @@ class Router:
         if not scores:
             return device, method, reasoning
         # The chosen tier's own match sets the bar the upgrade must beat.
-        own_engine = getattr(self.tiers[device].server_manager, "_engine",
-                             None)
-        own_probe = getattr(own_engine, "prefix_affinity", None)
+        own_probe = self._tier_affinity_probe(self.tiers[device])
         own = 0
         if callable(own_probe):
             try:
@@ -662,6 +742,19 @@ class Router:
                             to=best, match_tokens=scores[best])
             return best, f"{method}+prefix_affinity", reasoning
         return device, method, reasoning
+
+    @staticmethod
+    def _tier_affinity_probe(tier):
+        """The tier's prefix-affinity probe: the ReplicaSetManager's
+        best-across-replicas view for replicated tiers (a tier holds a
+        prefix if ANY replica does), else the single engine's — never
+        starts an engine either way."""
+        mgr = tier.server_manager
+        probe = getattr(mgr, "prefix_affinity", None)
+        if callable(probe):
+            return probe
+        engine = getattr(mgr, "_engine", None)
+        return getattr(engine, "prefix_affinity", None)
 
     @staticmethod
     def _extract_text(response: Any) -> Optional[str]:

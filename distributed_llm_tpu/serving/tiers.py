@@ -693,6 +693,17 @@ def build_tiers(
                 spawn_cmd=tier.spawn_cmd)
             continue
         mesh = meshes[tier.name]
+        if tier.replicas > 1:
+            # Replicated tier (ISSUE 12, serving/replicas.py): N engine
+            # replicas behind one tier client with prefix-affinity
+            # dispatch.  replicas=1 NEVER takes this path — the plain
+            # TierClient below stays byte-identical to pre-replica
+            # behavior.
+            from .replicas import ReplicatedTierClient
+            tiers[tier.name] = ReplicatedTierClient(
+                tier, cluster, mesh=mesh, fault_injector=fault_injector,
+                warmup_on_start=warmup_on_start, seed=cluster.seed)
+            continue
         # A 1-device mesh adds partitioning overhead for no benefit: pin to
         # the single device instead.
         if mesh.size == 1:

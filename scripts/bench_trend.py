@@ -47,16 +47,23 @@ import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 # (metric, higher_is_better): the regression-flagged comparables.
+# shared.peak_ratio (PR 10's resident-block dedup, lower = more KV
+# deduplicated) and replica.speedup (ISSUE 12's replicas=2/replicas=1
+# closed-loop ratio) joined the pinned set in r15: both are the
+# load-bearing wins of their PRs, and a silent drift back toward 1.0
+# would mean the dedup or the replica layer quietly stopped working.
 PINNED: Tuple[Tuple[str, bool], ...] = (
     ("trend_req_per_s", True),
     ("skew_tick_ratio", False),
     ("openloop.knee", True),
+    ("shared.peak_ratio", False),
+    ("replica.speedup", True),
 )
 
 # Context rows printed (no flags): the headline and accuracy travel
 # with the pinned numbers so a trend break can be read in context.
 CONTEXT = ("value", "routing_accuracy", "mixed.tbt95_ratio",
-           "shared.peak_ratio", "profile.coverage")
+           "replica.aff_ret", "profile.coverage")
 
 
 def _get(doc: Any, *path: str) -> Optional[Any]:
@@ -82,6 +89,10 @@ _PATHS: Dict[str, Tuple[Tuple[str, ...], ...]] = {
     "mixed.tbt95_ratio": (("mixed", "tbt95_ratio"),
                           ("mixed", "chunked", "tbt95_ratio")),
     "shared.peak_ratio": (("shared", "peak_ratio"),),
+    "replica.speedup": (("replica", "speedup"),
+                        ("replica", "closed_loop_speedup"),),
+    "replica.aff_ret": (("replica", "aff_ret"),
+                        ("replica", "affinity_hit_retention"),),
     "profile.coverage": (("profile", "coverage"),),
 }
 

@@ -67,3 +67,72 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: scripted-fault chaos-soak scenarios "
                    "(utils/faults.py FaultSchedule)")
+
+
+# -- environment capability flags (ISSUE 12 env-failure hygiene) -------------
+#
+# This container's jax lacks `from jax import shard_map` and its orbax
+# predates `PyTreeRestore(partial_restore=...)`; `hypothesis` is absent.
+# Since PR 1 those surfaced as a FIXED set of red failures/collection
+# errors every session had to eyeball against the seed baseline.  They
+# are now explicit skips: every guard below carries an "env: " reason,
+# and tests/test_env_hygiene.py PINS the guard count per capability —
+# tier-1 is green-or-real, and a genuine regression cannot hide inside
+# a growing skip pile (adding a guard without updating the pin fails).
+
+import pytest  # noqa: E402
+
+
+def _probe_shard_map() -> bool:
+    try:
+        from jax import shard_map  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def _probe_orbax_partial_restore() -> bool:
+    try:
+        import inspect
+        import orbax.checkpoint as ocp
+        return "partial_restore" in inspect.signature(
+            ocp.args.PyTreeRestore.__init__).parameters
+    except Exception:
+        return False
+
+
+def _probe_hypothesis() -> bool:
+    try:
+        import hypothesis  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+HAS_SHARD_MAP = _probe_shard_map()
+HAS_ORBAX_PARTIAL_RESTORE = _probe_orbax_partial_restore()
+HAS_HYPOTHESIS = _probe_hypothesis()
+
+ENV_SKIP_SHARD_MAP = pytest.mark.skipif(
+    not HAS_SHARD_MAP,
+    reason="env: `from jax import shard_map` unavailable in this "
+           "container's jax")
+ENV_SKIP_ORBAX_PARTIAL_RESTORE = pytest.mark.skipif(
+    not HAS_ORBAX_PARTIAL_RESTORE,
+    reason="env: this container's orbax predates "
+           "PyTreeRestore(partial_restore=...) — checkpoint-backed "
+           "serving paths cannot restore")
+
+
+def env_require_shard_map() -> None:
+    """Module-level guard for test modules whose IMPORTS need
+    jax.shard_map (they used to die as collection errors)."""
+    if not HAS_SHARD_MAP:
+        pytest.skip("env: `from jax import shard_map` unavailable in "
+                    "this container's jax", allow_module_level=True)
+
+
+def env_require_hypothesis() -> None:
+    if not HAS_HYPOTHESIS:
+        pytest.skip("env: `hypothesis` is not installed in this "
+                    "container", allow_module_level=True)

@@ -2,6 +2,10 @@
 the train → serve weight handoff."""
 
 import jax
+
+from conftest import env_require_shard_map
+
+env_require_shard_map()   # this module's imports need jax.shard_map
 import numpy as np
 import pytest
 

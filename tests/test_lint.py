@@ -1695,6 +1695,90 @@ def test_thread_reclaim_requires_stop_reachable_join():
     assert _rules(result) == ["thread-no-reclaim"]
 
 
+def test_thread_worker_pool_join_loop_reclaims(  # ISSUE 12 satellite
+):
+    """Per-replica worker POOLS: threads appended to a ``self.X`` list
+    and joined through a ``for t in self.X: t.join()`` loop in a
+    stop/drain-family method are reclaimed — and a leaked pool (drain
+    joins a DIFFERENT pool, or no stop path joins it at all) is
+    caught."""
+    from distributed_llm_tpu.lint.checkers.thread_lifecycle import \
+        ThreadLifecycleChecker
+    good = """
+        import threading
+
+        class ReplicaSet:
+            def __init__(self):
+                self._workers = []
+
+            def start(self, n):
+                for _ in range(n):
+                    t = threading.Thread(target=self._run)
+                    t.start()
+                    self._workers.append(t)
+
+            def _run(self):
+                pass
+
+            def drain(self):
+                for t in self._workers:
+                    t.join(timeout=5)
+    """
+    assert _lint(ThreadLifecycleChecker(), {SERVING: good}).findings == []
+
+    # The list()-wrapper form of the drain loop reclaims too.
+    wrapped = good.replace("for t in self._workers:",
+                           "for t in list(self._workers):")
+    assert _lint(ThreadLifecycleChecker(),
+                 {SERVING: wrapped}).findings == []
+
+    # Drain joins a DIFFERENT pool: the replica workers leak.
+    bad = good.replace("for t in self._workers:\n                    "
+                       "t.join(timeout=5)",
+                       "for t in self._others:\n                    "
+                       "t.join(timeout=5)")
+    result = _lint(ThreadLifecycleChecker(), {SERVING: bad})
+    assert _rules(result) == ["thread-no-reclaim"], result.findings
+
+    # The join loop exists but in a method no stop path reaches.
+    unreached = good.replace("def drain(self):", "def rebalance(self):")
+    result = _lint(ThreadLifecycleChecker(), {SERVING: unreached})
+    assert _rules(result) == ["thread-no-reclaim"], result.findings
+
+
+def test_thread_worker_pool_direct_append_reclaims():
+    """``self.X.append(threading.Thread(...))`` with no binding still
+    resolves to the pool for stop-family reclamation."""
+    from distributed_llm_tpu.lint.checkers.thread_lifecycle import \
+        ThreadLifecycleChecker
+    good = """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._threads = []
+
+            def start(self):
+                self._threads.append(threading.Thread(target=self._run))
+                self._threads[-1].start()
+
+            def _run(self):
+                pass
+
+            def stop(self):
+                for t in self._threads:
+                    t.join()
+    """
+    assert _lint(ThreadLifecycleChecker(), {SERVING: good}).findings == []
+
+    bad = good.replace("            def stop(self):\n"
+                       "                for t in self._threads:\n"
+                       "                    t.join()",
+                       "")
+    result = _lint(ThreadLifecycleChecker(), {SERVING: bad})
+    assert _rules(result) == ["thread-no-reclaim"], result.findings
+
+
 def test_thread_acquire_leak_flagged_and_finally_clean():
     from distributed_llm_tpu.lint.checkers.thread_lifecycle import \
         ThreadLifecycleChecker

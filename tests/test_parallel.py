@@ -4,6 +4,10 @@ virtual CPU mesh (no TPU required; SURVEY.md §4 implication)."""
 from functools import partial
 
 import jax
+
+from conftest import env_require_shard_map
+
+env_require_shard_map()   # this module's imports need jax.shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest

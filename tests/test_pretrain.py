@@ -2,6 +2,10 @@
 the published-artifact layout serving reads (VERDICT r1 #4 machinery)."""
 
 import jax
+
+from conftest import env_require_shard_map
+
+env_require_shard_map()   # this module's imports need jax.shard_map
 import pytest
 
 from distributed_llm_tpu.training import pretrain as pt
