@@ -342,6 +342,31 @@ def compact(result: dict) -> dict:
         }.items() if v is not None}
         if cm:
             out["shared"] = cm
+    sp = result.get("spill")
+    if isinstance(sp, dict) and not sp.get("skipped"):
+        # One number each (BENCHMARKS.md r16): the large-budget warm-hit
+        # rate (the spill-leg comparable) with OFF/small alongside, the
+        # monotonicity verdict, the decode-tick flatness ratio (≤1.05
+        # bar), promotion/demotion counts at the large budget, the race
+        # sub-check, and the cross-budget byte-identity verdict.
+        lg, sm, off = (sp.get("large") or {}, sp.get("small") or {},
+                       sp.get("off") or {})
+        cm = {k: v for k, v in {
+            "warm_hit_rate": sp.get("warm_hit_rate"),
+            "hit_off": off.get("warm_hit_rate"),
+            "hit_small": sm.get("warm_hit_rate"),
+            "monotone": sp.get("hit_rate_monotone"),
+            "tbt_ratio": sp.get("tbt_ratio"),
+            "promotions": lg.get("promotions"),
+            "demotions": lg.get("demotions_total"),
+            "ttft50_on": lg.get("revisit_ttft_p50_ms"),
+            "ttft50_off": off.get("revisit_ttft_p50_ms"),
+            "race_observed": (sp.get("race") or {}).get("observed"),
+            "ident": sp.get("outputs_identical"),
+            "err": (sp.get("error") or "")[:80] or None,
+        }.items() if v is not None}
+        if cm:
+            out["spill"] = cm
     rp = result.get("replica")
     if isinstance(rp, dict) and not rp.get("skipped"):
         # One number each (BENCHMARKS.md r15): the closed-loop scaling
@@ -1418,6 +1443,251 @@ def shared_prefix_phase(k_sessions: int = 4, beat=lambda: None) -> dict:
                         "exclusive path — the COW/byte-identity "
                         "contract is broken")
     return out
+
+
+def spill_phase(n_sessions: int = 16, beat=lambda: None) -> dict:
+    """Hierarchical-KV spill leg (ISSUE 14): a session population ≫ the
+    device pool (N sessions on a pool sized for ~4), spill OFF vs ON at
+    two host budgets, same seed/prompts — the regime where parked
+    prefixes are evicted long before they are re-hit and warm TTFT
+    becomes a function of host-RAM size instead of HBM size.
+
+    Per mode: every session prompts once (populate — pool pressure
+    evicts, ON demotes), then every session revisits with an extended
+    prompt, newest-first (recently active sessions return first — the
+    LRU-friendly half of real traffic; in-order revisits would ask each
+    tier for exactly the entry its LRU just dropped and read 0 at every
+    budget).  **warm_hit_rate** = revisits served warm (device prefix
+    hits + host promotions) / N — the spill-leg comparable, required
+    MONOTONE over OFF ≤ small-budget ≤ large-budget and measurably
+    higher at the large budget; **tbt_ratio** = a live CO-TENANT
+    stream's inter-token-gap p95 during the revisit phase, ON(large) /
+    OFF (p95 because decode emits whole ticks of tokens at once — the
+    p50 gap is ~0 by construction) — the decode stream the budget
+    contract protects must never pay a sync copy while promotions
+    absorb next to it, bar ≤ 1.05; outputs
+    must be byte-identical across ALL modes (hard ``error``, same
+    policy as the skew/shared legs).  A deterministic race sub-check
+    (copier paused, entry invalidated mid-promotion) must observe the
+    promotion-race fallback at least once with cold-prefill
+    byte-identity."""
+    import dataclasses
+    import sys
+
+    from distributed_llm_tpu.config import tiny_batched_cluster
+    from distributed_llm_tpu.engine.batching import ContinuousBatchingEngine
+    from distributed_llm_tpu.engine.paged_kv import pool_block_bytes
+
+    print("[bench] hierarchical-KV spill leg", file=sys.stderr, flush=True)
+    base = dataclasses.replace(
+        tiny_batched_cluster().nano, max_new_tokens=6, decode_batch=4,
+        prefill_buckets=(16, 32, 64), prefill_chunk_tokens=16,
+        prefix_cache_entries=32,        # capacity never the bound here
+        kv_pool_blocks=20)              # ~4 sessions of parked prefix
+    filler = ("tell me about the rivers lakes mountains oceans deltas "
+              "and glaciers of the region in one short sentence")
+    # Session names diverge at TOKEN ZERO: a shared "session N" opener
+    # would give every revisit a trivial >= min_prefix cross-session
+    # device hit and the warm-hit-rate comparable would read 1.0 in
+    # every mode (measured — the "session {i}:" form shares 5 tokens).
+    names = ("alpha bravo charlie delta echo foxtrot golf hotel india "
+             "juliett kilo lima mike november oscar papa quebec romeo "
+             "sierra tango").split()
+    prompts = [f"{names[i % len(names)]} {i}: {filler}"
+               for i in range(n_sessions)]
+    # Revisit most-recent-first (recently active sessions return first —
+    # the LRU-friendly half of real session traffic).  In-order
+    # revisits would ask each tier for exactly the entry its LRU just
+    # dropped and read 0 at EVERY budget; newest-first exposes the
+    # gradient the leg exists to measure: the device tier serves the
+    # last few sessions, the host tier extends the reach by its budget.
+    revisits = [p + " and then say more" for p in reversed(prompts)]
+    blk = pool_block_bytes(base.model(), base.kv_block_size,
+                           base.kv_quantize)
+    entry_bytes = blk * 4               # bucket-64 prompt ≈ 4 blocks
+    budgets = {"off": None,
+               "small": entry_bytes * 4,
+               "large": entry_bytes * n_sessions * 2}
+    out: dict = {"n_sessions": n_sessions, "kv_pool_blocks": 20,
+                 "host_entry_bytes": entry_bytes}
+
+    token_ids: dict = {}
+    for mode, host_bytes in budgets.items():
+        tier = dataclasses.replace(base, host_kv_bytes=host_bytes,
+                                   max_new_tokens=48)
+        eng = ContinuousBatchingEngine(tier, seed=11)
+        try:
+            eng.warmup(beat=beat)
+            ids_mode = []
+            for p in prompts:           # populate: park → evict/demote
+                ids_mode.append(tuple(
+                    eng.generate(p, max_new_tokens=6).token_ids))
+            beat()
+            cst0 = eng.prefix_cache.stats()
+            sp0 = (eng.kv_spill.stats() if eng.kv_spill is not None
+                   else {})
+            # A live co-tenant stream decodes THROUGH the revisit burst:
+            # its inter-token gaps are the TBT the budget contract
+            # protects — promotions must absorb next to it without the
+            # tick ever paying a sync copy.
+            import threading as _threading
+            gaps: list = []
+            co_stop = _threading.Event()
+
+            def co_tenant():
+                # Prompt shorter than the cache's min_prefix: the
+                # co-tenant never parks (and so never "hits"), keeping
+                # the warm-hit accounting purely about the N sessions.
+                while not co_stop.is_set():
+                    handle = eng.generate_stream(
+                        "sky", max_new_tokens=48)
+                    last = None
+                    for _ in handle:
+                        now = time.perf_counter()
+                        if last is not None:
+                            gaps.append((now - last) * 1000.0)
+                        last = now
+
+            co = _threading.Thread(target=co_tenant, daemon=True)
+            co.start()
+            ttfts = []
+            for p in revisits:          # revisit: the warm-or-cold test
+                r = eng.generate(p, max_new_tokens=6)
+                ids_mode.append(tuple(r.token_ids))
+                ttfts.append(r.ttft_ms)
+            co_stop.set()
+            co.join(timeout=60)
+            beat()
+            cst = eng.prefix_cache.stats()
+            sp = (eng.kv_spill.stats() if eng.kv_spill is not None
+                  else {})
+            dev_hits = ((cst["hits_shared"] + cst["hits_exclusive"])
+                        - (cst0["hits_shared"] + cst0["hits_exclusive"]))
+            promotions = (sp.get("promotions_total", 0)
+                          - sp0.get("promotions_total", 0))
+            warm = min(n_sessions, dev_hits + promotions)
+            token_ids[mode] = ids_mode
+            ttfts.sort()
+            gaps.sort()
+            out[mode] = {
+                "warm_hit_rate": round(warm / n_sessions, 4),
+                "device_hits": dev_hits,
+                "promotions": promotions,
+                "demotions_total": sp.get("demotions_total"),
+                "promotion_races_total": sp.get("promotion_races_total"),
+                "host_blocks_peak": sp.get("blocks"),
+                "revisit_ttft_p50_ms": _pct(ttfts, 0.50),
+                "cotenant_tbt_p50_ms": _pct(gaps, 0.50),
+                "cotenant_tbt_p95_ms": _pct(gaps, 0.95),
+                "decode_tick_p50_ms": eng.tick_stats()["p50_ms"],
+            }
+        finally:
+            eng.stop()
+        beat()
+
+    off = out.get("off") or {}
+    small = out.get("small") or {}
+    large = out.get("large") or {}
+    if large.get("warm_hit_rate") is not None:
+        out["warm_hit_rate"] = large["warm_hit_rate"]
+        out["hit_rate_monotone"] = (
+            off.get("warm_hit_rate", 1.0)
+            <= small.get("warm_hit_rate", 0.0)
+            <= large.get("warm_hit_rate", 0.0))
+        out["hit_rate_gain"] = round(
+            large["warm_hit_rate"] - off.get("warm_hit_rate", 0.0), 4)
+    # Flatness judged at p95 (mixed-leg precedent): decode emits whole
+    # ticks of tokens at once, so the p50 inter-delta gap is ~0 by
+    # construction and only the tick-cadence tail can show a promotion
+    # stalling the co-tenant.
+    if large.get("cotenant_tbt_p95_ms") and off.get("cotenant_tbt_p95_ms"):
+        out["tbt_ratio"] = round(large["cotenant_tbt_p95_ms"]
+                                 / off["cotenant_tbt_p95_ms"], 3)
+
+    # HARD invariant (correctness, not a measurement): the spill tier
+    # must not move a single token at any budget.
+    out["outputs_identical"] = (
+        len(token_ids) == 3
+        and token_ids["off"] == token_ids["small"] == token_ids["large"])
+    if not out["outputs_identical"]:
+        out["error"] = ("spill outputs diverged across host budgets — "
+                        "the promotion/race byte-identity contract is "
+                        "broken")
+    if not out.get("error") and out.get("hit_rate_monotone") is False:
+        # A bigger host budget serving FEWER revisits warm means the
+        # host LRU or the claim path regressed — the scaling story the
+        # leg exists to pin.
+        out["error"] = ("warm_hit_rate is not monotone over host "
+                        "budgets (off {} <= small {} <= large {} "
+                        "violated)".format(off.get("warm_hit_rate"),
+                                           small.get("warm_hit_rate"),
+                                           large.get("warm_hit_rate")))
+
+    # Race sub-check: force a promotion to LOSE (copier paused, entry
+    # invalidated mid-flight) and require the cold-prefill fallback to
+    # be byte-identical and counted.
+    try:
+        out["race"] = _spill_race_subcheck(base, entry_bytes, beat)
+        if not out.get("error") and not out["race"].get("observed"):
+            out["error"] = ("promotion-race fallback was never observed "
+                            "in the race sub-check")
+        if not out.get("error") and out["race"].get("identical") is False:
+            out["error"] = ("promotion-race fallback diverged from the "
+                            "cold prefill — the byte-identity contract "
+                            "is broken")
+    except Exception as exc:
+        out["race"] = {"error": str(exc)[:200]}
+        out.setdefault("error", f"race sub-check failed: {exc}"[:200])
+    return out
+
+
+def _spill_race_subcheck(base, entry_bytes: int, beat=lambda: None) -> dict:
+    """Deterministic promotion-race probe for the spill leg: park a
+    prefix, demote it with the copier PAUSED, admit a matching revisit
+    (the promotion claims the still-copying entry and waits), invalidate
+    the host store, resume — the promotion must fall back to a cold
+    prefill with byte-identical output and count exactly one race."""
+    import dataclasses
+    import time as _time
+
+    from distributed_llm_tpu.engine.batching import ContinuousBatchingEngine
+
+    prompt = ("race probe: tell me about rivers lakes mountains oceans "
+              "deltas and glaciers")
+    turn2 = prompt + " and then say more"
+
+    cold_eng = ContinuousBatchingEngine(
+        dataclasses.replace(base, host_kv_bytes=None), seed=11)
+    try:
+        cold_eng.generate(prompt)
+        cold = cold_eng.generate(turn2).token_ids
+    finally:
+        cold_eng.stop()
+    beat()
+
+    eng = ContinuousBatchingEngine(
+        dataclasses.replace(base, host_kv_bytes=entry_bytes * 8), seed=11)
+    try:
+        eng.generate(prompt)
+        eng.kv_spill.pause()
+        eng.prefix_cache.pop_oldest()         # demote, held in COPYING
+        req = eng.submit(turn2)
+        deadline = _time.time() + 20
+        while (eng.kv_spill.stats()["host_hits"] == 0
+               and _time.time() < deadline):
+            _time.sleep(0.001)
+        eng.kv_spill.clear()                  # the race: entry dies
+        eng.kv_spill.resume()
+        ok = req.done.wait(timeout=60) and req.error is None
+        st = eng.kv_spill.stats()
+        return {
+            "observed": bool(ok and st["promotion_races_total"] >= 1),
+            "races": st["promotion_races_total"],
+            "identical": bool(ok and req.result.token_ids == cold),
+        }
+    finally:
+        eng.kv_spill.resume()
+        eng.stop()
 
 
 def profile_phase(n_requests: int = 12, beat=lambda: None,
@@ -2883,6 +3153,22 @@ def run(progress: "Progress" = None, budget: "Budget" = None) -> dict:
     else:
         shared = {"skipped": budget.skip_stamp()}
     progress.section("shared", shared)
+    progress.flush_compact()
+
+    # Hierarchical-KV spill leg (ISSUE 14): 16 sessions on a pool sized
+    # for ~4, spill OFF vs ON at two host budgets at the same seed —
+    # warm-TTFT hit rate must scale (monotone) with host-cache size,
+    # decode tick p50 stays within 1.05x of OFF, outputs byte-identical
+    # across modes, and the promotion-race fallback is observed in the
+    # deterministic race sub-check (BENCHMARKS.md r16).
+    if budget.allows(150):
+        try:
+            spill = spill_phase(beat=progress.beat)
+        except Exception as exc:          # never lose the headline line
+            spill = {"error": str(exc)[:200]}
+    else:
+        spill = {"skipped": budget.skip_stamp()}
+    progress.section("spill", spill)
     progress.flush_compact()
 
     # Tick-forensics profile leg (ISSUE 11): a session-keyed mix through

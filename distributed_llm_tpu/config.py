@@ -388,6 +388,32 @@ class TierConfig:
     # take-ownership semantics (one live session per parked prefix; a
     # second same-prefix session misses and pays a full prefill).
     share_prefix_kv: bool = True
+    # Hierarchical KV spill tier (engine/kv_spill.py, ISSUE 14; batched
+    # paged engines with chunked prefill only): host-RAM byte budget for
+    # DEMOTED prefix-cache entries.  An unpinned sole-owner entry
+    # evicted from the device prefix cache is snapshot off the pool
+    # (async gather; the device→host pull drains on the spill copier
+    # thread, never the tick) instead of being dropped, and a later
+    # prompt extending it is PROMOTED back via budgeted host→device
+    # grants riding the chunked-prefill lane — warm TTFT becomes a
+    # function of host-RAM size instead of HBM size.  Promotions that
+    # lose the race (entry invalidated, copier stalled, blocks starved,
+    # drain) fall back to a cold prefill with byte-identical greedy
+    # output.  0/None disables the tier (exact pre-spill behavior).
+    # DLLM_HOST_KV_BYTES overrides globally (bench A/B).
+    host_kv_bytes: Optional[int] = None
+    # Fraction of the per-tick chunked-prefill token budget
+    # (prefill_chunk_budget) a promotion's host→device grants may spend
+    # per tick, charged at face value (one block = kv_block_size
+    # tokens).  Promotion work competes with chunk grants under ONE
+    # budget, so active streams' TBT bound is unchanged by promotions.
+    # Floored at one block per tick so a promotion always progresses.
+    host_kv_promote_share: float = 1.0
+    # Spill copier queue depth (pending demote snapshots).  A full
+    # queue makes further demotions drop (blocks were already freed;
+    # the prefix just isn't spilled) instead of backing up the
+    # scheduler — bounded memory for the in-flight device snapshots.
+    host_kv_copier_depth: int = 8
     # Weight-only quantization for serving ("none" | "int8", ops/quant.py):
     # int8 halves decode's HBM weight traffic.  Dense and MoE families;
     # unsharded tiers only (sharding rules and the trainer see

@@ -138,6 +138,11 @@ ENV_VARS: Dict[str, EnvVar] = {v.name: v for v in (
     _e("DLLM_BENCH_PROBE_ATTEMPTS", "4", "bench.py",
        "Accelerator-health probe attempts (with backoff) before the bench "
        "surrenders the headline run to CPU."),
+    _e("DLLM_HOST_KV_BYTES", None, "engine/batching.py",
+       "Global override of TierConfig.host_kv_bytes — the host-RAM "
+       "budget of the hierarchical KV spill tier in bytes ('0' disables "
+       "it everywhere); unset = each tier's config decides.  The bench "
+       "spill leg A/Bs through this."),
     _e("DLLM_REPLICA_POLICY", None, "serving/replicas.py",
        "Global replica-dispatch policy override for replicated tiers "
        "('affinity' | 'load' | 'random'); unset = "
@@ -224,6 +229,22 @@ CONFIG_FIELDS: Dict[str, str] = {
                                   "boundary block) instead of taking "
                                   "exclusive ownership; False restores "
                                   "one-live-session-per-prefix.",
+    "TierConfig.host_kv_bytes": "Host-RAM byte budget of the "
+                                "hierarchical KV spill tier (demoted "
+                                "prefix-cache entries; async copies "
+                                "off the tick path); 0/None disables "
+                                "it.  DLLM_HOST_KV_BYTES overrides "
+                                "globally.",
+    "TierConfig.host_kv_promote_share": "Fraction of the per-tick "
+                                        "chunked-prefill budget "
+                                        "promotion host→device grants "
+                                        "may spend (floored at one "
+                                        "block per tick).",
+    "TierConfig.host_kv_copier_depth": "Spill copier queue depth "
+                                       "(pending demote snapshots); a "
+                                       "full queue drops further "
+                                       "demotions instead of backing "
+                                       "up the scheduler.",
     "TierConfig.quantize": "Weight-only serving quantization ('none' | "
                            "'int8').",
     "TierConfig.kv_quantize": "KV-cache quantization ('none' | 'int8'); "

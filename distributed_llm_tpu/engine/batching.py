@@ -169,6 +169,20 @@ class _Prefill:
     # is discarded and decode resumes from replay[-1] (see
     # _admit_replay for the byte-identity contract).
     replay: Optional[List[int]] = None
+    # Hierarchical-KV promotion (ISSUE 14, engine/kv_spill.py): when
+    # set, the leading ``promote_nb`` blocks (covering the first
+    # ``promote_tokens`` positions) are satisfied by host→device copies
+    # of the claimed HostEntry instead of chunk compute —
+    # _advance_promotion grants them per tick under the shared chunk
+    # budget, then ``consumed`` jumps to ``promote_tokens`` and the
+    # suffix chunk-prefills as usual.  A promotion that loses the race
+    # clears these fields and the prefill restarts cold from 0
+    # (byte-identical greedy output either way).
+    promote_entry: Optional[Any] = None
+    promote_tokens: int = 0
+    promote_nb: int = 0
+    promote_done: int = 0
+    promote_waits: int = 0
     t_start: float = dataclasses.field(default_factory=time.perf_counter)
 
 
@@ -365,11 +379,45 @@ class ContinuousBatchingEngine:
         from .prefix_cache import PrefixCache
         self.prefix_cache = (
             PrefixCache(capacity=tier.prefix_cache_entries,
-                        on_evict=lambda e: self.allocator.free(
-                            e.cache["blocks"]),
+                        on_evict=self._prefix_evicted,
                         block_refcounts=self.allocator.refcounts)
             if tier.enable_prefix_cache and tier.prefix_cache_entries > 0
             else None)
+        # Hierarchical KV spill tier (ISSUE 14, engine/kv_spill.py): a
+        # host-RAM LRU under the device prefix cache.  Eviction of an
+        # unpinned sole-owner entry DEMOTES it (async snapshot + copier
+        # drain, see _try_demote); a later hit PROMOTES it back through
+        # the chunked-prefill lane (_advance_promotion).  Requires the
+        # chunk machinery — promotion grants ride its per-tick budget.
+        self.kv_spill = None
+        self._spill_fns: Dict[Any, Any] = {}
+        self._spill_block_bytes = 0
+        from ..config_registry import env_int
+        host_kv_bytes = env_int("DLLM_HOST_KV_BYTES",
+                                int(tier.host_kv_bytes or 0))
+        if host_kv_bytes > 0 and self.prefix_cache is not None:
+            if not self.chunk_tokens:
+                logger.warning(
+                    "tier %s: host_kv_bytes=%d ignored — the KV spill "
+                    "tier needs chunked prefill (prefill_chunk_tokens) "
+                    "to absorb promotion grants", tier.name, host_kv_bytes)
+            else:
+                from .kv_spill import HostKVSpill
+                from .paged_kv import pool_block_bytes
+                self._spill_block_bytes = pool_block_bytes(
+                    self.cfg, tier.kv_block_size, tier.kv_quantize)
+                self.kv_spill = HostKVSpill(
+                    budget_bytes=host_kv_bytes,
+                    block_bytes=self._spill_block_bytes,
+                    copier_depth=tier.host_kv_copier_depth,
+                    min_prefix=self.prefix_cache.min_prefix,
+                    tier=tier.name)
+        # Promotion stall bound, in scheduler passes: a claimed entry
+        # whose demote copy never lands (wedged copier) must not park
+        # the prefill lane forever — past this many stalled passes the
+        # promotion aborts to a cold prefill (the race-fallback
+        # contract, counted as a race).
+        self._promote_wait_cap = 2000
         # Cross-request shared-prefix KV (ISSUE 10): a cache hit PINS the
         # parked entry and maps its full blocks read-only into the new
         # slot's table (copy-on-write at the mid-block boundary) instead
@@ -612,14 +660,99 @@ class ContinuousBatchingEngine:
             self._cow_fn = jax.jit(copy_block, donate_argnums=donate, **kw)
         return self._cow_fn
 
+    def _spill_gather_fn(self):
+        """Jitted demote snapshot (``paged_kv.gather_blocks``): minted
+        ONCE; jit retraces per distinct block count, a family bounded by
+        the prompt-bucket ladder (ceil(bucket/bs) values) — the same
+        boundedness as the prefill writers.  NOT donated: it reads the
+        pool the next tick keeps using."""
+        fn = self._spill_fns.get("gather")
+        if fn is None:
+            from .paged_kv import gather_blocks
+            fn = jax.jit(gather_blocks)
+            self._spill_fns["gather"] = fn
+        return fn
+
+    def _spill_write_fn(self):
+        """Jitted promote write-back (``paged_kv.scatter_blocks``):
+        donated pool → in-place page-in, same policy as the prefill
+        writers; one trace per grant block count (bounded by the
+        promote-budget block grain)."""
+        fn = self._spill_fns.get("write")
+        if fn is None:
+            from .paged_kv import scatter_blocks
+            donate = (0,) if jax.default_backend() != "cpu" else ()
+            kw = {}
+            if self._pool_shardings is not None:
+                kw["out_shardings"] = self._pool_shardings
+            fn = jax.jit(scatter_blocks, donate_argnums=donate, **kw)
+            self._spill_fns["write"] = fn
+        return fn
+
+    def _prefix_evicted(self, entry) -> None:
+        """on_evict sink for the device prefix cache: DEMOTE the entry
+        to the host spill tier when eligible, else free its blocks (the
+        historical behavior — a refcounted decref under sharing)."""
+        blocks = (entry.cache.get("blocks")
+                  if isinstance(entry.cache, dict) else None)
+        if not blocks:
+            return
+        if not self._try_demote(entry.ids, blocks):
+            self.allocator.free(blocks)
+
+    def _try_demote(self, ids, blocks: List[int]) -> bool:
+        """Demote an evicted prefix entry's blocks to host RAM.  True =
+        the blocks were handled here (gathered and FREED — the
+        functional snapshot owns its data, so they return to the pool at
+        gather-issue time and the device→host pull drains on the spill
+        copier, never the tick).  Only sole-owner data demotes: a block
+        with refcount > 1 is still mapped by a live slot or another
+        parked entry — freeing is just a decref and the data stays
+        resident, so spilling a second copy would waste host budget."""
+        spill = self.kv_spill
+        if spill is None or self._stop.is_set():
+            return False
+        if any(r != 1 for r in self.allocator.refcounts(blocks)):
+            return False
+        nbytes = self._spill_block_bytes * len(blocks)
+        if not spill.accepts(nbytes):
+            return False
+
+        def gather():
+            self._note_compile("spill", ("gather", len(blocks)))
+            return self._spill_gather_fn()(
+                # dllm-lint: disable=retrace-dynamic-shape -- bounded: len(blocks) is ceil(parked-prompt/bs), one gather trace per prompt-bucket block count (the prefill-writer family's bound)
+                self.pool, jnp.asarray(blocks, jnp.int32))
+
+        # Phase stamps are scheduler-thread-only (the profiler is
+        # single-writer); evictions driven from another thread (tests
+        # poking pop_oldest, warmup on the builder thread) still demote,
+        # just unstamped.
+        if (self._thread is not None
+                and threading.get_ident() == self._thread.ident):
+            with self.profiler.phase("demote"):
+                tiles = gather()
+        else:
+            tiles = gather()
+        # The snapshot owns its data: the blocks can go back to the
+        # free list NOW — later pool writes build new pool arrays and
+        # never reach it (see paged_kv.gather_blocks).
+        self.allocator.free(blocks)
+        spill.offer(ids, tiles, nbytes, nb=len(blocks))
+        return True
+
     def _note_prefix_hit(self, kind: str) -> None:
         """Mirror one admission's prefix-cache lookup outcome to the
         ``dllm_prefix_hits_total{tier,kind}`` counter
-        (kind = shared | exclusive | miss).  Counted per admission
-        ATTEMPT — a KV-pressure requeue re-looks-up on re-admission,
-        matching the cache's own hit/miss stats semantics.  No
-        injection path on the engine (same pattern as the preemption
-        counter): the process-global registry."""
+        (kind = shared | exclusive | host | miss).  Counted per
+        admission ATTEMPT — a KV-pressure requeue re-looks-up on
+        re-admission, matching the cache's own hit/miss stats
+        semantics.  ``host`` (ISSUE 14) is a spill-tier promotion
+        claim: the DEVICE cache's own stats record it as a miss (or a
+        reversed hit), so cache.stats() reconcilers should treat host
+        hits as device misses.  No injection path on the engine (same
+        pattern as the preemption counter): the process-global
+        registry."""
         try:
             from ..obs import get_observability
             get_observability().m.prefix_hits.labels(
@@ -748,6 +881,52 @@ class ContinuousBatchingEngine:
         from .prefix_cache import select_reuse
         reused = select_reuse(self.prefix_cache, ids, self._reuse_buckets,
                               max_seq, share=self.share_prefix)
+
+        if self.kv_spill is not None:
+            # Hierarchical KV (ISSUE 14): probe the host spill tier and
+            # prefer it whenever it holds a LONGER prefix than the
+            # device cache found (a session's demoted history beats a
+            # stranger's short common opener).  A host hit becomes an
+            # in-flight chunked prefill whose leading blocks are
+            # PROMOTED (host→device grants under the chunk budget,
+            # _advance_promotion) instead of recomputed; the prefetch
+            # overlaps the request's own queue wait.  The single
+            # prefill lane applies exactly as for a long cold prompt.
+            dev_m = reused[1] if reused is not None else 0
+            if self.kv_spill.peek(ids, max_len=n - 1) > dev_m:
+                if self._prefill is not None:
+                    if reused is not None:
+                        # Hand the device hit back untouched — the
+                        # deferred re-admission re-probes both tiers.
+                        entry, m, _suffix, _sb = reused
+                        if self.share_prefix:
+                            self.prefix_cache.unshare(entry, m)
+                        else:
+                            self.prefix_cache.untake(entry, m)
+                    # unshare/untake reversed the cache's hit into a
+                    # miss (and a no-hit defer already counted one):
+                    # mirror it so the counter tracks cache stats.
+                    self._note_prefix_hit("miss")
+                    req.needs_chunk = True
+                    return False
+                claimed = self.kv_spill.claim(ids, max_len=n - 1)
+                if claimed is not None and claimed[1] > dev_m:
+                    if reused is not None:
+                        entry, m, _suffix, _sb = reused
+                        if self.share_prefix:
+                            self.prefix_cache.unshare(entry, m)
+                        else:
+                            self.prefix_cache.untake(entry, m)
+                        reused = None
+                    self._note_prefix_hit("host")
+                    self._start_prefill(req, slot_ix, ids, n, bucket,
+                                        budget, promote=claimed)
+                    return True
+                if claimed is not None:
+                    # The peeked entry shrank/died before the claim:
+                    # the device hit (if any) still stands.
+                    self.kv_spill.release(claimed[0], promoted=False)
+
         if self.prefix_cache is not None and reused is None:
             self._note_prefix_hit("miss")
 
@@ -1016,7 +1195,8 @@ class ContinuousBatchingEngine:
 
     def _start_prefill(self, req: _Request, slot_ix: int, ids: List[int],
                        n: int, bucket: int, budget: int,
-                       gen: Optional[List[int]] = None) -> None:
+                       gen: Optional[List[int]] = None,
+                       promote: Optional[Any] = None) -> None:
         """Reserve ``slot_ix`` and register the request as the tick's
         in-flight chunked prefill.  No blocks yet — _advance_prefill
         allocates per chunk, so a long prompt's pool footprint grows
@@ -1035,11 +1215,25 @@ class ContinuousBatchingEngine:
         self._rng, rng = jax.random.split(self._rng)
         temp = (self.tier.temperature if req.temperature is None
                 else req.temperature)
-        self._prefill = _Prefill(
+        pf = _Prefill(
             request=req, slot_ix=slot_ix, seq=seq, prompt_len=n,
             prompt_ids=tuple(ids), total=len(seq), budget=budget,
             temperature=temp, rng=rng, max_blocks=max_blocks,
             replay=list(gen) if gen is not None else None)
+        if promote is not None:
+            # Hierarchical-KV promotion (engine/kv_spill.py): the
+            # claimed (pinned) HostEntry satisfies the leading blocks —
+            # the ceil(m/bs) tiles covering the matched prefix; a
+            # mid-block boundary is fine because the suffix chunks
+            # overwrite their own positions in these PRIVATE blocks
+            # (the exclusive-take rule) and stale tail KV is masked.
+            entry, m = promote
+            pf.promote_entry = entry
+            pf.promote_tokens = m
+            pf.promote_nb = -(-m // bs)
+            obs_spans.event(req.trace, "kv_promote_start",
+                            matched_tokens=m, blocks=pf.promote_nb)
+        self._prefill = pf
         obs_spans.event(req.trace, "prefill_chunked", tokens=len(seq),
                         chunk_tokens=self.chunk_tokens,
                         replayed=bool(gen))
@@ -1065,6 +1259,15 @@ class ContinuousBatchingEngine:
         span = self.paged.blocks_per_slot * bs
         budget_left = self.chunk_budget
         try:
+            if pf.promote_entry is not None:
+                moved, budget_left = self._advance_promotion(pf,
+                                                             budget_left)
+                progressed = progressed or moved
+                if pf.promote_entry is not None:
+                    # Still mid-promotion (copier not landed, pool dry,
+                    # or the promote share of this tick's budget spent):
+                    # retry next tick — decode never waits on it.
+                    return progressed
             while pf.consumed < pf.total and budget_left >= c:
                 start = pf.consumed
                 if start + c > span:
@@ -1122,6 +1325,9 @@ class ContinuousBatchingEngine:
                     return True
         except BaseException as exc:       # surface to the caller
             self._prefill = None
+            if pf.promote_entry is not None and self.kv_spill is not None:
+                self.kv_spill.release(pf.promote_entry, promoted=False)
+                pf.promote_entry = None
             slot = self._slots[pf.slot_ix]
             if slot is not None and slot.request is req:
                 # The final chunk had already gone live as a slot when
@@ -1135,6 +1341,91 @@ class ContinuousBatchingEngine:
             req.done.set()
             return True
         return progressed
+
+    def _advance_promotion(self, pf: _Prefill, budget_left: int):
+        """Spend part of this tick's chunk budget landing host→device
+        promotion grants (ISSUE 14): up to ``host_kv_promote_share`` of
+        the budget, charged one block = one kv_block_size-token grant,
+        so promotion competes with chunk grants under ONE budget and the
+        active streams' TBT bound is unchanged.  Every copy is an async
+        upload + jitted scatter — no sync; the suffix chunk prefill that
+        follows depends on the writes ON DEVICE, so ordering is the
+        stream's job, never a host wait.
+
+        Returns (progressed, budget_left); clears ``pf.promote_*`` on
+        completion (``consumed`` jumps to the matched length) or on
+        abort — an invalidated entry or a wedged copier loses the race
+        and the prefill restarts COLD from position 0 this same tick,
+        byte-identical under greedy (the race-fallback contract)."""
+        from .kv_spill import COPYING, DEAD
+        spill = self.kv_spill
+        entry = pf.promote_entry
+        bs = self.paged.block_size
+        req = pf.request
+        state = spill.entry_state(entry)
+        if state is COPYING:
+            # Hit-during-demotion: the demote copy hasn't landed yet —
+            # wait it out (the copier is ms away), bounded so a wedged
+            # copier cannot park the prefill lane forever.
+            pf.promote_waits += 1
+            if pf.promote_waits <= self._promote_wait_cap:
+                return False, budget_left
+            state = DEAD                        # wedged: lost the race
+        # Snapshot the host buffers WITH the state verdict: a concurrent
+        # invalidation nulls entry.tiles, and a local reference cannot
+        # be nulled under the grant loop below.
+        host_tiles = entry.tiles
+        if host_tiles is None and state is not DEAD:
+            state = DEAD                        # invalidated between reads
+        if state is DEAD:
+            spill.release(entry, promoted=False, race=True)
+            pf.promote_entry = None
+            pf.promote_done = 0
+            pf.consumed = 0
+            obs_spans.event(req.trace, "kv_promote_race",
+                            fallback="cold_prefill")
+            return True, budget_left            # cold chunks proceed NOW
+        share = max(0.0, min(1.0, self.tier.host_kv_promote_share))
+        promo_budget = max(bs, int(self.chunk_budget * share))
+        progressed = False
+        spent = 0
+        while pf.promote_done < pf.promote_nb:
+            grain = min(budget_left, promo_budget - spent) // bs
+            k = min(pf.promote_nb - pf.promote_done, grain)
+            if k <= 0:
+                break
+            need = pf.promote_done + k
+            if len(pf.blocks) < need:
+                extra = self._alloc_evicting(need - len(pf.blocks))
+                if extra is None:
+                    # Pool dry: stall exactly like a dry chunk grant —
+                    # retry next tick (growth starvation may cancel the
+                    # whole prefill first, which releases the pin and
+                    # requeues the request).
+                    return progressed, budget_left
+                pf.blocks.extend(extra)
+            lo = pf.promote_done
+            tiles = {name: jnp.asarray(arr[:, :, lo:lo + k])  # dllm-lint: disable=retrace-dynamic-shape -- bounded: k is whole blocks under the per-tick promote budget, so upload widths (and the scatter traces they feed) are capped at promote-budget blocks
+                     for name, arr in host_tiles.items()}
+            with self.profiler.phase("promote"):
+                self._note_compile("spill", ("write", k))
+                self.pool = self._spill_write_fn()(
+                    # dllm-lint: disable=retrace-dynamic-shape -- bounded: k grants are whole blocks under the per-tick promote budget, so the write family is one trace per grant block count <= promote-budget blocks
+                    self.pool, jnp.asarray(pf.blocks[lo:need], jnp.int32),
+                    tiles)
+            pf.promote_done = need
+            budget_left -= k * bs
+            spent += k * bs
+            progressed = True
+            self._progress_t = time.monotonic()
+        if pf.promote_done >= pf.promote_nb:
+            pf.consumed = pf.promote_tokens
+            spill.release(entry, promoted=True)
+            pf.promote_entry = None
+            obs_spans.event(req.trace, "kv_promoted",
+                            tokens=pf.promote_tokens,
+                            blocks=pf.promote_nb)
+        return progressed, budget_left
 
     def _finish_prefill(self, pf: _Prefill, first: int) -> None:
         """Last chunk landed: the reserved slot goes live.  Cold
@@ -1174,6 +1465,12 @@ class ContinuousBatchingEngine:
         if pf is None:
             return
         self._prefill = None
+        if pf.promote_entry is not None and self.kv_spill is not None:
+            # Mid-promotion cancel (starvation/stop): drop the pin so
+            # the host entry is evictable again; re-admission re-claims
+            # it (or goes cold if it is gone by then).
+            self.kv_spill.release(pf.promote_entry, promoted=False)
+            pf.promote_entry = None
         self.allocator.free(pf.blocks)
         self.prefill_cancelled_total += 1
         req = pf.request
@@ -1587,6 +1884,14 @@ class ContinuousBatchingEngine:
                 f"mid-flight"))
             if self.prefix_cache is not None:
                 self.prefix_cache.clear()    # parked blocks → free list
+                # (_try_demote stands down once _stop is set, so clear
+                # frees straight to the allocator — no parting spills.)
+            if self.kv_spill is not None:
+                # Drain waits out in-flight copies: flush the copier
+                # (bounded) before dropping the engine so the host tier
+                # is consistent at rest — manager.drain reaches here via
+                # stop_server after the request drain completes.
+                self.kv_spill.stop()
             for ix, slot in enumerate(self._slots):
                 if slot is not None:
                     self._fail_slot(ix, shutdown)
@@ -1700,7 +2005,36 @@ class ContinuousBatchingEngine:
         rs = self.allocator.ref_stats()
         pinned = (self.prefix_cache.stats()["pinned_entries"]
                   if self.prefix_cache is not None else 0)
+        # Hierarchical-KV spill picture (ISSUE 14): host-tier occupancy,
+        # the demote/promote lifecycle counters, and the in-flight
+        # promotion's REMAINING block demand.  Promotion rides the
+        # chunked-prefill lane, so its unallocated blocks are already
+        # inside prefill_pending_blocks above — the admission gate's
+        # supply subtraction covers it with no double count; the
+        # explicit backlog field makes a degraded warm-hit rate
+        # diagnosable in one /stats call.
+        spill_fields: Dict[str, int] = {}
+        if self.kv_spill is not None:
+            ss = self.kv_spill.stats()
+            backlog = 0
+            if pf is not None and pf.promote_entry is not None:
+                backlog = max(0, pf.promote_nb - pf.promote_done)
+            spill_fields = {
+                "host_entries": ss["entries"],
+                "host_blocks": ss["blocks"],
+                "host_bytes": ss["bytes"],
+                "host_budget_bytes": ss["budget_bytes"],
+                "demotions_total": ss["demotions_total"],
+                "promotions_total": ss["promotions_total"],
+                "promotion_races_total": ss["promotion_races_total"],
+                # Entries whose host copy has not landed (queued jobs'
+                # entries are already in the copying state — counting
+                # the queue too would double-bill them).
+                "demote_inflight": ss["copying_entries"],
+                "promote_backlog_blocks": backlog,
+            }
         return {
+            **spill_fields,
             "free_blocks": self.allocator.available,
             "reclaimable_blocks": reclaimable,
             "block_size": self.paged.block_size,
@@ -1856,8 +2190,15 @@ class ContinuousBatchingEngine:
             return 0
         # Same headroom cap as select_reuse's take() — the affinity score
         # must not promise tokens a real reclaim could not use.
-        return self.prefix_cache.peek(
+        best = self.prefix_cache.peek(
             ids, max_len=self.cfg.max_seq_len - self._reuse_buckets[0])
+        if self.kv_spill is not None:
+            # Demoted entries are affinity-eligible (ISSUE 14): a
+            # session follows its spilled prefix home — promotion beats
+            # a cold prefill on a stranger replica.
+            best = max(best, self.kv_spill.peek(
+                ids, max_len=self.cfg.max_seq_len - self._reuse_buckets[0]))
+        return best
 
     def warmup(self, beat=None) -> None:
         """Compile the decode tick + smallest cold-prefill bucket (via one

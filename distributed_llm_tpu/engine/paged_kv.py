@@ -246,6 +246,53 @@ def copy_block(pool: KVPool, src: jax.Array, dst: jax.Array) -> KVPool:
     return out
 
 
+def gather_blocks(pool: KVPool, blocks: jax.Array) -> KVPool:
+    """Snapshot ``blocks``' K/V tiles (and int8 scales) out of the pool:
+    ``[L, N_kv, nb, bs, D]`` — the DEMOTE copy of the hierarchical KV
+    spill tier (engine/kv_spill.py).  The output is a fresh functional
+    array that owns its data, so the source blocks may return to the
+    free list the moment this gather is *issued*: later pool writes
+    build new pool arrays and can never reach the snapshot, and on
+    donating backends the enqueued gather reads its input before the
+    donated update may alias it.  The device→host pull of the snapshot
+    happens on the spill copier thread, never here."""
+    out = {"k": pool["k"][:, :, blocks], "v": pool["v"][:, :, blocks]}
+    if "ks" in pool:
+        out["ks"] = pool["ks"][:, :, blocks]
+        out["vs"] = pool["vs"][:, :, blocks]
+    return out
+
+
+def scatter_blocks(pool: KVPool, blocks: jax.Array,
+                   tiles: KVPool) -> KVPool:
+    """Write previously gathered ``[L, N_kv, nb, bs, D]`` tiles back
+    into ``blocks`` — the PROMOTE copy of the hierarchical KV spill
+    tier.  The exact inverse of ``gather_blocks`` (bit-identical round
+    trip, int8 scales included), so a promoted prefix serves decode
+    exactly like one that never left the pool."""
+    out = {"k": pool["k"].at[:, :, blocks].set(tiles["k"]),
+           "v": pool["v"].at[:, :, blocks].set(tiles["v"])}
+    if "ks" in pool:
+        out["ks"] = pool["ks"].at[:, :, blocks].set(tiles["ks"])
+        out["vs"] = pool["vs"].at[:, :, blocks].set(tiles["vs"])
+    return out
+
+
+def pool_block_bytes(cfg: ModelConfig, block_size: int,
+                     kv_quantize: str = "none") -> int:
+    """Host bytes one pool block costs when spilled (k + v tiles, plus
+    int8 scales) — the unit ``TierConfig.host_kv_bytes`` budgets in.
+    Shared by the engine's spill accounting and the bench's budget
+    sizing so the two can never drift."""
+    d = cfg.head_dim
+    per_row = cfg.num_layers * cfg.num_kv_heads * block_size
+    if kv_quantize == "int8":
+        # int8 k/v (1 byte) + float32 per-row scales.
+        return per_row * (d * 2 + 4 * 2)
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    return per_row * d * itemsize * 2
+
+
 def chunk_prefill_paged(
     cfg: ModelConfig,
     params: transformer.Params,

@@ -125,7 +125,13 @@ class PrefixCache:
         entry's pool blocks to the allocator (HBM-array entries just get
         garbage-collected; with refcounting a "return" is a decref, so an
         evicted entry whose blocks live slots still share releases only
-        the cache's own reference).
+        the cache's own reference).  Ownership of the evicted entry
+        moves WHOLLY to the sink: the batched engine's sink
+        (``_prefix_evicted``) may DEMOTE an unpinned sole-owner entry to
+        the host-RAM spill tier (engine/kv_spill.py, ISSUE 14) instead
+        of dropping it — eviction is the demotion trigger, and because
+        it removes the entry under this cache's lock BEFORE the sink
+        runs, take/share can never race a demotion.
 
         ``block_refcounts(blocks) -> [int]`` (paged engines: the
         allocator's BATCH refcount reader — one lock acquisition per

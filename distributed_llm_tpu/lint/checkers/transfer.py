@@ -24,6 +24,17 @@ Rules:
   ``jax.`` expression in the closure — an implicit device→host pull
   (and often a fresh host copy) on every tick.  Expressions that
   contain an explicit sync are reported once, as the sync.
+- ``transfer-sync-spill``: the hierarchical-KV specialization (ISSUE
+  14) — a synchronous host copy (``jax.device_get`` /
+  ``block_until_ready`` / ``np.asarray``-style pull) whose argument
+  touches POOL DATA (a name matching ``pool``/``cache``/``kv*``/
+  ``buffer``), in the hot-path closure.  The spill copier worker is the
+  ONLY sanctioned device→host crossing for pool blocks: the scheduler
+  demotes by issuing an async gather snapshot and hands the drain to
+  the copier thread (engine/kv_spill.py), so a sync pool pull reachable
+  from the scheduler ``_loop`` is a reintroduced stall by definition.
+  Classified before the generic rules — the specific finding names the
+  sanctioned alternative.
 - ``transfer-undonated-buffer``: a ``jax.jit``/``pjit`` wrap whose
   function threads a KV/cache/pool buffer (a parameter named ``pool``
   / ``cache`` / ``kv*`` that the function also returns) with no
@@ -56,6 +67,20 @@ PULL_WRAPPERS = {"float", "int", "bool"}
 BUFFER_PARAM_RE = re.compile(r"^(pool|cache|kv\w*|buffer)$")
 
 
+def _touches_pool(expr: ast.expr) -> bool:
+    """Whether the expression references a KV-pool-shaped value (a bare
+    or attribute name matching the buffer pattern: ``pool`` /
+    ``self.pool`` / ``kv*`` / ``cache`` / ``buffer``) — the
+    transfer-sync-spill heuristic for 'this sync pulls pool data'."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and BUFFER_PARAM_RE.match(node.id):
+            return True
+        if isinstance(node, ast.Attribute) \
+                and BUFFER_PARAM_RE.match(node.attr):
+            return True
+    return False
+
+
 def _contains_sync(expr: ast.expr) -> bool:
     for node in ast.walk(expr):
         if isinstance(node, ast.Call) and call_name(node) in SYNC_NAMES:
@@ -78,7 +103,7 @@ def _contains_device_expr(expr: ast.expr) -> bool:
 class TransferChecker(Checker):
     name = "transfer"
     rules = ("transfer-host-sync", "transfer-host-round-trip",
-             "transfer-undonated-buffer")
+             "transfer-sync-spill", "transfer-undonated-buffer")
     scope = ("distributed_llm_tpu/engine", "distributed_llm_tpu/serving",
              "distributed_llm_tpu/obs", "distributed_llm_tpu/ops",
              "distributed_llm_tpu/models", "distributed_llm_tpu/parallel")
@@ -128,6 +153,19 @@ class TransferChecker(Checker):
                 continue
             name = call_name(n)
             if name in SYNC_NAMES:
+                if n.args and _touches_pool(n.args[0]):
+                    # Pool data crossing the host boundary
+                    # synchronously on the hot path: the spill copier
+                    # worker is the only sanctioned crossing.
+                    findings.append(Finding(
+                        "transfer-sync-spill", mod.relpath, n.lineno,
+                        f"`{name}(...)` pulls POOL data to host on the "
+                        f"hot path (via `{gf.qualname}`) — the spill "
+                        f"copier worker (engine/kv_spill.py) is the "
+                        f"only sanctioned device→host crossing for "
+                        f"pool blocks; demote by issuing the async "
+                        f"gather snapshot and let the copier drain it"))
+                    continue
                 findings.append(Finding(
                     "transfer-host-sync", mod.relpath, n.lineno,
                     f"`{name}(...)` on the hot path (reachable from a "
@@ -149,6 +187,19 @@ class TransferChecker(Checker):
             if chain in ("np.asarray", "np.array", "numpy.asarray",
                          "numpy.array"):
                 is_np_pull = True
+                if n.args and _touches_pool(n.args[0]) \
+                        and not _contains_sync(n.args[0]):
+                    # An np pull DIRECTLY over pool data needs no jnp
+                    # call to be a device→host copy — the pool is
+                    # device-resident by construction.
+                    findings.append(Finding(
+                        "transfer-sync-spill", mod.relpath, n.lineno,
+                        f"`{name}(...)` over POOL data on the hot path "
+                        f"(via `{gf.qualname}`) — an implicit sync "
+                        f"device→host copy of pool blocks; the spill "
+                        f"copier worker (engine/kv_spill.py) is the "
+                        f"only sanctioned crossing"))
+                    continue
             elif isinstance(n.func, ast.Name) and name in PULL_WRAPPERS:
                 is_np_pull = True
             if is_np_pull and n.args \

@@ -435,8 +435,41 @@ class ServingMetrics:
             "dllm_prefix_hits_total",
             "Prefix-cache lookup outcomes on the batched admit path, "
             "per admission attempt (shared = pinned read-only mapping, "
-            "exclusive = take-ownership reuse, miss = cold prefill)",
+            "exclusive = take-ownership reuse, host = spill-tier "
+            "promotion claim, miss = cold prefill)",
             ("tier", "kind"))
+        # Hierarchical-KV spill family (ISSUE 14, engine/kv_spill.py):
+        # the host tier's occupancy and the demote/promote lifecycle —
+        # warm TTFT as a function of host-RAM size must be observable,
+        # and a promotion losing its race must be countable.
+        self.kv_host_blocks_g = registry.gauge(
+            "dllm_kv_host_blocks",
+            "Pool-block equivalents of demoted prefix KV resident in "
+            "the host spill tier (sampled)", ("tier",))
+        self.kv_host_bytes_g = registry.gauge(
+            "dllm_kv_host_bytes",
+            "Host bytes held by the KV spill tier against "
+            "TierConfig.host_kv_bytes (sampled)", ("tier",))
+        self.kv_promote_backlog_g = registry.gauge(
+            "dllm_kv_promote_backlog",
+            "Blocks the in-flight promotion still has to land "
+            "host→device (sampled; 0 = no promotion in flight)",
+            ("tier",))
+        self.kv_demotions = registry.counter(
+            "dllm_kv_demotions_total",
+            "Prefix-cache evictions demoted to the host spill tier "
+            "(copy landed; the async device→host copy drains on the "
+            "spill copier, never the tick)", ("tier",))
+        self.kv_promotions = registry.counter(
+            "dllm_kv_promotions_total",
+            "Demoted prefixes promoted back to the device pool "
+            "(budgeted host→device grants riding the chunked-prefill "
+            "lane)", ("tier",))
+        self.kv_promotion_races = registry.counter(
+            "dllm_kv_promotion_races_total",
+            "Promotions that lost the race (entry invalidated / copier "
+            "stalled) and fell back to a byte-identical cold prefill",
+            ("tier",))
         self.tier_draining_g = registry.gauge(
             "dllm_tier_draining",
             "1 while the tier is gracefully draining, else 0 (sampled)",

@@ -71,9 +71,13 @@ from typing import Any, Dict, List, Optional
 
 # Canonical phase taxonomy (DESIGN.md "Tick forensics").  The profiler
 # accepts any name — this tuple is the documented set the engine stamps
-# and the bench table orders by.
+# and the bench table orders by.  ``demote``/``promote`` (ISSUE 14) are
+# the hierarchical-KV spill tier's dispatch costs: the async gather
+# snapshot of an evicted prefix and the host→device write-back grants —
+# the device↔host DRAIN itself lives on the copier thread and never
+# stamps a tick phase.
 PHASES = ("admit", "prefill", "cow_copy", "table_upload", "decode",
-          "emit", "chunk_prefill")
+          "emit", "chunk_prefill", "demote", "promote")
 
 DEFAULT_CAPACITY = 512
 EVENT_CAPACITY = 512

@@ -57,6 +57,9 @@ _GAUGE_FIELDS = (
     ("kv_reclaimable_blocks", "kv_reclaimable_blocks_g"),
     ("kv_shared_blocks", "kv_shared_blocks_g"),
     ("kv_dedup_ratio", "kv_dedup_ratio_g"),
+    ("kv_host_blocks", "kv_host_blocks_g"),
+    ("kv_host_bytes", "kv_host_bytes_g"),
+    ("kv_promote_backlog", "kv_promote_backlog_g"),
     ("prefill_backlog_tokens", "prefill_backlog_g"),
     ("draining", "tier_draining_g"),
     ("decode_tick_p50_ms", "decode_tick_p50_g"),

@@ -286,6 +286,20 @@ class ReplicaSetManager:
         out["block_size"] = first.get("block_size")
         out["dedup_ratio"] = max(float(r.get("dedup_ratio", 1.0))
                                  for r in reps.values())
+        # Hierarchical-KV spill tier (ISSUE 14): host-tier occupancy and
+        # demote/promote counters sum like the pool fields, but only
+        # when some replica actually runs a spill tier — a spill-less
+        # tier's aggregate keeps its historical shape.  (Affinity
+        # already treats a replica's DEMOTED entries as eligible: the
+        # per-engine prefix_affinity_tokens peek consults the spill
+        # store, so a session follows its spilled prefix home.)
+        spill_keys = ("host_entries", "host_blocks", "host_bytes",
+                      "host_budget_bytes", "demotions_total",
+                      "promotions_total", "promotion_races_total",
+                      "demote_inflight", "promote_backlog_blocks")
+        for k in spill_keys:
+            if any(k in r for r in reps.values()):
+                out[k] = sum(int(r.get(k, 0)) for r in reps.values())
         out["replicas"] = reps
         return out
 

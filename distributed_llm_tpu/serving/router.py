@@ -479,6 +479,15 @@ class Router:
                 st["kv_shared_blocks"] = ks.get("shared_blocks", 0)
                 st["kv_dedup_ratio"] = ks.get("dedup_ratio", 1.0)
                 st["preempted_total"] = ks.get("preempted_total", 0)
+                # Hierarchical-KV spill tier (ISSUE 14): host-side
+                # occupancy + promotion backlog ride the timeline so a
+                # degraded warm-hit rate is diagnosable from the same
+                # flight-recorder slice as the pool pressure it caused.
+                if "host_blocks" in ks:
+                    st["kv_host_blocks"] = ks.get("host_blocks")
+                    st["kv_host_bytes"] = ks.get("host_bytes")
+                    st["kv_promote_backlog"] = ks.get(
+                        "promote_backlog_blocks", 0)
                 # Chunked-prefill backlog (PR 9): prompt tokens of
                 # the in-flight prefill not yet absorbed — the
                 # dllm_prefill_backlog gauge's source series.

@@ -52,12 +52,19 @@ from typing import Any, Dict, List, Optional, Tuple
 # closed-loop ratio) joined the pinned set in r15: both are the
 # load-bearing wins of their PRs, and a silent drift back toward 1.0
 # would mean the dedup or the replica layer quietly stopped working.
+# spill.warm_hit_rate (ISSUE 14's revisits-served-warm fraction at the
+# large host budget — the spilled-prefix win itself) and
+# spill.tbt_ratio (a live co-tenant stream's inter-token-gap p95,
+# spill-on(large) / spill-off — a drift past ~1.05 means promotions
+# started stalling the decode stream next to them) joined in r16.
 PINNED: Tuple[Tuple[str, bool], ...] = (
     ("trend_req_per_s", True),
     ("skew_tick_ratio", False),
     ("openloop.knee", True),
     ("shared.peak_ratio", False),
     ("replica.speedup", True),
+    ("spill.warm_hit_rate", True),
+    ("spill.tbt_ratio", False),
 )
 
 # Context rows printed (no flags): the headline and accuracy travel
@@ -89,6 +96,8 @@ _PATHS: Dict[str, Tuple[Tuple[str, ...], ...]] = {
     "mixed.tbt95_ratio": (("mixed", "tbt95_ratio"),
                           ("mixed", "chunked", "tbt95_ratio")),
     "shared.peak_ratio": (("shared", "peak_ratio"),),
+    "spill.warm_hit_rate": (("spill", "warm_hit_rate"),),
+    "spill.tbt_ratio": (("spill", "tbt_ratio"),),
     "replica.speedup": (("replica", "speedup"),
                         ("replica", "closed_loop_speedup"),),
     "replica.aff_ret": (("replica", "aff_ret"),

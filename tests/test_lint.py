@@ -1545,6 +1545,58 @@ def test_transfer_sync_inside_lambda_on_hot_path_flagged():
     assert "transfer-host-sync" in _rules(result), result.findings
 
 
+def test_transfer_sync_spill_pool_pull_on_scheduler_loop_flagged():
+    """The ISSUE 14 rule: a synchronous host copy of POOL data reachable
+    from the scheduler `_loop` hot path — the spill copier worker is the
+    only sanctioned device→host crossing for pool blocks.  Both the
+    explicit-sync and the np-pull shapes classify as the SPECIFIC rule
+    (never the generic transfer-host-sync), so the finding names the
+    sanctioned alternative."""
+    from distributed_llm_tpu.lint.checkers.transfer import TransferChecker
+    src = """
+        import jax
+        import numpy as np
+
+        class Engine:
+            def _loop(self):    # dllm-lint: hot-path
+                while True:
+                    self._demote()
+
+            def _demote(self):
+                host = jax.device_get(self.pool["k"][:, :, self.victim])
+                spare = np.asarray(self.pool["v"][:, :, self.victim])
+                self.store.append((host, spare))
+    """
+    result = _lint(TransferChecker(), {ENGINE: src})
+    assert _rules(result) == ["transfer-sync-spill",
+                              "transfer-sync-spill"], result.findings
+    assert "copier" in result.findings[0].message
+
+
+def test_transfer_sync_spill_near_miss_copier_worker_clean():
+    """Near-miss: the SANCTIONED shape — the scheduler issues the async
+    gather snapshot (no sync) and the device→host pull lives on the
+    copier worker, a thread target outside the hot-path closure.  Must
+    stay silent, and so must the existing sanctioned non-pool syncs
+    (first-token block_until_ready under its justification)."""
+    from distributed_llm_tpu.lint.checkers.transfer import TransferChecker
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        def _loop(self):    # dllm-lint: hot-path
+            while True:
+                tiles = self._gather(self.pool, self.victim)  # async snap
+                self.jobs.put(tiles)
+
+        def _copier_loop(self):
+            while True:
+                tiles = self.jobs.get()
+                self.store.append(jax.device_get(tiles))
+    """
+    assert _lint(TransferChecker(), {ENGINE: src}).findings == []
+
+
 def test_transfer_undonated_buffer_flagged_and_donated_clean():
     from distributed_llm_tpu.lint.checkers.transfer import TransferChecker
     bad = """
