@@ -303,6 +303,27 @@ def compact(result: dict) -> dict:
         out["skew_tick_p50_ms"] = {
             m: (sk.get(m) or {}).get("decode_tick_p50_ms")
             for m in ("dense", "ragged") if isinstance(sk.get(m), dict)}
+    sp_dec = result.get("spec_phase")
+    if isinstance(sp_dec, dict):
+        # One number each (BENCHMARKS.md r17): the judged spec-on/off
+        # decode tok/s ratio (≥1.0 = speculation pays on this config),
+        # both modes' tok/s, the aggregate + per-slot acceptance, the
+        # compiled verify-program count vs its (γ_bucket) family bound,
+        # and the cross-mode byte-identity re-check.
+        on = sp_dec.get("on") or {}
+        off = sp_dec.get("off") or {}
+        cm = {k: v for k, v in {
+            "tok_ratio": sp_dec.get("tok_ratio"),
+            "wall_ratio": sp_dec.get("wall_tok_ratio"),
+            "tok_on": on.get("tok_per_s"),
+            "tok_off": off.get("tok_per_s"),
+            "accept": on.get("accept_ratio"),
+            "slot_accept": on.get("per_slot_accept"),
+            "verify_programs": on.get("verify_programs"),
+            "ident": sp_dec.get("outputs_identical"),
+        }.items() if v is not None}
+        if cm:
+            out["spec"] = cm
     mx = result.get("mixed")
     if isinstance(mx, dict):
         # One number each (BENCHMARKS.md r12): the chunked short-class
@@ -1057,6 +1078,136 @@ def skew_phase(n_requests: int = 32, beat=lambda: None) -> dict:
         len(token_ids.get("dense", ())) == n_requests
         and len(token_ids.get("ragged", ())) == n_requests
         and token_ids["dense"] == token_ids["ragged"])
+    return out
+
+
+def spec_phase(n_requests: int = 16, gamma_max: int = 12,
+               beat=lambda: None) -> dict:
+    """Batched-speculation leg (ISSUE 15): the skew prompt mix on the
+    pinned tiny nano tier, spec-ON (draft_test — ~1/8 the target's
+    per-step compute at shared vocab/context) against spec-OFF at the
+    same seed, same prompts, engines warmed.  NOTE on acceptance: both
+    models are random-init on the trend config and tiny random models
+    decode into degenerate repeats, so measured acceptance sits near
+    1.0 — flattering vs trained-model reality.  The leg's job is the
+    MECHANISM (γ drafts per slot verified in one fused ragged call,
+    byte-identity, the bounded program family) and a regression-pinned
+    ratio on a fixed config, not a claim about trained acceptance.
+
+    Hard invariants (``error``, not log lines): greedy outputs must be
+    byte-identical across modes, and the compiled verify-program count
+    must equal the (γ_bucket) family size — per-slot γ adaptation and
+    acceptance lengths are runtime operands, so ANY extra verify mint
+    is a retrace bug.  The judged number is ``tok_ratio`` (spec-on
+    decode tok/s ÷ spec-off, higher-better, bar ≥1.0 on this config —
+    pinned cross-round by scripts/bench_trend.py as ``spec.tok_ratio``)
+    with the aggregate and per-slot acceptance rates alongside; a real
+    smaller-draft deployment changes acceptance, not the mechanics."""
+    import dataclasses
+    import sys
+
+    from distributed_llm_tpu.config import tiny_batched_cluster
+    from distributed_llm_tpu.engine.batching import ContinuousBatchingEngine
+
+    print("[bench] batched speculation leg", file=sys.stderr, flush=True)
+    base = dataclasses.replace(tiny_batched_cluster().nano,
+                               max_new_tokens=24,
+                               enable_prefix_cache=False)
+    short_q = "short question about rivers please"
+    long_q = ("long question: " + "rivers lakes mountains oceans deltas "
+              * 16)
+    prompts = [(short_q if i % 2 else long_q) + f" variant {i}"
+               for i in range(n_requests)]
+    out: dict = {"decode_batch": base.decode_batch,
+                 "requests": n_requests,
+                 "gamma_max": gamma_max,
+                 "draft_preset": "draft_test",
+                 "steps_per_tick": base.decode_steps_per_tick}
+
+    token_ids: dict = {}
+    for mode, on in (("off", False), ("on", True)):
+        tier = dataclasses.replace(
+            base, spec_decode=on,
+            draft_preset="draft_test" if on else None,
+            spec_gamma_max=gamma_max)
+        eng = ContinuousBatchingEngine(tier, seed=7)
+        try:
+            eng.warmup()
+            eng.generate(long_q, max_new_tokens=24)
+            eng.generate(short_q, max_new_tokens=24)
+            beat()
+            eng.tick_ms.clear()
+            t0 = time.perf_counter()
+            reqs = [eng.submit(p) for p in prompts]
+            for r in reqs:
+                r.done.wait(timeout=300)
+            wall = time.perf_counter() - t0
+            errors = sum(1 for r in reqs if r.error is not None)
+            token_ids[mode] = [tuple(r.result.token_ids)
+                               for r in reqs if r.result is not None]
+            gen_tokens = sum(r.result.gen_tokens for r in reqs
+                             if r.result is not None)
+            ttfts = sorted(r.result.ttft_ms for r in reqs
+                           if r.result is not None)
+            # DECODE tok/s — the judged quantity: tokens over the decode
+            # ticks' device wall (the tick ring), which is where
+            # speculation acts.  The end-to-end wall additionally pays
+            # each admission's prefill — spec-on seeds the draft there,
+            # a TTFT cost reported explicitly below, not smuggled into
+            # the decode ratio (nor hidden from it: at this tiny scale
+            # prefill+host machinery is ~90% of wall for BOTH modes and
+            # would dilute any decode-side effect toward 1.0).
+            decode_s = sum(eng.tick_ms) / 1000.0
+            st = eng.spec_stats()
+            out[mode] = {
+                "tok_per_s": round(gen_tokens / max(decode_s, 1e-9), 3),
+                "wall_tok_per_s": round(gen_tokens / max(wall, 1e-9), 3),
+                "req_per_s": round(n_requests / max(wall, 1e-9), 4),
+                "ttft_p50_ms": round(_pct(ttfts, 0.5), 2) if ttfts else None,
+                "gen_tokens": gen_tokens,
+                "decode_s": round(decode_s, 4),
+                "ticks": len(eng.tick_ms),
+                "errors": errors,
+                "accept_ratio": st["accept_ratio"],
+                "drafted_total": st["drafted_total"],
+                "accepted_total": st["accepted_total"],
+                "per_slot_accept": {ix: s["ratio"]
+                                    for ix, s in st["per_slot"].items()},
+                "verify_programs": len(eng._compiled.get("verify", ())),
+                "gamma_buckets": st["gamma_buckets"],
+            }
+            if on and not errors:
+                family = len(eng._gamma_buckets)
+                minted = len(eng._compiled.get("verify", ()))
+                if minted > family:
+                    out["error"] = (
+                        f"verify compile churn: {minted} verify "
+                        f"program(s) minted for a (γ_bucket) family of "
+                        f"{family} — per-acceptance-length retrace")
+        finally:
+            eng.stop()
+        beat()
+    t_on = (out.get("on") or {}).get("tok_per_s")
+    t_off = (out.get("off") or {}).get("tok_per_s")
+    if t_on and t_off:
+        out["tok_ratio"] = round(t_on / t_off, 3)
+    w_on = (out.get("on") or {}).get("wall_tok_per_s")
+    w_off = (out.get("off") or {}).get("wall_tok_per_s")
+    if w_on and w_off:
+        # End-to-end context (NOT the judged number): includes both
+        # modes' admission prefills — spec-on's draft seeding shows up
+        # here and in the per-mode ttft_p50_ms.
+        out["wall_tok_ratio"] = round(w_on / w_off, 3)
+    # Byte-identity across modes is the speculative guarantee itself:
+    # NOT vacuous (every request must have a result in both modes), and
+    # divergence hard-fails the leg.
+    out["outputs_identical"] = (
+        len(token_ids.get("off", ())) == n_requests
+        and len(token_ids.get("on", ())) == n_requests
+        and token_ids["off"] == token_ids["on"])
+    if not out["outputs_identical"] and "error" not in out:
+        out["error"] = ("speculative outputs diverged from plain greedy "
+                        "decode — the acceptance rule is broken")
     return out
 
 
@@ -3125,6 +3276,22 @@ def run(progress: "Progress" = None, budget: "Budget" = None) -> dict:
     progress.section("skew", skew)
     progress.flush_compact()
 
+    # Batched-speculation leg right after the skew leg (same pinned
+    # tiny-batched family, same prompt mix): spec-on (draft_test drafts,
+    # fused ragged verify, adaptive γ) vs spec-off at the same seed —
+    # decode tok/s ratio (bar ≥1.0), acceptance aggregate + per-slot,
+    # byte-identity and the verify-program family bound are hard
+    # invariants (ISSUE 15; BENCHMARKS.md r17 "spec leg" semantics).
+    if budget.allows(60):
+        try:
+            spec_dec = spec_phase(beat=progress.beat)
+        except Exception as exc:          # never lose the headline line
+            spec_dec = {"error": str(exc)[:200]}
+    else:
+        spec_dec = {"skipped": budget.skip_stamp()}
+    progress.section("spec_phase", spec_dec)
+    progress.flush_compact()
+
     # Mixed-phase chunked-prefill leg right after the skew leg (ISSUE 9;
     # mini_bench so the prefill stall is physically visible): a
     # 1792-bucket prompt injected mid-stream next to a short stream,
@@ -3493,6 +3660,7 @@ def run(progress: "Progress" = None, budget: "Budget" = None) -> dict:
         "chaos": chaos,
         "pressure": pressure,
         "skew": skew,
+        "spec_phase": spec_dec,
         "openloop": openloop,
         "knee_req_per_s": openloop.get("knee_req_per_s"),
         "goodput_at_knee": openloop.get("goodput_at_knee"),

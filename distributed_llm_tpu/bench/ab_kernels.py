@@ -298,6 +298,44 @@ def micro_ab(tier_name: str = "orin", repeat: int = 20,
                        RA.ragged_paged_decode_attention_q8,
                        (q, kq, vq, ksc, vsc, tables, pos), {"batch": b})
 
+        # ragged speculative verify (ISSUE 15): the q_len=γ+1 extension
+        # of the ragged decode case — same skewed per-slot lengths, a
+        # γ+1 verify chunk per slot ending at the slot's frontier (the
+        # chunk's own K/V already written, write-before-attend, so the
+        # queries attend real content like a serving verify tick).
+        for b in batches[1:]:
+            if not (want("ragged_verify") or want("ragged_verify_q8")):
+                break
+            g = 5                                  # γ=4, the preset default
+            nb = b * (s // bs) + 1
+            kp = jax.random.normal(key, (nkv, nb, bs, d), bf16)
+            vp = jax.random.normal(key, (nkv, nb, bs, d), bf16)
+            tables = jnp.asarray(
+                np.arange(b * (s // bs), dtype=np.int32).reshape(b, s // bs))
+            # First-query positions: the slot's skewed frontier minus the
+            # chunk (clamped non-negative) — verify masks per query row.
+            pos = jnp.asarray([max(0, s * (i + 1) // b - g)
+                               for i in range(b)], jnp.int32)
+            q = jax.random.normal(key, (b, g, nq, d), bf16)
+            if want("ragged_verify"):
+                record("ragged_verify", s, A.ragged_verify,
+                       (q, kp, vp, tables, pos),
+                       RA.ragged_paged_verify_attention,
+                       (q, kp, vp, tables, pos), {"batch": b, "g": g})
+
+            if want("ragged_verify_q8"):
+                kq, ksc = _qkv(kp)
+                vq, vsc = _qkv(vp)
+                record("ragged_verify_q8", s,
+                       lambda *a: A.ragged_verify(a[0], a[1], a[2], a[5],
+                                                  a[6], impl="xla",
+                                                  k_scale=a[3],
+                                                  v_scale=a[4]),
+                       (q, kq, vq, ksc, vsc, tables, pos),
+                       RA.ragged_paged_verify_attention_q8,
+                       (q, kq, vq, ksc, vsc, tables, pos),
+                       {"batch": b, "g": g})
+
         # paged chunk prefill (prefix-reuse admissions — engine/paged_kv.
         # chunk_prefill_paged): one 128-token suffix attending through a
         # slot's block table over a window of this length.

@@ -202,6 +202,14 @@ MODEL_PRESETS: Dict[str, ModelConfig] = {
         name="nano_test", hidden_size=64, num_layers=2, num_heads=4,
         num_kv_heads=2, ffn_size=128, max_seq_len=256,
     ),
+    # Speculative DRAFT for the test/trend tiers (ISSUE 15): ~1/8 of
+    # nano_test's per-step compute at the same vocab/context, so the
+    # batched spec leg and the unit suite exercise a genuinely
+    # cheaper-draft configuration on CPU.
+    "draft_test": ModelConfig(
+        name="draft_test", hidden_size=32, num_layers=1, num_heads=4,
+        num_kv_heads=2, ffn_size=64, max_seq_len=256,
+    ),
     "moe_test": ModelConfig(
         name="moe_test", hidden_size=64, num_layers=2, num_heads=4,
         num_kv_heads=2, ffn_size=128, max_seq_len=256, num_experts=4,
@@ -357,9 +365,38 @@ class TierConfig:
     # deterministic random init (utils/checkpoint.py load_params_for_tier).
     checkpoint_path: Optional[str] = None
     # Model preset to draft with for speculative decoding (greedy-exact;
-    # engine/speculative.py).  None = plain decoding.
+    # engine/speculative.py sequential, engine/batching.py batched).
+    # None = plain decoding.  The tier's own model_preset is the valid
+    # zero-extra-weights SELF-DRAFT for the batched path (draft params
+    # shared with the target; acceptance approaches 1 and the win is
+    # the fused γ+1-token verify amortizing per-tick dispatch).
     draft_preset: Optional[str] = None
     speculative_gamma: int = 4
+    # Batched speculative decoding (engine/batching.py, ISSUE 15): with
+    # a draft_preset and decode_batch>1, each scheduler tick drafts γ
+    # tokens per active slot with the draft model (its own paged pool
+    # behind the SAME block tables), verifies every slot's γ+1 chunk in
+    # ONE fused ragged_verify call (ops/ragged_attention.py — the
+    # ragged kernel's q_len=γ+1 face), applies per-slot greedy
+    # acceptance, and rewinds rejected tails' block frontiers (never
+    # mutating shared/parked blocks — COW first, like admit).  Greedy
+    # outputs stay byte-identical to plain decode.  Tri-state: None
+    # (default) = AUTO — EngineManager arms it when a tier configures
+    # draft_preset with decode_batch>1 (the PR 1 bypass retired —
+    # speculation no longer forces the sequential engine; the bench
+    # spec leg's tok/s bar was met at 2.0×, BENCHMARKS.md r17); True =
+    # engine-level force-on (tests/bench construct engines directly);
+    # False = the operator KILL SWITCH — a draft tier keeps its config
+    # but serves plain batched decode.  Requires the fused ragged tick;
+    # unsharded greedy tiers only.
+    spec_decode: Optional[bool] = None
+    # Per-slot adaptive γ cap for batched speculation: slots start at
+    # this γ and an acceptance-rate EWMA scales each slot down
+    # (ultimately to γ=0 = plain ragged decode for low-acceptance
+    # tenants, sticky per request).  The compiled draft/verify program
+    # family is the power-of-two bucket ladder up to this value —
+    # bounded by config, never by observed acceptance lengths.
+    spec_gamma_max: int = 4
     # Session KV prefix reuse (engine/prefix_cache.py): park each request's
     # KV cache and re-prefill only the suffix when the next prompt extends
     # it (multi-turn chats).  For DENSE models this is the same math as a
@@ -518,6 +555,12 @@ class TierConfig:
 
     def model(self) -> ModelConfig:
         return MODEL_PRESETS[self.model_preset]
+
+    def draft_model(self) -> ModelConfig:
+        """The speculative draft's architecture (``draft_preset``) —
+        raises KeyError when none is configured, like ``model()`` would
+        on a bad preset."""
+        return MODEL_PRESETS[self.draft_preset]
 
 
 @dataclasses.dataclass(frozen=True)

@@ -216,7 +216,24 @@ CONFIG_FIELDS: Dict[str, str] = {
     "TierConfig.draft_preset": "Draft model preset for speculative "
                                "decoding; None = plain decoding.",
     "TierConfig.speculative_gamma": "Draft tokens proposed per "
-                                    "speculative round.",
+                                    "speculative round (sequential "
+                                    "decode_batch=1 engine).",
+    "TierConfig.spec_decode": "Batched speculative decoding on the "
+                              "ragged paged kernel (decode_batch>1 + "
+                              "draft_preset): per-slot drafts verified "
+                              "in ONE fused ragged_verify call, greedy "
+                              "acceptance, rejected-tail frontier "
+                              "rewind; byte-identical greedy outputs. "
+                              "Tri-state: None=AUTO (EngineManager arms "
+                              "it on batched draft tiers), True=force "
+                              "on, False=operator kill switch (draft "
+                              "tier serves plain batched decode).",
+    "TierConfig.spec_gamma_max": "Per-slot adaptive γ cap for batched "
+                                 "speculation: slots start here, an "
+                                 "acceptance EWMA scales each down "
+                                 "(γ=0 = plain ragged decode); the "
+                                 "compiled draft/verify family is the "
+                                 "power-of-two bucket ladder up to it.",
     "TierConfig.enable_prefix_cache": "Park finished requests' KV for "
                                       "suffix-only re-prefill "
                                       "(multi-turn chats).",

@@ -53,7 +53,7 @@ from .inference import (GenerationResult, prepare_prompt, trim_at_eos,
                         upgrade_attention_impl)
 from .paged_kv import (BlockAllocator, PagedConfig, TRASH_BLOCK,
                        chunk_prefill_paged, decode_step_paged, init_pool,
-                       write_prefill_blocks)
+                       verify_step_paged, write_prefill_blocks)
 from .tokenizer import get_tokenizer
 
 History = Union[str, Sequence[Dict[str, Any]]]
@@ -80,6 +80,27 @@ def _sample_batched(logits: jax.Array, rng: jax.Array,
     scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
     sampled = jax.random.categorical(rng, scaled, axis=-1)
     return jnp.where(temps > 0.0, sampled, greedy)
+
+
+def _fetch_tick(x):
+    """THE tick boundary's one sanctioned device sync: pull a tick's
+    device results to host in one blocking call — shared by the plain
+    decode tick ([T, B] tokens) and the speculative round's verify
+    outputs ((out, n_acc)), so the hot path has exactly ONE sync site
+    and every other host round-trip must justify itself against it.
+    ``tree_map`` makes the numpy pull cover either pytree shape."""
+    # dllm-lint: disable=transfer-host-sync -- THE one sanctioned sync per tick: the tick boundary, where all of a tick's tokens become observable in one pull (plain [T,B] or speculative (out, n_acc)) — every other hot-path sync must justify itself against this one
+    return jax.tree_util.tree_map(np.asarray, jax.block_until_ready(x))
+
+
+# Per-slot acceptance-rate-adaptive γ (ISSUE 15): EWMA weight of a
+# round's observed acceptance, and the floor under which a slot stops
+# speculating entirely (γ=0 — it rides the verify's first row only, i.e.
+# plain ragged decode, burning zero draft/verify width).  γ=0 is sticky
+# for the slot's lifetime: with no drafts there is no new acceptance
+# evidence, and a fresh request starts optimistic again.
+SPEC_EWMA_ALPHA = 0.3
+SPEC_EWMA_FLOOR = 0.125
 
 
 @dataclasses.dataclass
@@ -137,6 +158,17 @@ class _Slot:
     # block references themselves drop through the allocator's uniform
     # refcounted free().
     pinned_entry: Optional[Any] = None
+    # Batched speculative decoding (ISSUE 15): whether this slot's draft
+    # KV was seeded (monolithic cold prefill / prefix-hit suffix chunk /
+    # replay — chunked and host-promoted admissions skip the draft pass,
+    # so their drafts would attend garbage), its current adaptive γ
+    # (0 = degraded to plain ragged decode, sticky), the acceptance
+    # EWMA driving γ, and lifetime draft/accept counts for spec_stats.
+    spec: bool = False
+    gamma: int = 0
+    accept_ewma: float = 1.0
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
 
 @dataclasses.dataclass
@@ -427,6 +459,63 @@ class ContinuousBatchingEngine:
         self.share_prefix = bool(tier.share_prefix_kv
                                  and self.prefix_cache is not None)
         self._cow_fn = None
+        # Batched speculative decoding (ISSUE 15): a small per-tier
+        # draft model rides the SAME block tables as the target — its
+        # own paged pool, indexed by the same block ids, so slot/block
+        # lifecycle (admission, growth, parking, preemption, COW) is
+        # bookkept once.  Each speculative tick drafts γ tokens per
+        # slot (one scanned device call on the draft), verifies all
+        # slots' chunks in ONE fused ragged_verify call on the target,
+        # applies per-slot greedy acceptance, and rewinds rejected
+        # tails' block frontiers.  Draft KV quality only moves the
+        # acceptance rate — byte-identity to plain greedy decode is the
+        # verify rule's, never the draft's.
+        self.spec = False
+        self.cfg_d = None
+        self.params_d = None
+        self.pool_d = None
+        self._cow_fn_d = None
+        self.spec_gamma_max = max(1, int(tier.spec_gamma_max))
+        self._spec_fns: Dict[Any, Any] = {}
+        self.spec_drafted_total = 0
+        self.spec_accepted_total = 0
+        # Per-SLOT-INDEX lifetime draft/accept accumulators (bounded by
+        # max_slots): the bench spec leg reports per-slot acceptance so
+        # a skewed mix's low-acceptance tenant is visible next to the
+        # aggregate ratio.
+        self._spec_slot_acc: Dict[int, List[int]] = {}
+        if tier.spec_decode and self._resolve_spec():
+            self.spec = True
+            dcfg = tier.draft_model()
+            self.cfg_d = upgrade_attention_impl(dcfg, None)
+            if tier.draft_preset == tier.model_preset:
+                # Self-draft: the draft IS the target (weights shared,
+                # zero extra parameter memory) — acceptance approaches
+                # 1.0 and the tick's win is the fused γ+1-token verify
+                # amortizing the per-tick dispatch.  The bench's spec
+                # leg measures this configuration; a genuinely smaller
+                # draft_preset swaps in transparently.
+                self.params_d = self.params
+            else:
+                init_d = jax.jit(partial(models.init_params, self.cfg_d),
+                                 static_argnames=("seed",))
+                from ..ops.quant import maybe_quantize as _mq
+                self.params_d = _mq(init_d(seed=seed + 1), tier, self.cfg_d)
+            # Draft pool: same geometry (block count/size) as the target
+            # pool so the target's block tables index it directly.
+            self.pool_d = init_pool(self.cfg_d, self.paged, tier.kv_quantize)
+            from ..utils import roofline as _roofline
+            self._wbytes_d = _roofline.weight_bytes(self.cfg_d,
+                                                    tier.quantize)
+        # Bounded γ program family: powers of two up to spec_gamma_max
+        # (plus the max itself) — a speculative tick buckets the active
+        # slots' max γ up to one of these, so the compiled draft/verify
+        # program count is the bucket count, never per-γ or
+        # per-acceptance-length.
+        gmax = self.spec_gamma_max
+        self._gamma_buckets = tuple(sorted(
+            {1 << i for i in range(gmax.bit_length()) if (1 << i) <= gmax}
+            | {gmax}))
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         # Scheduler-head requeue lane: KV-pressure deferrals and preempted
         # requests go back to the FRONT (appendleft), so a starved elder
@@ -499,6 +588,71 @@ class ContinuousBatchingEngine:
         span = self.paged.blocks_per_slot * self.paged.block_size
         return attn_ops._choose(self.cfg.attention_impl, kind,
                                 span) == "pallas"
+
+    def _resolve_spec(self) -> bool:
+        """Whether ``TierConfig.spec_decode`` can actually arm batched
+        speculation on this engine.  Requirements, each logged when it
+        blocks: a ``draft_preset`` (the drafting model — the target's
+        own preset is the zero-extra-weights self-draft), the fused
+        ragged tick (the verify call IS the ragged kernel's q_len=γ+1
+        face; the dense windowed tick has no verify shape), no TP mesh
+        (same rule as ragged), a greedy tier default (per-REQUEST
+        temperature>0 just degrades that slot to γ=0; a sampled tier
+        default would degrade every slot, so it reads as
+        misconfiguration), and a draft context covering the target's
+        (positions are the target's)."""
+        tier = self.tier
+        if not tier.draft_preset:
+            logger.warning("tier %s: spec_decode=True ignored — no "
+                           "draft_preset configured", tier.name)
+            return False
+        if not self.ragged or self.mesh is not None:
+            logger.warning(
+                "tier %s: spec_decode=True ignored — batched speculation "
+                "needs the fused ragged tick (ragged=%s, mesh=%s)",
+                tier.name, self.ragged, self.mesh is not None)
+            return False
+        if (tier.temperature or 0) > 0:
+            logger.warning(
+                "tier %s: spec_decode=True ignored — the tier default "
+                "temperature=%s would degrade every slot to γ=0 "
+                "(speculation is greedy-exact; per-request sampling "
+                "rides the verify's sampled first row)",
+                tier.name, tier.temperature)
+            return False
+        dcfg = tier.draft_model()
+        if dcfg.vocab_size != self.cfg.vocab_size:
+            logger.warning(
+                "tier %s: spec_decode=True ignored — draft_preset=%s "
+                "vocab %d != target vocab %d",
+                tier.name, tier.draft_preset, dcfg.vocab_size,
+                self.cfg.vocab_size)
+            return False
+        if dcfg.max_seq_len < self.cfg.max_seq_len:
+            logger.warning(
+                "tier %s: spec_decode=True ignored — draft_preset=%s "
+                "max_seq_len %d < target %d (drafts run at the "
+                "target's positions)",
+                tier.name, tier.draft_preset, dcfg.max_seq_len,
+                self.cfg.max_seq_len)
+            return False
+        return True
+
+    def _gamma_bucket(self, g: int) -> int:
+        """Smallest registered γ bucket covering ``g`` — the static
+        q-length the speculative tick compiles at (runtime per-slot γ
+        caps acceptance INSIDE the program, so slot-level adaptation
+        never mints a new one)."""
+        return next(b for b in self._gamma_buckets if b >= g)
+
+    def _adapt_gamma(self, ewma: float) -> int:
+        """Acceptance EWMA → the slot's next γ: proportional scaling
+        with a floor at 0 (degrade to plain ragged decode — the verify's
+        first row only) once acceptance stops paying for draft FLOPs."""
+        if ewma < SPEC_EWMA_FLOOR:
+            return 0
+        return max(1, min(self.spec_gamma_max,
+                          int(ewma * self.spec_gamma_max + 0.5)))
 
     # -- compiled stages ---------------------------------------------------
 
@@ -660,6 +814,150 @@ class ContinuousBatchingEngine:
             self._cow_fn = jax.jit(copy_block, donate_argnums=donate, **kw)
         return self._cow_fn
 
+    def _cow_copy_fn_d(self):
+        """Draft-pool twin of ``_cow_copy_fn``: the COW boundary copy
+        must land in BOTH pools (the draft attends the same block
+        tables), and the draft pool's layer/head shape differs, so it
+        is its own single compiled program in the same bounded
+        block-write family."""
+        if self._cow_fn_d is None:
+            from .paged_kv import copy_block
+            self._note_compile("writer", "cow_copy_draft")
+            donate = (0,) if jax.default_backend() != "cpu" else ()
+            self._cow_fn_d = jax.jit(copy_block, donate_argnums=donate)
+        return self._cow_fn_d
+
+    def _draft_prefill_fn(self, bucket: int):
+        """Per bucket: the DRAFT model's prompt forward — K/V only, no
+        sampling (the target's prefill picks the first token; the draft
+        just needs its own prefix KV to draft against).  Same bounded
+        per-bucket family as the target prefill, under the "draft"
+        compile stage."""
+        key = ("draft_prefill", bucket)
+        if key in self._spec_fns:
+            return self._spec_fns[key]
+        self._note_compile("draft", ("prefill", bucket))
+        cfg_d = self.cfg_d
+        from ..parallel.tp_attention import tp_prefill_attn
+        attn = tp_prefill_attn(None, cfg_d, bucket)
+
+        def run(params_d, tokens):
+            b, s = tokens.shape
+            positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+            _, (k_all, v_all) = models.serving_prefill(
+                cfg_d, params_d, tokens, positions, attn=attn)
+            return k_all[:, 0], v_all[:, 0]              # squeeze batch
+        fn = jax.jit(run)
+        self._spec_fns[key] = fn
+        return fn
+
+    def _draft_writer_fn(self, nb: int):
+        """Draft-pool prefill scatter: one compile per prefill block
+        count, like the target's ``_writer_fn`` (the draft pool's shape
+        differs, so the programs are siblings, not shared)."""
+        key = ("draft_writer", nb)
+        if key not in self._spec_fns:
+            self._note_compile("draft", ("writer", nb))
+            donate = (0,) if jax.default_backend() != "cpu" else ()
+            self._spec_fns[key] = jax.jit(write_prefill_blocks,
+                                          donate_argnums=donate)
+        return self._spec_fns[key]
+
+    def _draft_chunk_fn(self, bucket: int, window: int):
+        """Per (suffix bucket, window): seed the DRAFT pool for a
+        prefix-reuse admission's suffix — the draft twin of
+        ``_chunk_prefill_fn``, K/V writes only (sample discarded), so a
+        shared/exclusive prefix hit stays speculation-eligible instead
+        of drafting against a garbage suffix."""
+        key = ("draft_chunk", bucket, window)
+        if key in self._spec_fns:
+            return self._spec_fns[key]
+        self._note_compile("draft", ("chunk", bucket, window))
+        cfg_d = self.cfg_d
+
+        def run(params_d, pool_d, tokens, start, true_len, table):
+            _, pool_d = chunk_prefill_paged(
+                cfg_d, params_d, tokens, start, true_len, pool_d, table,
+                window)
+            return pool_d
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        fn = jax.jit(run, donate_argnums=donate)
+        self._spec_fns[key] = fn
+        return fn
+
+    def _spec_draft_fn(self, gb: int):
+        """Per γ bucket: the draft half of a speculative round — γ+1
+        scanned draft decode steps over the DRAFT pool (the +1 writes
+        the last draft's K/V so a fully-accepted round leaves no
+        permanent cache hole, exactly the sequential engine's rule),
+        returning the γ drafted tokens.  Compiled once per bucket: the
+        γ-program family is ``_gamma_buckets``, bounded by config."""
+        key = ("spec_draft", gb)
+        if key in self._spec_fns:
+            return self._spec_fns[key]
+        self._note_compile("draft", (gb, self.paged.blocks_per_slot
+                                     * self.paged.block_size))
+        cfg_d = self.cfg_d
+        max_pos = self.cfg.max_seq_len - 1
+
+        def run(params_d, pool_d, tables, pos, cur):
+            def step(carry, _):
+                pool_d, tok, p = carry
+                logits, pool_d = decode_step_paged(
+                    cfg_d, params_d, tok, p, pool_d, tables, ragged=True)
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                return (pool_d, nxt, jnp.minimum(p + 1, max_pos)), nxt
+            (pool_d, _, _), drafted = jax.lax.scan(
+                step, (pool_d, cur, pos), None, length=gb + 1)
+            return jnp.swapaxes(drafted, 0, 1)[:, :gb], pool_d   # [B, γ]
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        fn = jax.jit(run, donate_argnums=donate)
+        self._spec_fns[key] = fn
+        return fn
+
+    def _spec_verify_fn(self, gb: int):
+        """Per γ bucket: the verify half — ONE fused
+        ``verify_step_paged`` call over every slot's γ+1 chunk (q_len =
+        γ+1 on the ragged kernel face), greedy acceptance with the
+        per-slot runtime γ cap, and the emitted-token assembly, all on
+        device.  Keyed ONLY by (γ_bucket, pool span) through
+        ``_note_compile("verify")``: per-slot γ and acceptance lengths
+        are runtime operands, so adaptation never mints a program."""
+        key = ("spec_verify", gb)
+        if key in self._spec_fns:
+            return self._spec_fns[key]
+        self._note_compile("verify", (gb, self.paged.blocks_per_slot
+                                      * self.paged.block_size))
+        cfg = self.cfg
+
+        def run(params, pool, tables, pos, cur, drafted, gammas, temps,
+                rng):
+            chunk = jnp.concatenate([cur[:, None], drafted], axis=1)
+            logits, pool = verify_step_paged(cfg, params, chunk, pos,
+                                             pool, tables)
+            picks = jnp.argmax(logits, -1).astype(jnp.int32)  # [B, γ+1]
+            # First-row pick is temperature-aware: a sampled slot rides
+            # γ=0 and its one token per round must come from the same
+            # distribution the plain tick samples (greedy slots get the
+            # identical argmax).
+            pick0 = _sample_batched(logits[:, 0], rng, temps)
+            picks = picks.at[:, 0].set(pick0.astype(jnp.int32))
+            agree = drafted == picks[:, :gb]                  # [B, γ]
+            n_acc = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1),
+                            axis=1)
+            n_acc = jnp.minimum(n_acc, gammas)                # per-slot cap
+            idx = jnp.arange(gb + 1)[None]
+            out = jnp.where(
+                idx < n_acc[:, None],
+                jnp.pad(drafted, ((0, 0), (0, 1))),
+                jnp.take_along_axis(picks, jnp.minimum(idx, n_acc[:, None]),
+                                    axis=1))
+            return out, n_acc, pool
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        fn = jax.jit(run, donate_argnums=donate)
+        self._spec_fns[key] = fn
+        return fn
+
     def _spill_gather_fn(self):
         """Jitted demote snapshot (``paged_kv.gather_blocks``): minted
         ONCE; jit retraces per distinct block count, a family bounded by
@@ -805,7 +1103,8 @@ class ContinuousBatchingEngine:
                       first: Optional[int] = None,
                       gen: Optional[List[int]] = None,
                       ttft_ms: float = 0.0,
-                      pinned_entry: Optional[Any] = None) -> None:
+                      pinned_entry: Optional[Any] = None,
+                      spec_ok: bool = False) -> None:
         """The go-live tail shared by ALL FOUR admission paths
         (monolithic/chunked x cold/replay): construct the slot, publish
         its table row and per-slot decode state, emit the primed first
@@ -819,10 +1118,17 @@ class ContinuousBatchingEngine:
         else:
             tokens, cur = list(gen), gen[-1]
             ttft_ms = req.replay_ttft_ms or 0.0
+        # Speculation eligibility is decided HERE, once, for the slot's
+        # life: the admission path must have seeded the draft pool
+        # (spec_ok) and the slot must be greedy — a sampled slot rides
+        # the verify's sampled first row at γ=0.
+        spec = bool(self.spec and spec_ok and temp <= 0)
         slot = _Slot(request=req, blocks=blocks, prompt_len=prompt_len,
                      budget=budget, temperature=temp, ttft_ms=ttft_ms,
                      tokens=tokens, prompt_ids=prompt_ids,
-                     max_blocks=max_blocks, pinned_entry=pinned_entry)
+                     max_blocks=max_blocks, pinned_entry=pinned_entry,
+                     spec=spec,
+                     gamma=self.spec_gamma_max if spec else 0)
         if gen is None:
             obs_spans.add_token(req.trace)   # the prefill's primed token
             if req.token_queue is not None:
@@ -1006,6 +1312,15 @@ class ContinuousBatchingEngine:
                         self.pool = self._cow_copy_fn()(
                             self.pool, jnp.asarray(boundary_src, jnp.int32),
                             jnp.asarray(priv[0], jnp.int32))
+                        if self.spec:
+                            # The draft attends the same tables: its
+                            # boundary block must COW too, or the
+                            # slot's suffix draft KV would land in the
+                            # sharer-visible draft block.
+                            self.pool_d = self._cow_copy_fn_d()(
+                                self.pool_d,
+                                jnp.asarray(boundary_src, jnp.int32),
+                                jnp.asarray(priv[0], jnp.int32))
                 row = self._table_row(owned)
                 tokens = np.full((1, sb), self.tokenizer.pad_id, np.int32)
                 tokens[0, :len(suffix)] = suffix
@@ -1019,6 +1334,16 @@ class ContinuousBatchingEngine:
                         self.params, self.pool, jnp.asarray(tokens),
                         jnp.asarray([m], np.int32), jnp.asarray([n], np.int32),
                         jnp.asarray(row), rng, jnp.float32(temp))
+                    if self.spec:
+                        # Seed the draft pool's suffix (K/V only): the
+                        # parked prefix blocks already carry whatever
+                        # draft KV their writers left — stale content
+                        # only lowers acceptance, never correctness.
+                        self.pool_d = self._draft_chunk_fn(sb, window)(
+                            self.params_d, self.pool_d,
+                            jnp.asarray(tokens),
+                            jnp.asarray([m], np.int32),
+                            jnp.asarray([n], np.int32), jnp.asarray(row))
                     # dllm-lint: disable=transfer-host-sync -- sanctioned: the FIRST token must reach the host NOW (TTFT is the SLO and the value seeds the slot) — one sync per admission, never per tick
                     first = int(jax.block_until_ready(first))
                 self.profiler.event("host_sync",
@@ -1060,10 +1385,17 @@ class ContinuousBatchingEngine:
                         jnp.asarray([n], np.int32), rng, jnp.float32(temp))
                     # Page the prefilled bucket into this slot's blocks.
                     nb_prefill = bucket // bs
+                    blk_dev = jnp.asarray(blocks[:nb_prefill], np.int32)  # dllm-lint: disable=retrace-dynamic-shape -- bounded: nb_prefill only takes values from the validated prefill bucket set (one writer program per bucket, pinned by _note_compile's "writer" stage)
                     self.pool = self._writer_fn(nb_prefill)(
-                        # dllm-lint: disable=retrace-dynamic-shape -- bounded: nb_prefill only takes values from the validated prefill bucket set (one writer program per bucket, pinned by _note_compile's "writer" stage)
-                        self.pool, jnp.asarray(blocks[:nb_prefill], np.int32),
-                        k_all, v_all)
+                        self.pool, blk_dev, k_all, v_all)
+                    if self.spec:
+                        # Seed the DRAFT pool with the prompt's K/V so
+                        # this slot can speculate (ISSUE 15): same
+                        # bucket, same blocks, the draft's own forward.
+                        dk, dv = self._draft_prefill_fn(bucket)(
+                            self.params_d, jnp.asarray(tokens))
+                        self.pool_d = self._draft_writer_fn(nb_prefill)(
+                            self.pool_d, blk_dev, dk, dv)
                     # dllm-lint: disable=transfer-host-sync -- sanctioned: the FIRST token must reach the host NOW (TTFT is the SLO and the value seeds the slot) — one sync per admission, never per tick
                     first = int(jax.block_until_ready(first))
                 self.profiler.event("host_sync",
@@ -1082,7 +1414,8 @@ class ContinuousBatchingEngine:
         self._slot_go_live(req, slot_ix, blocks, prompt_len=n,
                            prompt_ids=tuple(ids), budget=budget, temp=temp,
                            max_blocks=max_blocks, pos=n, first=first,
-                           ttft_ms=ttft_ms, pinned_entry=pinned_entry)
+                           ttft_ms=ttft_ms, pinned_entry=pinned_entry,
+                           spec_ok=True)
         return True
 
     def _admit_replay(self, req: _Request, slot_ix: int, ids: List[int],
@@ -1157,10 +1490,17 @@ class ContinuousBatchingEngine:
                     jnp.asarray([len(seq)], np.int32), rng,
                     jnp.float32(temp))
                 nb_prefill = bucket // bs
+                blk_dev = jnp.asarray(blocks[:nb_prefill], np.int32)  # dllm-lint: disable=retrace-dynamic-shape -- bounded: nb_prefill only takes values from the validated prefill bucket set (one writer program per bucket)
                 self.pool = self._writer_fn(nb_prefill)(
-                    # dllm-lint: disable=retrace-dynamic-shape -- bounded: nb_prefill only takes values from the validated prefill bucket set (one writer program per bucket)
-                    self.pool, jnp.asarray(blocks[:nb_prefill], np.int32),
-                    k_all, v_all)
+                    self.pool, blk_dev, k_all, v_all)
+                if self.spec:
+                    # Replay rebuilds the draft prefix too (same cold
+                    # prefill shape), so a preempted speculating slot
+                    # resumes speculating instead of degrading to γ=0.
+                    dk, dv = self._draft_prefill_fn(bucket)(
+                        self.params_d, jnp.asarray(tokens))
+                    self.pool_d = self._draft_writer_fn(nb_prefill)(
+                        self.pool_d, blk_dev, dk, dv)
                 # The replay's sampled token is discarded: the last
                 # generated token was already emitted pre-preemption and
                 # decoding resumes FROM it, not after a fresh sample.
@@ -1181,7 +1521,8 @@ class ContinuousBatchingEngine:
                         generated=len(gen))
         self._slot_go_live(req, slot_ix, blocks, prompt_len=n,
                            prompt_ids=tuple(ids), budget=budget, temp=temp,
-                           max_blocks=max_blocks, pos=len(seq), gen=gen)
+                           max_blocks=max_blocks, pos=len(seq), gen=gen,
+                           spec_ok=True)
         return True
 
     # -- chunked prefill (the in-flight scheduler citizen) -----------------
@@ -1503,18 +1844,129 @@ class ContinuousBatchingEngine:
         self._release(slot_ix)               # free ALL blocks, no parking
         self._head.appendleft(req)
 
-    def _ensure_growth(self, active: List[int]) -> None:
+    def _spec_plan(self, active: List[int]) -> Optional[int]:
+        """The γ bucket this tick's speculative round compiles at, or
+        None for a plain decode tick (spec off, or no active slot is
+        both eligible and above γ=0 — every degraded batch falls back
+        to the T-step plain tick, so an all-low-acceptance engine pays
+        zero speculative overhead)."""
+        if not self.spec:
+            return None
+        gmax = 0
+        for ix in active:
+            slot = self._slots[ix]
+            if slot is not None and slot.spec and slot.gamma > 0:
+                gmax = max(gmax, slot.gamma)
+        return self._gamma_bucket(gmax) if gmax else None
+
+    def _ensure_spec_private(self, active: List[int], gb: int) -> None:
+        """The PR 10 rollback constraint, enforced BEFORE the round: a
+        speculative tick writes (and a rejection abandons) positions
+        ``[pos, pos+γ]`` in every active slot, so every block covering
+        that window must be slot-private — a shared (refcount>1) or
+        parked-prefix block there is COW-copied first, exactly like the
+        admit boundary (one decref'd reference back to the sharers,
+        one fresh private copy in BOTH pools).  By construction the
+        admission paths never map a shared block at the write frontier
+        (the boundary COW runs at admit), so this is the defensive
+        backstop the rollback contract demands, not a hot loop: the
+        refcount probe is one batched read per slot per spec tick.  A
+        pool too dry to COW preempts the slot (replay is the uniform
+        starvation answer) rather than ever writing a sharer-visible
+        block."""
+        bs = self.paged.block_size
+        for ix in active:
+            slot = self._slots[ix]
+            if slot is None:
+                continue
+            lo = int(self._pos[ix]) // bs
+            hi = min((int(self._pos[ix]) + gb) // bs, len(slot.blocks) - 1)
+            if hi < lo:
+                continue
+            idxs = list(range(lo, hi + 1))
+            refs = self.allocator.refcounts(
+                [slot.blocks[i] for i in idxs])
+            for i, r in zip(idxs, refs):
+                if r <= 1:
+                    continue
+                fresh = self._alloc_evicting(1)
+                if fresh is None:
+                    self._preempt(ix)
+                    break
+                with self.profiler.phase("cow_copy"):
+                    self.pool = self._cow_copy_fn()(
+                        self.pool, jnp.asarray(slot.blocks[i], jnp.int32),
+                        jnp.asarray(fresh[0], jnp.int32))
+                    self.pool_d = self._cow_copy_fn_d()(
+                        self.pool_d, jnp.asarray(slot.blocks[i], jnp.int32),
+                        jnp.asarray(fresh[0], jnp.int32))
+                shared = slot.blocks[i]
+                slot.blocks[i] = fresh[0]
+                self.allocator.free([shared])    # decref: sharers keep it
+                self._set_table_row(ix, self._table_row(slot.blocks))
+                obs_spans.event(slot.request.trace, "spec_cow",
+                                block=shared, copy=fresh[0])
+
+    def _spec_steps(self, slot: _Slot, gb: Optional[int] = None) -> int:
+        """Positions past ``pos`` a speculative round must land in REAL
+        blocks for this slot: its own γ+1 chunk rows (capped by the
+        tick's bucket when given).  Rows past a slot's γ still compute
+        — the verify is one fused call — but their writes fall off the
+        table row into the trash block and their picks are never
+        accepted, so growth (and the rewound frontier) only ever covers
+        the slot's OWN speculation depth, not the batch max."""
+        g = slot.gamma if slot.spec else 0
+        if gb is not None:
+            g = min(g, gb)
+        return g + 1
+
+    def _rewind_frontier(self, ix: int) -> None:
+        """Roll a slot's rejected speculative tail back: free every
+        block past what the slot's NEXT round can write (its accepted
+        frontier plus its own γ+1 runway — a γ that just adapted DOWN
+        releases the deeper tail immediately, and a degraded γ=0 slot
+        keeps exactly the plain-decode footprint).  Keeping the runway
+        rather than rewinding to the bare frontier stops a healthy
+        slot's alloc/free/table-upload ping-pong (growth would re-take
+        the same blocks next round); under real pool pressure the
+        growth path's eviction/preemption still reclaims runways.
+        Leading shared-prefix blocks are never in the freed tail (the
+        tail is the youngest, slot-private end of the block list), and
+        freeing is a refcounted decref regardless — a rollback can
+        shrink this slot's mapping but never mutate a sharer's."""
+        slot = self._slots[ix]
+        if slot is None:
+            return
+        bs = self.paged.block_size
+        end = int(self._pos[ix]) + self._spec_steps(slot)
+        need = max(1, min(slot.max_blocks, -(-end // bs)))
+        if len(slot.blocks) <= need:
+            return
+        tail = slot.blocks[need:]
+        del slot.blocks[need:]
+        self.allocator.free(tail)
+        self._set_table_row(ix, self._table_row(slot.blocks))
+
+    def _ensure_growth(self, active: List[int],
+                       spec_gb: Optional[int] = None) -> None:
         """Pre-tick lazy KV growth: every active slot's table must cover
         the positions this tick will write (bounded by the slot's own
-        budget).  When the pool runs dry — even after evicting parked
-        prefixes — the YOUNGEST slot is preempted: freed blocks un-starve
-        the elders, and the victim replays on re-admission."""
+        budget) — ``decode_steps_per_tick`` positions for a plain tick;
+        for a speculative round (``spec_gb`` set) each slot's OWN γ+1
+        chunk depth (deeper rows of the fused verify fall off the table
+        into the trash block and are never accepted, so growing to the
+        batch-max bucket would buy nothing).  When the pool runs dry —
+        even after evicting parked prefixes — the YOUNGEST slot is
+        preempted: freed blocks un-starve the elders, and the victim
+        replays on re-admission."""
         bs = self.paged.block_size
         for ix in active:
             slot = self._slots[ix]
             if slot is None:
                 continue                     # preempted earlier this pass
-            end = min(int(self._pos[ix]) + self.steps_per_tick,
+            steps = (self.steps_per_tick if spec_gb is None
+                     else self._spec_steps(slot, spec_gb))
+            end = min(int(self._pos[ix]) + steps,
                       slot.prompt_len + slot.budget,
                       self.cfg.max_seq_len)
             need = min(slot.max_blocks, -(-end // bs))
@@ -1603,12 +2055,90 @@ class ContinuousBatchingEngine:
         self._cur[slot_ix] = 0
 
     def _fail_slot(self, slot_ix: int, exc: BaseException) -> None:
-        req = self._slots[slot_ix].request
+        slot = self._slots[slot_ix]
+        if slot is None:
+            # Already released (a preemption raced the failing tick's
+            # active snapshot): failing it twice would NPE inside the
+            # scheduler's exception handler and kill the loop.
+            return
+        req = slot.request
         self._release(slot_ix)
         req.error = exc
         if req.token_queue is not None:
             req.token_queue.put(None)
         req.done.set()
+
+    def _emit_spec(self, active: List[int], out, n_acc, gammas) -> None:
+        """Apply one speculative round's verdicts: per slot, emit the
+        accepted draft prefix plus the target's pick (``n_acc+1``
+        tokens, 1 for a γ=0/rejected-first slot — exactly plain decode's
+        emission), fold the observed acceptance into the slot's EWMA →
+        next-round γ, and rewind the rejected tail's block frontier.
+        Budget/EOS/PAD termination applies per token with the SAME rules
+        as the plain emit loop (mid-round stoppers discard the rest of
+        their round, like a mid-tick finisher discards its overshoot)."""
+        tick_drafted = tick_accepted = 0
+        with self.profiler.phase("emit"):
+            for ix in active:
+                slot = self._slots[ix]
+                if slot is None:
+                    continue                 # preempted by the COW guard
+                k = int(n_acc[ix])
+                g_i = int(gammas[ix])
+                if slot.spec and g_i > 0:
+                    rate = k / g_i
+                    slot.accept_ewma = ((1.0 - SPEC_EWMA_ALPHA)
+                                        * slot.accept_ewma
+                                        + SPEC_EWMA_ALPHA * rate)
+                    slot.gamma = self._adapt_gamma(slot.accept_ewma)
+                    slot.spec_drafted += g_i
+                    slot.spec_accepted += k
+                    tick_drafted += g_i
+                    tick_accepted += k
+                    acc = self._spec_slot_acc.setdefault(ix, [0, 0])
+                    acc[0] += g_i
+                    acc[1] += k
+                    if slot.gamma == 0:
+                        obs_spans.event(slot.request.trace,
+                                        "spec_degraded",
+                                        accept_ewma=round(
+                                            slot.accept_ewma, 4))
+                finished = False
+                for t in range(k + 1):
+                    tok = int(out[ix, t])
+                    slot.tokens.append(tok)
+                    obs_spans.add_token(slot.request.trace)
+                    if slot.request.token_queue is not None:
+                        slot.request.token_queue.put(tok)
+                    self._pos[ix] += 1
+                    self._cur[ix] = tok
+                    hit_cap = len(slot.tokens) >= slot.budget
+                    hit_end = (tok in (self.tokenizer.eos_id,
+                                       self.tokenizer.pad_id)
+                               or self._pos[ix]
+                               >= self.cfg.max_seq_len - 1)
+                    if hit_cap or hit_end:
+                        self._finish(ix)
+                        finished = True
+                        break
+                if not finished:
+                    # Rejected-tail rollback: blocks grown for draft
+                    # positions past the accepted frontier go back to
+                    # the pool NOW (PR 5/9 frontier bookkeeping; stale
+                    # KV inside kept blocks is masked until overwritten).
+                    self._rewind_frontier(ix)
+        self.spec_drafted_total += tick_drafted
+        self.spec_accepted_total += tick_accepted
+        if tick_drafted:
+            try:
+                # No injection path on the engine (same pattern as the
+                # preemption counter): the process-global registry.
+                from ..obs import get_observability
+                m = get_observability().m
+                m.spec_drafted.labels(self.tier.name).inc(tick_drafted)
+                m.spec_accepted.labels(self.tier.name).inc(tick_accepted)
+            except Exception:
+                pass
 
     # The scheduler thread + fused decode tick: THE hot path.  The
     # transfer lint walks everything reachable from here, project-wide;
@@ -1666,13 +2196,49 @@ class ContinuousBatchingEngine:
                     req.done.set()
 
             active = [ix for ix, s in enumerate(self._slots) if s is not None]
+            spec_gb = None
             if active:
+                # Speculative plan first (ISSUE 15): the round's γ
+                # bucket decides how many positions this tick writes,
+                # so growth must cover the chunk, not just the plain
+                # tick's T steps.  Re-planned after growth — a
+                # preemption may have evicted the very slot that set
+                # the bucket.
+                spec_gb = self._spec_plan(active)
                 # Lazy KV growth (+ preemption under starvation) BEFORE
                 # the tick: every surviving slot's table covers the
                 # positions this tick writes.
-                self._ensure_growth(active)
+                self._ensure_growth(active, spec_gb=spec_gb)
                 active = [ix for ix, s in enumerate(self._slots)
                           if s is not None]
+                if spec_gb is not None:
+                    spec_gb = self._spec_plan(active)
+                    if spec_gb is not None:
+                        # Rollback contract guard (PR 10): every block
+                        # the round will write — or a rejection will
+                        # abandon — must be slot-private before the
+                        # first draft write lands.  Runs OUTSIDE the
+                        # tick's try, same discipline as growth (whose
+                        # preemption behavior it shares): a COW failure
+                        # in here must never reach the tick handler
+                        # that fails a pre-guard active list.
+                        self._ensure_spec_private(active, spec_gb)
+                        active = [ix for ix, s in enumerate(self._slots)
+                                  if s is not None]
+                        spec_gb = self._spec_plan(active)
+                    if spec_gb is None and active:
+                        # Growth (or the COW guard) preempted every
+                        # speculating slot: the tick falls back to the
+                        # PLAIN T-step path, but the survivors were
+                        # only grown for their own γ+1 chunk rows (1
+                        # position for non-spec slots).  Re-grow for
+                        # the plain span — a plain tick over
+                        # under-grown tables would scatter real
+                        # positions' K/V into the trash block and
+                        # silently corrupt every later read.
+                        self._ensure_growth(active, spec_gb=None)
+                        active = [ix for ix, s in enumerate(self._slots)
+                                  if s is not None]
             if not active:
                 if self._prefill is not None:
                     # No decoding slots: the whole tick is prefill — a
@@ -1712,6 +2278,7 @@ class ContinuousBatchingEngine:
 
             try:
                 self._rng, rng = jax.random.split(self._rng)
+                spec_tick = spec_gb is not None
                 if self.ragged:
                     # Ragged fused tick: the FULL tables go to one
                     # attention.ragged_decode call with true per-slot
@@ -1741,24 +2308,56 @@ class ContinuousBatchingEngine:
                             # dllm-lint: disable=retrace-dynamic-shape -- bounded by design: wb only takes values from the validated bucket ladder, so this is the dense rung-ladder program family PR 6 documents (ragged mode removes it); the cache above bounds the UPLOADS to one per table change
                             tables_arg = jnp.asarray(self._tables[:, :wb])
                         self._tables_dev_w[wb] = tables_arg
-                self._note_compile("decode", wb)
                 t_tick = time.perf_counter()
-                with self.phases.phase("decode"), \
-                        self.profiler.phase("decode"):
-                    toks, self.pool = self._decode_step()(
-                        self.params, self.pool, tables_arg,
-                        jnp.asarray(self._pos), jnp.asarray(self._cur),
-                        jnp.asarray(self._temps), rng)
-                    # dllm-lint: disable=transfer-host-sync -- THE one sanctioned sync per tick: the tick boundary, where all T×B tokens become observable in one pull — every other hot-path sync must justify itself against this one
-                    toks = np.asarray(jax.block_until_ready(toks))  # [T, B]
+                if spec_tick:
+                    # One speculative round: γ_bucket drafts per slot in
+                    # one scanned draft call, then ONE fused γ+1-wide
+                    # ragged verify with per-slot acceptance caps as
+                    # runtime operands.  Two device calls, one sync (the
+                    # verify pull) — the draft phase stamps dispatch
+                    # wall, the verify phase carries the device wait
+                    # (DESIGN.md "Batched speculation" documents the
+                    # attribution).
+                    gammas = np.zeros(self.paged.max_slots, np.int32)
+                    for ix in active:
+                        slot = self._slots[ix]
+                        if slot is not None and slot.spec:
+                            gammas[ix] = min(slot.gamma, spec_gb)
+                    pos_dev = jnp.asarray(self._pos)
+                    cur_dev = jnp.asarray(self._cur)
+                    with self.phases.phase("decode"), \
+                            self.profiler.phase("draft"):
+                        drafted, self.pool_d = self._spec_draft_fn(
+                            spec_gb)(self.params_d, self.pool_d,
+                                     tables_arg, pos_dev, cur_dev)
+                    with self.phases.phase("decode"), \
+                            self.profiler.phase("verify"):
+                        out, n_acc, self.pool = self._spec_verify_fn(
+                            spec_gb)(self.params, self.pool, tables_arg,
+                                     pos_dev, cur_dev, drafted,
+                                     jnp.asarray(gammas),
+                                     jnp.asarray(self._temps), rng)
+                        out, n_acc = _fetch_tick((out, n_acc))
+                else:
+                    self._note_compile("decode", wb)
+                    with self.phases.phase("decode"), \
+                            self.profiler.phase("decode"):
+                        toks, self.pool = self._decode_step()(
+                            self.params, self.pool, tables_arg,
+                            jnp.asarray(self._pos), jnp.asarray(self._cur),
+                            jnp.asarray(self._temps), rng)
+                        toks = _fetch_tick(toks)               # [T, B]
                 tick_ms = (time.perf_counter() - t_tick) * 1000.0
                 from ..utils import roofline
                 from ..ops import attention as attn_ops
                 window = wb * self.paged.block_size
                 q8 = self.tier.kv_quantize == "int8"
-                kind = (("ragged_decode_q8" if q8 else "ragged_decode")
-                        if self.ragged
-                        else ("paged_decode_q8" if q8 else "paged_decode"))
+                if spec_tick:
+                    kind = "ragged_verify_q8" if q8 else "ragged_verify"
+                elif self.ragged:
+                    kind = "ragged_decode_q8" if q8 else "ragged_decode"
+                else:
+                    kind = "paged_decode_q8" if q8 else "paged_decode"
                 self.tick_ms.append(tick_ms)
                 if self.profiler.enabled:
                     # Per-request cost attribution (ISSUE 11): the
@@ -1798,25 +2397,56 @@ class ContinuousBatchingEngine:
                                          window)).inc()
                 except Exception:
                     pass
-                # Mid-tick per-row positions (each row advances
-                # steps_per_tick this tick): frontier-clamped Pallas paged
-                # kernels stream ceil((pos+1)/bs) blocks, not the window.
-                mid = self.steps_per_tick // 2
-                self.phases.add_work("decode", **roofline.decode_work(
-                    self.cfg, self.steps_per_tick,
-                    window, batch=len(active),
-                    wbytes=self._wbytes,
-                    kv_quantize=self.tier.kv_quantize,
-                    kv_ctx=attn_ops.decode_kv_span(
+                if spec_tick:
+                    # Roofline split, sequential-engine style: the draft
+                    # pays γ+1 sequential small-model steps; the target
+                    # verify is ONE step whose γ+1 query rows share a
+                    # single KV read per slot (kv_batch charges B KV
+                    # streams, not B·(γ+1)).
+                    kv_ctx = attn_ops.decode_kv_span(
                         kind, window,
-                        [self._pos[ix] + mid for ix in active],
+                        [self._pos[ix] + spec_gb // 2 for ix in active],
                         impl=self.cfg.attention_impl,
-                        block=self.paged.block_size)))
+                        block=self.paged.block_size)
+                    self.phases.add_work("decode", **roofline.decode_work(
+                        self.cfg_d, spec_gb + 1, window,
+                        batch=len(active), wbytes=self._wbytes_d,
+                        kv_quantize=self.tier.kv_quantize, kv_ctx=kv_ctx))
+                    self.phases.add_work("decode", **roofline.decode_work(
+                        self.cfg, 1, window,
+                        batch=(spec_gb + 1) * len(active),
+                        wbytes=self._wbytes,
+                        kv_quantize=self.tier.kv_quantize,
+                        kv_batch=len(active), kv_ctx=kv_ctx))
+                else:
+                    # Mid-tick per-row positions (each row advances
+                    # steps_per_tick this tick): frontier-clamped Pallas
+                    # paged kernels stream ceil((pos+1)/bs) blocks, not
+                    # the window.
+                    mid = self.steps_per_tick // 2
+                    self.phases.add_work("decode", **roofline.decode_work(
+                        self.cfg, self.steps_per_tick,
+                        window, batch=len(active),
+                        wbytes=self._wbytes,
+                        kv_quantize=self.tier.kv_quantize,
+                        kv_ctx=attn_ops.decode_kv_span(
+                            kind, window,
+                            [self._pos[ix] + mid for ix in active],
+                            impl=self.cfg.attention_impl,
+                            block=self.paged.block_size)))
             except BaseException as exc:
                 # A dead tick must not become a dead scheduler: fail the
                 # in-flight requests and keep serving new ones.
                 for ix in active:
                     self._fail_slot(ix, exc)
+                self.profiler.commit(len(active))
+                continue
+
+            if spec_tick:
+                self._emit_spec(active, out, n_acc, gammas)
+                if self._prefill is not None:
+                    self._advance_prefill()
+                self._progress_t = time.monotonic()
                 self.profiler.commit(len(active))
                 continue
 
@@ -2133,6 +2763,15 @@ class ContinuousBatchingEngine:
         active = sum(1 for s in self._slots if s is not None)
         total = self.paged.max_slots
         pstats = self.prefill_stats()
+        # Per-slot speculative γ (ISSUE 15): {slot_ix: γ} over ACTIVE
+        # slots — γ=0 entries are slots degraded to plain ragged decode
+        # (or spec-ineligible ones), so an operator sees at a glance
+        # which tenants are still speculating.  Empty when spec is off.
+        gammas: Dict[str, int] = {}
+        if self.spec:
+            for ix, s in enumerate(self._slots):
+                if s is not None:
+                    gammas[str(ix)] = s.gamma if s.spec else 0
         return {
             "queue_depth": self.queue_depth(),
             "active_slots": active,
@@ -2144,6 +2783,30 @@ class ContinuousBatchingEngine:
             # long prompt is mid-absorption.
             "prefill_inflight": pstats["inflight"],
             "prefill_backlog_tokens": pstats["backlog_tokens"],
+            "spec_gammas": gammas,
+        }
+
+    def spec_stats(self) -> Dict[str, Any]:
+        """Batched-speculation snapshot (ISSUE 15): lifetime draft /
+        accept counters (the dllm_spec_* counters' source), the running
+        acceptance ratio the ``dllm_spec_accept_ratio`` sampler gauge
+        mirrors, and the live per-slot γ map.  Advisory GIL-safe reads
+        of scheduler-owned state, same discipline as slot_stats."""
+        drafted = self.spec_drafted_total
+        accepted = self.spec_accepted_total
+        return {
+            "enabled": self.spec,
+            "gamma_max": self.spec_gamma_max,
+            "gamma_buckets": list(self._gamma_buckets),
+            "drafted_total": drafted,
+            "accepted_total": accepted,
+            "accept_ratio": (round(accepted / drafted, 4)
+                             if drafted else None),
+            "slot_gammas": self.slot_stats()["spec_gammas"],
+            "per_slot": {
+                str(ix): {"drafted": d, "accepted": a,
+                          "ratio": round(a / d, 4) if d else None}
+                for ix, (d, a) in sorted(self._spec_slot_acc.items())},
         }
 
     def prefill_stats(self) -> Dict[str, Any]:
@@ -2230,6 +2893,25 @@ class ContinuousBatchingEngine:
                 jnp.asarray(self._temps), rng)
             jax.block_until_ready(toks)
             beat()
+        if self.spec:
+            # Speculative program family (ISSUE 15): the warm request
+            # above compiled the TOP γ bucket's draft/verify pair (fresh
+            # slots start at γ=spec_gamma_max); the remaining buckets —
+            # what adaptation can step a round down to — compile here
+            # against the all-trash tables (slots are free, writes land
+            # in the trash block), so a mid-serve γ drop never traces.
+            zero = jnp.zeros(self.paged.max_slots, jnp.int32)
+            for gb in self._gamma_buckets:
+                self._rng, rng = jax.random.split(self._rng)
+                drafted, self.pool_d = self._spec_draft_fn(gb)(
+                    self.params_d, self.pool_d,
+                    jnp.asarray(self._tables), zero, zero)
+                out, n_acc, self.pool = self._spec_verify_fn(gb)(
+                    self.params, self.pool, jnp.asarray(self._tables),
+                    zero, zero, drafted, zero,
+                    jnp.asarray(self._temps), rng)
+                jax.block_until_ready(out)
+                beat()
         if self.share_prefix:
             # The COW boundary-copy program: one compiled copy serves
             # every (src, dst) pair, warmed here so the first shared-hit
@@ -2246,6 +2928,15 @@ class ContinuousBatchingEngine:
                 jax.block_until_ready(self.pool["k"])
                 self.allocator.free(blks)
                 beat()
+                if self.spec:
+                    blks = self.allocator.alloc(2)
+                    if blks is not None:
+                        self.pool_d = self._cow_copy_fn_d()(
+                            self.pool_d, jnp.asarray(blks[0], jnp.int32),
+                            jnp.asarray(blks[1], jnp.int32))
+                        jax.block_until_ready(self.pool_d["k"])
+                        self.allocator.free(blks)
+                        beat()
         if self.prefix_cache is not None and self._buckets:
             row = self._table_row([])
             # Every (reuse suffix bucket, chunk window rung) an admit
@@ -2264,6 +2955,18 @@ class ContinuousBatchingEngine:
                         jnp.asarray(row), rng, jnp.float32(0.0))
                     jax.block_until_ready(first)
                     beat()
+                    if self.spec:
+                        # The draft's suffix-seed twin rides the same
+                        # (sb, window) ladder — warm it so a prefix-hit
+                        # admission never traces the draft mid-chat.
+                        self.pool_d = self._draft_chunk_fn(sb, window)(
+                            self.params_d, self.pool_d,
+                            jnp.full((1, sb), self.tokenizer.pad_id,
+                                     jnp.int32),
+                            jnp.asarray([0], np.int32),
+                            jnp.asarray([1], np.int32), jnp.asarray(row))
+                        jax.block_until_ready(self.pool_d["k"])
+                        beat()
         if (self.chunk_tokens and self._buckets
                 and max(self._buckets) > self.chunk_tokens):
             # The cold-chunk program family: one (chunk_tokens, window)

@@ -103,20 +103,31 @@ class EngineManager:
                     self.mesh is not None, self.tier.temperature)
                 use_speculative = False
             if use_speculative and self.tier.decode_batch > 1:
-                # Concurrent-by-default presets set decode_batch>1, but a
-                # configured draft still wins: speculative serving is the
-                # sequential engine family, so the tier falls back to it
-                # (the documented automatic fallback) instead of silently
-                # dropping the draft.
-                logger.warning(
-                    "tier %s: decode_batch=%d ignored — draft_preset=%s "
-                    "selects the sequential speculative engine",
-                    self.tier.name, self.tier.decode_batch,
-                    self.tier.draft_preset)
+                # Batched speculative path (ISSUE 15, retiring the PR 1
+                # bypass): a configured draft with decode_batch>1 serves
+                # through the continuous-batching engine — per-slot
+                # drafts verified in one fused ragged call — instead of
+                # falling back to the sequential engine and abandoning
+                # concurrency.
+                logger.info(
+                    "tier %s: draft_preset=%s serves the BATCHED "
+                    "speculative path (spec_decode armed; decode_batch=%d "
+                    "slots, spec_gamma_max=%d)",
+                    self.tier.name, self.tier.draft_preset,
+                    self.tier.decode_batch, self.tier.spec_gamma_max)
+                use_speculative = False
             if use_speculative:
                 import dataclasses as _dc
 
                 from .speculative import SpeculativeEngine
+                # decode_batch=1 keeps the sequential speculative engine
+                # (the batched path needs batch slots; set decode_batch>1
+                # — and tune spec_decode / spec_gamma_max — to serve the
+                # batched speculative path instead).
+                logger.info(
+                    "tier %s: decode_batch=1 — sequential SpeculativeEngine "
+                    "(set decode_batch>1 for the batched speculative path; "
+                    "spec_decode/spec_gamma_max govern it)", self.tier.name)
                 # The draft is a fresh model: no draft-side checkpoint
                 # exists (the target's weights are a different
                 # architecture), so clear inherited paths.
@@ -127,9 +138,22 @@ class EngineManager:
                     self.tier, draft, gamma=self.tier.speculative_gamma,
                     seed=self.seed, target_params=params)
             elif self.tier.decode_batch > 1:
+                import dataclasses as _dc
+
                 from .batching import ContinuousBatchingEngine
+                tier_eff = self.tier
+                if (self.tier.draft_preset and self.mesh is None
+                        and self.tier.temperature <= 0
+                        and self.tier.spec_decode is None):
+                    # AUTO (the tri-state default): the draft is the
+                    # operator's ask, so arm spec_decode on the engine's
+                    # tier view (frozen dataclass — replaced copy; the
+                    # manager/client keep the configured tier).  An
+                    # explicit spec_decode=False is the kill switch and
+                    # passes through untouched.
+                    tier_eff = _dc.replace(self.tier, spec_decode=True)
                 engine = ContinuousBatchingEngine(
-                    self.tier, seed=self.seed, mesh=self.mesh,
+                    tier_eff, seed=self.seed, mesh=self.mesh,
                     devices=self.devices, params=params)
             else:
                 engine = InferenceEngine(

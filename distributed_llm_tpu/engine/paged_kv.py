@@ -382,6 +382,110 @@ def chunk_prefill_paged(
     return hidden, new_pool
 
 
+def verify_step_paged(
+    cfg: ModelConfig,
+    params: transformer.Params,
+    tokens: jax.Array,         # [B, G] verify chunk per slot (cur + drafts)
+    pos: jax.Array,            # [B] the FIRST chunk token's position
+    pool: KVPool,
+    tables: jax.Array,         # [B, MB] FULL table rows (ragged contract)
+) -> Tuple[jax.Array, KVPool]:
+    """One batched SPECULATIVE-VERIFY forward over paged caches: the
+    q_len=γ+1 twin of ``decode_step_paged`` (ISSUE 15).  Each slot's
+    G = γ+1 chunk tokens — the last accepted token plus its drafts —
+    are embedded at absolute positions ``pos + g``, their K/V scattered
+    into the slot's blocks (write-before-attend, exactly like decode),
+    and ONE fused ``attention.ragged_verify`` call attends every slot's
+    chunk against its own prefix with per-query causal masks, so length
+    skew stays the kernel's problem.
+
+    Returns (logits [B, G, V] float32, updated pool): row g's argmax is
+    the target's pick for position ``pos + g + 1`` — the greedy
+    acceptance rule compares it against draft g.  Rejected rows' K/V
+    are stale garbage past the accepted frontier; the per-query mask
+    (``col <= pos + g``) keeps them invisible until a later write
+    overwrites them — the same overwrite-later invariant the
+    sequential speculative engine and right-padded prefill rely on.
+    Positions past ``max_seq_len`` (a slot finishing at the context
+    edge mid-chunk) scatter into the trash block instead of clamping
+    onto live KV."""
+    b, g = tokens.shape
+    d = cfg.head_dim
+    bs = pool["k"].shape[3]
+    max_pos = cfg.max_seq_len - 1
+
+    x = quant.embed_rows(params["embed"], tokens)      # [B, G, H]
+    positions = pos[:, None] + jnp.arange(g)[None]     # [B, G]
+    wpos = jnp.minimum(positions, max_pos)
+    sin, cos = transformer.rope_sincos(wpos, d, cfg.rope_theta)
+
+    # Overflowing rows route to the reserved trash block: a clamped
+    # write would land INSIDE the slot's live frontier and corrupt
+    # accepted KV the per-query mask still exposes.
+    blk = jnp.where(
+        positions <= max_pos,
+        jnp.take_along_axis(tables, wpos // bs, axis=1),
+        TRASH_BLOCK)                                   # [B, G]
+    off = wpos % bs
+    quantized = "ks" in pool
+
+    def layer(x, scanned):
+        if quantized:
+            lp, k_pool, v_pool, ks_pool, vs_pool = scanned
+        else:
+            lp, k_pool, v_pool = scanned
+            ks_pool = vs_pool = None
+        h_in = transformer.rms_norm(x, lp["ln1"], cfg.norm_eps)
+        q = quant.matmul(h_in, lp["wq"]).reshape(b, g, cfg.num_heads, d)
+        k = quant.matmul(h_in, lp["wk"]).reshape(b, g, cfg.num_kv_heads, d)
+        v = quant.matmul(h_in, lp["wv"]).reshape(b, g, cfg.num_kv_heads, d)
+        q = transformer.apply_rope(q, sin, cos)
+        k = transformer.apply_rope(k, sin, cos)
+
+        # Write-before-attend for the whole chunk: [nkv, B, G, d] rows
+        # scatter to (head, blk[b, g], off[b, g]) — trash rows collide
+        # harmlessly like idle decode slots.
+        k_rows = jnp.moveaxis(k, 2, 0)                 # [nkv, B, G, d]
+        v_rows = jnp.moveaxis(v, 2, 0)
+        if quantized:
+            k_rows, k_sc = quantize_kv_rows(k_rows)
+            v_rows, v_sc = quantize_kv_rows(v_rows)
+            ks_pool = ks_pool.at[:, blk, off].set(k_sc)
+            vs_pool = vs_pool.at[:, blk, off].set(v_sc)
+        k_pool = k_pool.at[:, blk, off].set(k_rows)
+        v_pool = v_pool.at[:, blk, off].set(v_rows)
+
+        attn_out = attention.ragged_verify(
+            q, k_pool, v_pool, tables, pos, impl=cfg.attention_impl,
+            k_scale=ks_pool, v_scale=vs_pool)          # [B, G, Nq, d]
+
+        x = x + quant.matmul(
+            attn_out.reshape(b, g, cfg.num_heads * d), lp["wo"])
+        h_ffn = transformer.rms_norm(x, lp["ln2"], cfg.norm_eps)
+        if cfg.num_experts > 1:
+            from ..models.moe import moe_ffn_train
+            ffn_out, _ = moe_ffn_train(cfg, lp, h_ffn)
+            x = x + ffn_out
+        else:
+            x = x + transformer._swiglu(h_ffn, lp["w_gate"], lp["w_up"],
+                                        lp["w_down"])
+        if quantized:
+            return x, (k_pool, v_pool, ks_pool, vs_pool)
+        return x, (k_pool, v_pool)
+
+    if quantized:
+        x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            layer, x, (params["layers"], pool["k"], pool["v"],
+                       pool["ks"], pool["vs"]))
+        new_pool = {"k": k_new, "v": v_new, "ks": ks_new, "vs": vs_new}
+    else:
+        x, (k_new, v_new) = jax.lax.scan(
+            layer, x, (params["layers"], pool["k"], pool["v"]))
+        new_pool = {"k": k_new, "v": v_new}
+    hidden = transformer.rms_norm(x, params["final_ln"], cfg.norm_eps)
+    return transformer.logits_from_hidden(params, hidden), new_pool
+
+
 def decode_step_paged(
     cfg: ModelConfig,
     params: transformer.Params,

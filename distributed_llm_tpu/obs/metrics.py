@@ -389,6 +389,25 @@ class ServingMetrics:
             "Device time of one interleaved prefill chunk — the upper "
             "bound a chunked admission adds to active streams' "
             "time-between-tokens per tick", ("tier",))
+        # Batched-speculation family (ISSUE 15): drafted vs accepted
+        # draft tokens per tier (the counter pair whose ratio IS the
+        # realized acceptance rate) and the engine's running acceptance
+        # ratio mirrored by the system-state sampler — an operator reads
+        # whether speculation is paying for its draft FLOPs without
+        # diffing counters.
+        self.spec_drafted = registry.counter(
+            "dllm_spec_drafted_total",
+            "Draft tokens proposed by batched speculative decoding "
+            "(per-slot γ summed over rounds)", ("tier",))
+        self.spec_accepted = registry.counter(
+            "dllm_spec_accepted_total",
+            "Draft tokens accepted by the fused verify's greedy "
+            "acceptance rule", ("tier",))
+        self.spec_accept_ratio_g = registry.gauge(
+            "dllm_spec_accept_ratio",
+            "Engine-lifetime accepted/drafted ratio for batched "
+            "speculation (sampled; absent until the first draft)",
+            ("tier",))
         self.prefill_backlog_g = registry.gauge(
             "dllm_prefill_backlog",
             "Prompt tokens of the in-flight chunked prefill not yet "

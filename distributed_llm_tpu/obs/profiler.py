@@ -77,7 +77,7 @@ from typing import Any, Dict, List, Optional
 # the device↔host DRAIN itself lives on the copier thread and never
 # stamps a tick phase.
 PHASES = ("admit", "prefill", "cow_copy", "table_upload", "decode",
-          "emit", "chunk_prefill", "demote", "promote")
+          "draft", "verify", "emit", "chunk_prefill", "demote", "promote")
 
 DEFAULT_CAPACITY = 512
 EVENT_CAPACITY = 512

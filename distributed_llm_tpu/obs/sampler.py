@@ -57,6 +57,7 @@ _GAUGE_FIELDS = (
     ("kv_reclaimable_blocks", "kv_reclaimable_blocks_g"),
     ("kv_shared_blocks", "kv_shared_blocks_g"),
     ("kv_dedup_ratio", "kv_dedup_ratio_g"),
+    ("spec_accept_ratio", "spec_accept_ratio_g"),
     ("kv_host_blocks", "kv_host_blocks_g"),
     ("kv_host_bytes", "kv_host_bytes_g"),
     ("kv_promote_backlog", "kv_promote_backlog_g"),

@@ -45,7 +45,8 @@ _DISPATCH_META: Optional[dict] = None
 # kernels).
 DISPATCH_KINDS = ("prefill", "decode", "decode_q8", "chunk", "chunk_q8",
                   "paged_decode", "paged_decode_q8", "paged_chunk",
-                  "ragged_decode", "ragged_decode_q8")
+                  "ragged_decode", "ragged_decode_q8",
+                  "ragged_verify", "ragged_verify_q8")
 
 
 def _load_dispatch() -> None:
@@ -250,12 +251,12 @@ def chunk(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     return chunk_attention(q, k_cache, v_cache, q_positions)
 
 
-def _gather_decode_paged(q, k_pool, v_pool, tables, pos, k_scale, v_scale):
-    """XLA fallback shared by ``paged_decode`` and ``ragged_decode``:
-    gather the block table into a contiguous view and reuse
-    ``decode_attention`` (portable / GSPMD-shardable; one code path so
-    the two kinds' fallbacks are byte-identical — the parity reference
-    for the Pallas kernels)."""
+def _gather_pool_seq(q_dtype, k_pool, v_pool, tables, k_scale, v_scale):
+    """The paged fallbacks' ONE table gather: pools [Nkv, NB, bs, D] +
+    tables [B, MB] -> contiguous [B, S, Nkv, D] views (int8 pools
+    dequantized through the gathered scales).  Shared by the decode
+    (q_len=1) and verify (q_len=γ+1) fallbacks so their byte-parity is
+    mechanical, not maintained by hand."""
     b, mb = tables.shape
     nkv, bs, d = k_pool.shape[0], k_pool.shape[2], k_pool.shape[3]
     # [Nkv, B, MB, bs, D] -> [B, S, Nkv, D]
@@ -264,8 +265,19 @@ def _gather_decode_paged(q, k_pool, v_pool, tables, pos, k_scale, v_scale):
     if k_scale is not None:
         k_sc = k_scale[:, tables].reshape(nkv, b, mb * bs).transpose(1, 2, 0)
         v_sc = v_scale[:, tables].reshape(nkv, b, mb * bs).transpose(1, 2, 0)
-        k_seq = (k_seq.astype(jnp.float32) * k_sc[..., None]).astype(q.dtype)
-        v_seq = (v_seq.astype(jnp.float32) * v_sc[..., None]).astype(q.dtype)
+        k_seq = (k_seq.astype(jnp.float32) * k_sc[..., None]).astype(q_dtype)
+        v_seq = (v_seq.astype(jnp.float32) * v_sc[..., None]).astype(q_dtype)
+    return k_seq, v_seq
+
+
+def _gather_decode_paged(q, k_pool, v_pool, tables, pos, k_scale, v_scale):
+    """XLA fallback shared by ``paged_decode`` and ``ragged_decode``:
+    gather the block table into a contiguous view and reuse
+    ``decode_attention`` (portable / GSPMD-shardable; one code path so
+    the two kinds' fallbacks are byte-identical — the parity reference
+    for the Pallas kernels)."""
+    k_seq, v_seq = _gather_pool_seq(q.dtype, k_pool, v_pool, tables,
+                                    k_scale, v_scale)
     return decode_attention(q, k_seq, v_seq, pos)
 
 
@@ -329,6 +341,56 @@ def ragged_decode(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
         return ragged_paged_decode_attention_q8(q, k_pool, v_pool, k_scale,
                                                 v_scale, tables, pos)
     return _gather_decode_paged(q, k_pool, v_pool, tables, pos,
+                                k_scale, v_scale)
+
+
+def _gather_verify_paged(q, k_pool, v_pool, tables, pos, k_scale, v_scale):
+    """XLA fallback for ``ragged_verify``: the SAME ``_gather_pool_seq``
+    gather as ``_gather_decode_paged`` (so the q_len=1 and q_len=γ+1
+    fallbacks agree block-for-block by construction), attended through
+    ``chunk_attention`` with per-query absolute positions — the
+    byte-level correctness reference the Pallas verify kernels are
+    pinned against."""
+    g = q.shape[1]
+    k_seq, v_seq = _gather_pool_seq(q.dtype, k_pool, v_pool, tables,
+                                    k_scale, v_scale)
+    q_pos = pos[:, None] + jnp.arange(g)[None]               # [B, G]
+    return chunk_attention(q, k_seq, v_seq, q_pos)
+
+
+def ragged_verify(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                  tables: jax.Array, pos: jax.Array,
+                  impl: str = "auto", k_scale: jax.Array = None,
+                  v_scale: jax.Array = None) -> jax.Array:
+    """Dispatching RAGGED speculative-verify attention over a paged KV
+    pool: q [B, G, Nq, D] — G = γ+1 chunk queries per slot at absolute
+    positions ``pos[b] + g`` (``pos`` [B] is the FIRST query's position;
+    the chunk's K/V are already written, write-before-attend), pools
+    [Nkv, NB, bs, D], tables [B, MB] -> [B, G, Nq, D].
+
+    The q_len=γ+1 extension of ``ragged_decode`` (the Ragged Paged
+    Attention paper's q-length flexibility): the Pallas path
+    (ops/ragged_attention.py verify kernels) streams each slot's own
+    ceil((pos+G)/bs) blocks with a per-query causal mask, so one
+    invocation verifies every slot's drafts at per-slot cost regardless
+    of length skew.  The XLA path gathers the full table and reuses
+    ``chunk_attention`` — the portable fallback (default everywhere
+    until an on-chip A/B writes a 'pallas' row; the shipped
+    ab_dispatch.json rows are conservative 'xla') and the byte-level
+    parity reference.  ``k_scale``/``v_scale`` ([Nkv, NB, bs]) mark an
+    int8 pool (ragged_verify_q8, in-VMEM dequant on the Pallas path)."""
+    b, mb = tables.shape
+    bs = k_pool.shape[2]
+    if k_scale is None:
+        if _choose(impl, "ragged_verify", mb * bs) == "pallas":
+            from .ragged_attention import ragged_paged_verify_attention
+            return ragged_paged_verify_attention(q, k_pool, v_pool, tables,
+                                                 pos)
+    elif _choose(impl, "ragged_verify_q8", mb * bs) == "pallas":
+        from .ragged_attention import ragged_paged_verify_attention_q8
+        return ragged_paged_verify_attention_q8(q, k_pool, v_pool, k_scale,
+                                                v_scale, tables, pos)
+    return _gather_verify_paged(q, k_pool, v_pool, tables, pos,
                                 k_scale, v_scale)
 
 

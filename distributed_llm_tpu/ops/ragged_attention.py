@@ -167,6 +167,121 @@ def ragged_paged_decode_attention(q: jax.Array, k_pool: jax.Array,
     )(tables32, pos32, q, k_pool, v_pool)
 
 
+def _ragged_verify_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                          acc_ref, m_ref, l_ref, *, bs: int, nkv: int,
+                          d: int, g: int, scale: float):
+    """Speculative-verify twin of ``_ragged_decode_kernel``: each slot
+    carries ``g`` query positions (the γ+1 verify chunk) instead of one.
+    The q tile arrives head-major flattened ([Nq·g, D], position index
+    fastest within each head's row group), so the per-head score stacks
+    are the decode kernel's with ``groups·g`` rows, and the ragged mask
+    becomes per-ROW: row r (position ``r % g`` of its slot) sees
+    ``col <= pos[b] + r % g``.  The frontier clamp streams to the LAST
+    query's block, so a slot still pays ceil((pos+g)/bs) blocks — its
+    own length plus its chunk, never the batch max."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+    last = pos_ref[b] + g - 1
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * bs <= last)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale             # [Nq·g, D]
+        groups = q.shape[0] // (nkv * g)
+
+        s = jnp.concatenate([
+            jax.lax.dot_general(
+                q[h * groups * g:(h + 1) * groups * g],
+                k_ref[h, 0].astype(jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [G·g, bs]
+            for h in range(nkv)], axis=0)
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bs
+        row_pos = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) % g
+                   + pos_ref[b])
+        s = jnp.where(col <= row_pos, s, NEG_INF)        # per-row ragged mask
+
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.concatenate([
+            jnp.dot(p[h * groups * g:(h + 1) * groups * g
+                      ].astype(v_ref.dtype),
+                    v_ref[h, 0],
+                    preferred_element_type=jnp.float32)
+            for h in range(nkv)], axis=0)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+
+    @pl.when(j == nb - 1)
+    def _done():
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def ragged_paged_verify_attention(q: jax.Array, k_pool: jax.Array,
+                                  v_pool: jax.Array, tables: jax.Array,
+                                  pos: jax.Array) -> jax.Array:
+    """Batched ragged VERIFY attention over a paged KV pool: q
+    [B, G, Nq, D] — the γ+1 speculative verify chunk per slot, queries
+    at absolute positions ``pos[b] + g`` — pools [Nkv, NB, bs, D],
+    tables [B, MB], pos [B] the FIRST query's position -> [B, G, Nq, D].
+
+    One invocation verifies every slot's drafts regardless of length
+    skew: the same per-slot frontier clamp as the decode kernel, widened
+    to the last query's block, with a per-query causal mask so draft g
+    attends exactly its own prefix (prefix + chunk positions <= pos+g,
+    all already written — write-before-attend, like decode)."""
+    b, g, nq, d = q.shape
+    nkv, bs = k_pool.shape[0], k_pool.shape[2]
+    mb = tables.shape[1]
+
+    tables32 = tables.astype(jnp.int32)
+    pos32 = pos.astype(jnp.int32)
+    # Head-major flatten: row (h_q·g + position) so each kv head's rows
+    # are contiguous and the in-kernel per-head slicing stays the decode
+    # kernel's.
+    qf = q.transpose(0, 2, 1, 3).reshape(b, nq * g, d)
+
+    kernel = functools.partial(_ragged_verify_kernel, bs=bs, nkv=nkv, d=d,
+                               g=g, scale=d ** -0.5)
+
+    def kv_index(b_, j, tbl, p):
+        return (0, tbl[b_, jnp.minimum(j, (p[b_] + g - 1) // bs)], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mb),
+        in_specs=[
+            pl.BlockSpec((1, nq * g, d), lambda b_, j, tbl, p: (b_, 0, 0)),
+            pl.BlockSpec((nkv, 1, bs, d), kv_index),
+            pl.BlockSpec((nkv, 1, bs, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, nq * g, d),
+                               lambda b_, j, tbl, p: (b_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nq * g, d), jnp.float32),
+            pltpu.VMEM((nq * g, 1), jnp.float32),
+            pltpu.VMEM((nq * g, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        interpret=_interpret(),
+    )(tables32, pos32, qf, k_pool, v_pool)
+    return out.reshape(b, nq, g, d).transpose(0, 2, 1, 3)
+
+
 def _ragged_decode_kernel_q8(tables_ref, pos_ref, q_ref, k_ref, v_ref,
                              ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref,
                              *, bs: int, nkv: int, d: int, scale: float):
@@ -269,3 +384,114 @@ def ragged_paged_decode_attention_q8(q: jax.Array, k_pool: jax.Array,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=_interpret(),
     )(tables32, pos32, q, k_pool, v_pool, ks, vs)
+
+
+def _ragged_verify_kernel_q8(tables_ref, pos_ref, q_ref, k_ref, v_ref,
+                             ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref,
+                             *, bs: int, nkv: int, d: int, g: int,
+                             scale: float):
+    """int8 twin of ``_ragged_verify_kernel``: half-width pool tiles +
+    per-row f32 scales, dequantized in VMEM (the ops/quant contract),
+    with the verify kernel's per-row ragged mask and last-query frontier
+    clamp."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+    last = pos_ref[b] + g - 1
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * bs <= last)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale             # [Nq·g, D]
+        groups = q.shape[0] // (nkv * g)
+
+        def dq(ref, sref, h):
+            return ref[h, 0].astype(jnp.float32) * sref[h, 0]  # [bs, D]
+
+        s = jnp.concatenate([
+            jax.lax.dot_general(
+                q[h * groups * g:(h + 1) * groups * g],
+                dq(k_ref, ks_ref, h),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [G·g, bs]
+            for h in range(nkv)], axis=0)
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bs
+        row_pos = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) % g
+                   + pos_ref[b])
+        s = jnp.where(col <= row_pos, s, NEG_INF)        # per-row ragged mask
+
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.concatenate([
+            jnp.dot(p[h * groups * g:(h + 1) * groups * g],
+                    dq(v_ref, vs_ref, h),
+                    preferred_element_type=jnp.float32)
+            for h in range(nkv)], axis=0)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+
+    @pl.when(j == nb - 1)
+    def _done():
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def ragged_paged_verify_attention_q8(q: jax.Array, k_pool: jax.Array,
+                                     v_pool: jax.Array, k_scale: jax.Array,
+                                     v_scale: jax.Array, tables: jax.Array,
+                                     pos: jax.Array) -> jax.Array:
+    """``ragged_paged_verify_attention`` over an int8 pool: q
+    [B, G, Nq, D], pools [Nkv, NB, bs, D] int8, scales [Nkv, NB, bs]
+    f32, pos [B] first-query positions -> [B, G, Nq, D].  Streams half
+    the KV bytes of the bf16 verify kernel with the same per-row mask;
+    never materializes the dequantized window in HBM (the XLA fallback's
+    gather does)."""
+    b, g, nq, d = q.shape
+    nkv, bs = k_pool.shape[0], k_pool.shape[2]
+    mb = tables.shape[1]
+
+    tables32 = tables.astype(jnp.int32)
+    pos32 = pos.astype(jnp.int32)
+    ks = k_scale[..., None].astype(jnp.float32)
+    vs = v_scale[..., None].astype(jnp.float32)
+    qf = q.transpose(0, 2, 1, 3).reshape(b, nq * g, d)
+
+    kernel = functools.partial(_ragged_verify_kernel_q8, bs=bs, nkv=nkv,
+                               d=d, g=g, scale=d ** -0.5)
+
+    def kv_index(b_, j, tbl, p):
+        return (0, tbl[b_, jnp.minimum(j, (p[b_] + g - 1) // bs)], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mb),
+        in_specs=[
+            pl.BlockSpec((1, nq * g, d), lambda b_, j, tbl, p: (b_, 0, 0)),
+            pl.BlockSpec((nkv, 1, bs, d), kv_index),
+            pl.BlockSpec((nkv, 1, bs, d), kv_index),
+            pl.BlockSpec((nkv, 1, bs, 1), kv_index),
+            pl.BlockSpec((nkv, 1, bs, 1), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, nq * g, d),
+                               lambda b_, j, tbl, p: (b_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nq * g, d), jnp.float32),
+            pltpu.VMEM((nq * g, 1), jnp.float32),
+            pltpu.VMEM((nq * g, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        interpret=_interpret(),
+    )(tables32, pos32, qf, k_pool, v_pool, ks, vs)
+    return out.reshape(b, nq, g, d).transpose(0, 2, 1, 3)

@@ -501,6 +501,17 @@ class Router:
                 st["decode_tick_p50_ms"] = tick_fn().get("p50_ms")
             except Exception:
                 pass
+        # Batched speculation (ISSUE 15): the engine-lifetime acceptance
+        # ratio — the dllm_spec_accept_ratio gauge's source series
+        # (absent until the first draft so the gauge never fakes a 0).
+        spec_fn = getattr(engine, "spec_stats", None)
+        if callable(spec_fn):
+            try:
+                ss = spec_fn()
+                if ss.get("enabled") and ss.get("accept_ratio") is not None:
+                    st["spec_accept_ratio"] = ss["accept_ratio"]
+            except Exception:
+                pass
         # Tick-phase profiler (ISSUE 11): per-phase p50 self-times
         # over the ring's recent tail + the coverage fraction —
         # advisory ring reads, bounded to the last 128 records so

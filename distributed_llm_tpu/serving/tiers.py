@@ -232,14 +232,14 @@ class TierClient:
         # Bounded admission replaces lock-serialization as the
         # concurrency story; registered on the manager so health()
         # snapshots expose queue depth next to slot occupancy.
-        # Slot count mirrors EngineManager's engine choice: a tier whose
-        # draft_preset will select the sequential speculative engine
-        # (greedy, unsharded — manager.py start_server) serves ONE
-        # stream regardless of decode_batch.
+        # Slot count mirrors EngineManager's engine choice.  A draft
+        # with decode_batch>1 serves the BATCHED speculative path
+        # (ISSUE 15 retired the PR 1 sequential fallback), so admission
+        # believes in the real decode_batch slots; the only engine that
+        # serves one stream — the sequential SpeculativeEngine — is
+        # selected exactly when decode_batch<=1, where max(1, ...) is
+        # already 1.
         slots = max(1, tier.decode_batch)
-        if (tier.draft_preset and (tier.temperature or 0) <= 0
-                and getattr(manager, "mesh", None) is None):
-            slots = 1
         self.admission = AdmissionController(tier, slots=slots)
         try:
             manager.admission = self.admission

@@ -57,6 +57,10 @@ from typing import Any, Dict, List, Optional, Tuple
 # spill.tbt_ratio (a live co-tenant stream's inter-token-gap p95,
 # spill-on(large) / spill-off — a drift past ~1.05 means promotions
 # started stalling the decode stream next to them) joined in r16.
+# spec.tok_ratio (ISSUE 15's spec-on/spec-off decode tok/s on the skew
+# mix, same seed, warmed — the batched-speculation win; a drift below
+# 1.0 means drafting+fused-verify stopped paying for itself on the
+# trend config) joined in r17.
 PINNED: Tuple[Tuple[str, bool], ...] = (
     ("trend_req_per_s", True),
     ("skew_tick_ratio", False),
@@ -65,6 +69,7 @@ PINNED: Tuple[Tuple[str, bool], ...] = (
     ("replica.speedup", True),
     ("spill.warm_hit_rate", True),
     ("spill.tbt_ratio", False),
+    ("spec.tok_ratio", True),
 )
 
 # Context rows printed (no flags): the headline and accuracy travel
@@ -98,6 +103,8 @@ _PATHS: Dict[str, Tuple[Tuple[str, ...], ...]] = {
     "shared.peak_ratio": (("shared", "peak_ratio"),),
     "spill.warm_hit_rate": (("spill", "warm_hit_rate"),),
     "spill.tbt_ratio": (("spill", "tbt_ratio"),),
+    "spec.tok_ratio": (("spec", "tok_ratio"),
+                       ("spec_phase", "tok_ratio"),),
     "replica.speedup": (("replica", "speedup"),
                         ("replica", "closed_loop_speedup"),),
     "replica.aff_ret": (("replica", "aff_ret"),

@@ -248,7 +248,8 @@ def test_batched_engine_slot_stats_under_load():
         st = engine.slot_stats()
         assert set(st) == {"queue_depth", "active_slots", "max_slots",
                            "slot_occupancy", "preempted_total",
-                           "prefill_inflight", "prefill_backlog_tokens"}
+                           "prefill_inflight", "prefill_backlog_tokens",
+                           "spec_gammas"}
         assert st["max_slots"] == 2
         for r in reqs:
             assert r.done.wait(timeout=60)
@@ -395,24 +396,37 @@ def test_router_queue_aware_sheds_before_rejecting():
         assert router.tiers["nano"].admission.rejected == 0
 
 
-def test_admission_slots_follow_speculative_fallback():
-    """A draft_preset tier serves the SEQUENTIAL speculative engine no
-    matter its decode_batch (manager fallback) — admission and health
-    must reflect that real concurrency of 1, not the configured batch
-    (code review r6: admission believing in 4 slots would admit 4× what
-    the engine can serve and suppress the fail-fast)."""
+def test_admission_slots_follow_speculative_engine_choice():
+    """Speculation routing after ISSUE 15 retired the PR 1 bypass: a
+    draft_preset tier with decode_batch>1 serves the BATCHED speculative
+    path (ContinuousBatchingEngine, spec armed, admission believes in
+    the real decode_batch slots); only decode_batch=1 keeps the
+    sequential SpeculativeEngine and its one-stream admission."""
+    from distributed_llm_tpu.engine.batching import ContinuousBatchingEngine
     from distributed_llm_tpu.engine.speculative import SpeculativeEngine
 
     tier = _tier(decode_batch=4, draft_preset="nano_test")
     mgr = EngineManager(tier, warmup_on_start=False)
     client = TierClient(tier, mgr)
     try:
-        assert client.admission.slots == 1
-        assert isinstance(mgr.engine(), SpeculativeEngine)
-        assert mgr.health()["max_slots"] == 1
-        assert client.load_snapshot()["max_slots"] == 1
+        assert client.admission.slots == 4
+        engine = mgr.engine()
+        assert isinstance(engine, ContinuousBatchingEngine)
+        assert engine.spec and engine.tier.spec_decode
+        assert mgr.health()["max_slots"] == 4
+        assert client.load_snapshot()["max_slots"] == 4
     finally:
         mgr.stop_server()
+
+    tier1 = _tier(decode_batch=1, draft_preset="nano_test")
+    mgr1 = EngineManager(tier1, warmup_on_start=False)
+    client1 = TierClient(tier1, mgr1)
+    try:
+        assert client1.admission.slots == 1
+        assert isinstance(mgr1.engine(), SpeculativeEngine)
+        assert mgr1.health()["max_slots"] == 1
+    finally:
+        mgr1.stop_server()
 
 
 def test_tiny_batched_cluster_builds_batching_engines():

@@ -1420,6 +1420,66 @@ def test_retrace_chunk_per_prompt_length_shapes_flagged():
     assert _rules(result) == ["retrace-shape-cache-key"], result.findings
 
 
+def test_retrace_spec_verify_family_bounded_keys_clean():
+    """The batched-speculation idiom (ISSUE 15): draft/verify program
+    caches keyed by the bounded (γ_bucket, pool-span) INTS — per-slot γ
+    and acceptance lengths are runtime operands — plus the scheduler
+    loop calling the already-built wrapped functions.  The shipped
+    ``_spec_draft_fn``/``_spec_verify_fn``/``_spec_plan`` shape must
+    stay silent."""
+    from distributed_llm_tpu.lint.checkers.retrace import RetraceChecker
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        def verify_fn(self, gb):
+            key = ("spec_verify", gb)      # bounded γ-bucket key
+            if key not in self._fns:
+                self._fns[key] = jax.jit(self._run_verify)
+            return self._fns[key]
+
+        def spec_round(self, active, gb):    # dllm-lint: hot-path
+            while active:
+                out, n_acc, self.pool = self.verify_fn(gb)(
+                    self.params, self.pool, self.tables,
+                    jnp.asarray(self._pos), jnp.asarray(self._cur),
+                    self.drafted, jnp.asarray(self.gammas),
+                    jnp.asarray(self._temps), self.rng)
+                active = self.emit(out, n_acc)
+    """
+    assert _lint(RetraceChecker(), {ENGINE: src}).findings == []
+
+
+def test_retrace_spec_per_acceptance_length_wrap_flagged():
+    """The naive speculative tick this PR must NOT ship: wrapping (or
+    keying) the verify per observed acceptance length re-traces on the
+    hot path once per distinct n_acc — acceptance is data, not a
+    program key."""
+    from distributed_llm_tpu.lint.checkers.retrace import RetraceChecker
+    bad = """
+        from functools import partial
+
+        import jax
+
+        def _verify(params, pool, chunk, *, n_acc):
+            return params, pool
+
+        def spec_round(self, n_acc):    # dllm-lint: hot-path
+            # fresh trace per acceptance length — unbounded churn
+            return jax.jit(partial(_verify, n_acc=n_acc))(
+                self.params, self.pool, self.chunk)
+    """
+    result = _lint(RetraceChecker(), {ENGINE: bad})
+    assert "retrace-per-call-wrap" in _rules(result), result.findings
+
+    keyed = """
+        def verify_fn(self, drafted):
+            return self._fns[drafted.shape]   # one program per γ observed
+    """
+    result = _lint(RetraceChecker(), {ENGINE: keyed})
+    assert _rules(result) == ["retrace-shape-cache-key"], result.findings
+
+
 def test_retrace_cow_copy_per_admission_wrap_flagged():
     """The COW boundary copy this PR must NOT ship (ISSUE 10): wrapping
     the one-block copy per admission re-traces on the admit path — the

@@ -467,3 +467,117 @@ def test_sampler_exports_sharing_gauges():
     fields = dict(_GAUGE_FIELDS)
     assert fields["kv_shared_blocks"] == "kv_shared_blocks_g"
     assert fields["kv_dedup_ratio"] == "kv_dedup_ratio_g"
+
+
+# -- speculative rollback × sharing (ISSUE 15) -------------------------------
+
+def _spec_tier(**kw):
+    return _tier(spec_decode=True, draft_preset="nano_test", **kw)
+
+
+def test_spec_rollback_on_shared_prefix_byte_identical_no_crosstalk():
+    """Rejected-tail frontier rewinds on slots whose PREFIX blocks are
+    shared (refcount>1): two concurrent same-prefix sessions speculate
+    (the disagreeing draft forces rejections + rollback every round),
+    outputs match the non-speculating sharing engine byte-for-byte, no
+    crosstalk leaks into the sharer, and every reference drops —
+    refcounts conserved (free list full after stop)."""
+    import dataclasses as _dc
+    prompts = _session_prompts(3)
+    base = _tier(decode_batch=3)
+    eng_plain = ContinuousBatchingEngine(base, seed=11)
+    try:
+        eng_plain.generate(SYS + " seed?")        # park the shared prefix
+        plain = [tuple(r.token_ids)
+                 for r in _run_concurrent(eng_plain, prompts)]
+    finally:
+        eng_plain.stop()
+
+    eng = ContinuousBatchingEngine(
+        _dc.replace(base, spec_decode=True, draft_preset="draft_test"),
+        seed=11)
+    try:
+        eng.generate(SYS + " seed?")
+        spec = [tuple(r.token_ids)
+                for r in _run_concurrent(eng, prompts)]
+        assert eng.spec_stats()["drafted_total"] > 0
+        total = eng.paged.num_blocks - 1
+        eng.prefix_cache.clear()
+        assert eng.allocator.available == total, "leaked references"
+        assert eng.allocator.ref_stats()["allocated_blocks"] == 0
+    finally:
+        eng.stop()
+    assert spec == plain
+
+
+def test_spec_rollback_on_cow_boundary_block():
+    """The COW boundary case: the parked prefix ends MID-block (SYS is
+    ~19 tokens, 19 % 16 != 0), so every shared speculative slot COW'd
+    the boundary at admit — rounds of rejection/rollback must never
+    reach the sharer's copy.  Pinned by byte-identity of a FOLLOW-UP
+    same-prefix session after the speculating sessions finished (its
+    hit maps the original parked blocks: corruption would change its
+    output) plus refcount conservation."""
+    import dataclasses as _dc
+    tier = _dc.replace(_tier(decode_batch=2), spec_decode=True,
+                       draft_preset="draft_test")
+    eng = ContinuousBatchingEngine(tier, seed=11)
+    try:
+        eng.generate(SYS + " seed?")             # parks the mid-block prefix
+        _run_concurrent(eng, _session_prompts(2))
+        follow_spec = tuple(eng.generate(SYS + " follow-up?").token_ids)
+    finally:
+        eng.stop()
+    eng2 = ContinuousBatchingEngine(_tier(decode_batch=2), seed=11)
+    try:
+        eng2.generate(SYS + " seed?")
+        _run_concurrent(eng2, _session_prompts(2))
+        follow_plain = tuple(eng2.generate(SYS + " follow-up?").token_ids)
+    finally:
+        eng2.stop()
+    assert follow_spec == follow_plain
+
+
+def test_spec_tick_cow_protects_externally_shared_frontier_block():
+    """The defensive half of the rollback contract, driven directly: a
+    block inside a slot's speculative write window with a second holder
+    is COW-copied by the pre-round guard — the slot's table swaps to a
+    private copy carrying the same bytes, the shared block's content is
+    untouched, its refcount drops by exactly the slot's reference, and
+    the ledger stays conserved."""
+    from distributed_llm_tpu.engine.batching import _Request, _Slot
+    eng = ContinuousBatchingEngine(
+        _spec_tier(decode_batch=1, max_new_tokens=8,
+                   enable_prefix_cache=False), seed=11)
+    try:
+        blocks = eng.allocator.alloc(2)
+        req = _Request(history="x", max_new_tokens=8, temperature=0.0)
+        slot = _Slot(request=req, blocks=list(blocks), prompt_len=4,
+                     budget=8, temperature=0.0, ttft_ms=0.0,
+                     tokens=[1], max_blocks=4, spec=True,
+                     gamma=eng.spec_gamma_max)
+        eng._slots[0] = slot
+        eng._set_table_row(0, eng._table_row(slot.blocks))
+        eng._pos[0] = 4                       # write window inside block 0
+        shared = slot.blocks[0]
+        eng.allocator.share([shared])         # second holder appears
+        before = np.asarray(eng.pool["k"][:, :, shared])
+
+        eng._ensure_spec_private([0], eng.spec_gamma_max)
+
+        assert shared not in slot.blocks, "guard must swap the block out"
+        fresh = slot.blocks[0]
+        np.testing.assert_array_equal(
+            np.asarray(eng.pool["k"][:, :, shared]), before)
+        np.testing.assert_array_equal(
+            np.asarray(eng.pool["k"][:, :, fresh]), before)   # true copy
+        assert eng.allocator.refcount(shared) == 1            # ours only
+        assert eng.allocator.refcount(fresh) == 1
+        # Conservation: slot blocks + our shared ref account for every
+        # allocated block.
+        eng._slots[0] = None
+        eng.allocator.free(slot.blocks)
+        eng.allocator.free([shared])
+        assert eng.allocator.available == eng.paged.num_blocks - 1
+    finally:
+        eng.stop()
