@@ -416,6 +416,30 @@ def compact(result: dict) -> dict:
         }.items() if v is not None}
         if cm:
             out["replica"] = cm
+    mc = result.get("multichip")
+    if isinstance(mc, dict) and not mc.get("skipped"):
+        # One number each (BENCHMARKS.md r18): the judged tp=2/tp=1
+        # decode tok/s ratio (regression canary on CPU — sharding is
+        # pure overhead there), both rates, the capacity demo verdicts
+        # (refused at tp=1 / served at tp=2 on the straddling budget),
+        # speculation's decode ratio at tp=2, the one-decode-program
+        # pin, and the byte-identity verdict.
+        cap = mc.get("capacity") or {}
+        cm = {k: v for k, v in {
+            "tp_ratio": mc.get("tp_ratio"),
+            "tok_tp1": (mc.get("tp1") or {}).get("tok_per_s"),
+            "tok_tp2": (mc.get("tp2") or {}).get("tok_per_s"),
+            "ragged_tp2": (mc.get("tp2") or {}).get("ragged"),
+            "programs_tp2": (mc.get("tp2") or {}).get("decode_programs"),
+            "cap_refused_tp1": cap.get("tp1_refused"),
+            "cap_served_tp2": cap.get("tp2_served"),
+            "cap_budget_gb": cap.get("hbm_gb_per_chip"),
+            "spec_ratio": mc.get("spec_tok_ratio"),
+            "ident": mc.get("outputs_identical"),
+            "err": (mc.get("error") or "")[:80] or None,
+        }.items() if v is not None}
+        if cm:
+            out["multichip"] = cm
     pf = result.get("profile")
     if isinstance(pf, dict) and not pf.get("skipped"):
         # One number each (BENCHMARKS.md r14): worst per-tier phase
@@ -2304,6 +2328,237 @@ def replica_phase(n_clients: int = 12, n_requests: int = 48,
     return out
 
 
+def multichip_phase(n_requests: int = 8, beat=lambda: None) -> dict:
+    """Tensor-parallel serving leg (ISSUE 16): tp=2 vs tp=1 on the
+    multi-device carve, three parts.
+
+    Part A — **parity + throughput**: the pinned tiny batched tier at
+    tp=1 (one device, no mesh) vs tp=2 (two host devices, params + KV
+    pool sharded over the kv-head axis, the fused ragged tick under
+    shard_map).  ``tp_ratio`` = tp2 decode tok/s over tp1 — pinned
+    cross-round by scripts/bench_trend.py as ``multichip.tp_ratio``.
+    On CPU host devices sharding is pure overhead (two programs on one
+    core plus shard_map glue), so the ratio sits BELOW 1.0 here; the
+    pin is a regression canary for the sharded tick's host-side cost,
+    not a speedup claim — on real chips tp=2 halves per-chip weight
+    bytes, which is the leg's point.  The tp=2 mesh comes from
+    ``carve_tier_meshes`` under ``DLLM_TP=2`` — the env lever a
+    deployment A/B would use — not a hand-built mesh.
+
+    Part B — **capacity demonstration**: a per-chip HBM budget chosen
+    BETWEEN the tier's tp=1 and tp=2 per-chip footprints
+    (utils/hbm_budget.tier_hbm_budget): at tp=1 ``start_server`` must
+    refuse cleanly (TierOverCapacityError, nothing materialized); the
+    SAME budget at tp=2 must serve.  Model size became a config knob.
+
+    Part C — **speculation survives sharding**: spec-on (draft_test,
+    replicated draft) vs spec-off decode tok/s, BOTH on the tp=2 mesh,
+    byte-identical outputs, ``spec_tok_ratio`` >= 1.0 bar.
+
+    HARD invariants (``error``, the skew leg's churn policy): outputs
+    byte-identical across tp degrees and spec modes; at tp=2 the engine
+    must be RAGGED (not the dense windowed fallback) and mint exactly
+    ONE decode program (compiled-set + dllm_compiled_programs gauge
+    agreement), with verify programs bounded by the (γ_bucket) family.
+    The full result is also checkpointed to the next free
+    ``MULTICHIP_r*.json`` beside the driver's dryrun captures."""
+    import dataclasses
+    import os
+    import sys
+
+    from distributed_llm_tpu.config import tiny_batched_cluster
+    from distributed_llm_tpu.engine.batching import ContinuousBatchingEngine
+    from distributed_llm_tpu.engine.manager import (EngineManager,
+                                                    TierOverCapacityError)
+    from distributed_llm_tpu.obs import get_observability
+    from distributed_llm_tpu.parallel.mesh import carve_tier_meshes
+    from distributed_llm_tpu.utils.hbm_budget import tier_hbm_budget
+
+    import jax
+
+    print("[bench] multichip (tensor-parallel) leg", file=sys.stderr,
+          flush=True)
+    devs = jax.devices()
+    if len(devs) < 2:
+        return {"skipped": "single_device"}
+    cluster = tiny_batched_cluster()
+    base = dataclasses.replace(cluster.nano, max_new_tokens=24,
+                               enable_prefix_cache=False)
+    short_q = "short question about rivers please"
+    long_q = ("long question: " + "rivers lakes mountains oceans deltas "
+              * 16)
+    prompts = [(short_q if i % 2 else long_q) + f" variant {i}"
+               for i in range(n_requests)]
+    out: dict = {"n_devices": len(devs), "requests": n_requests,
+                 "decode_batch": base.decode_batch}
+
+    # The tp=2 mesh through the deployment lever: DLLM_TP forces the
+    # carve's requested degree past the preset's tp=1.
+    saved_tp = os.environ.get("DLLM_TP")
+    os.environ["DLLM_TP"] = "2"
+    try:
+        mesh2 = carve_tier_meshes(
+            dataclasses.replace(cluster, nano=base))["nano"]
+    finally:
+        if saved_tp is None:
+            os.environ.pop("DLLM_TP", None)
+        else:
+            os.environ["DLLM_TP"] = saved_tp
+    if dict(mesh2.shape).get("tp") != 2:
+        return {"error": f"DLLM_TP=2 carve produced {dict(mesh2.shape)}"}
+
+    def measure(tier, mesh, seed=7):
+        eng = ContinuousBatchingEngine(tier, seed=seed, mesh=mesh)
+        try:
+            eng.warmup()
+            eng.generate(long_q, max_new_tokens=24)
+            eng.generate(short_q, max_new_tokens=24)
+            beat()
+            eng.tick_ms.clear()
+            t0 = time.perf_counter()
+            reqs = [eng.submit(p) for p in prompts]
+            for r in reqs:
+                r.done.wait(timeout=300)
+            wall = time.perf_counter() - t0
+            gen_tokens = sum(r.result.gen_tokens for r in reqs
+                             if r.result is not None)
+            decode_s = sum(eng.tick_ms) / 1000.0
+            gauge = None
+            try:
+                gauge = get_observability().m.compiled_programs.labels(
+                    eng.tier.name, "decode").value
+            except Exception:
+                pass
+            return {
+                "tokens": [tuple(r.result.token_ids) for r in reqs
+                           if r.result is not None],
+                "errors": sum(1 for r in reqs if r.error is not None),
+                "tok_per_s": round(gen_tokens / max(decode_s, 1e-9), 3),
+                "wall_tok_per_s": round(gen_tokens / max(wall, 1e-9), 3),
+                "ragged": bool(eng.ragged),
+                "spec": bool(eng.spec),
+                "decode_programs": len(eng._compiled.get("decode", ())),
+                "verify_programs": len(eng._compiled.get("verify", ())),
+                "gamma_family": len(eng._gamma_buckets),
+                "decode_gauge": gauge,
+                "spec_stats": (eng.spec_stats() if eng.spec else None),
+            }
+        finally:
+            eng.stop()
+
+    # ---- Part A: tp=1 vs tp=2 parity + throughput -----------------------
+    r1 = measure(base, None)
+    r2 = measure(base, mesh2)
+    for key, r in (("tp1", r1), ("tp2", r2)):
+        out[key] = {k: r[k] for k in ("tok_per_s", "wall_tok_per_s",
+                                      "errors", "ragged",
+                                      "decode_programs", "decode_gauge")}
+    if r1["tok_per_s"] and r2["tok_per_s"]:
+        out["tp_ratio"] = round(r2["tok_per_s"] / r1["tok_per_s"], 3)
+    ident_tp = (len(r1["tokens"]) == n_requests
+                and r1["tokens"] == r2["tokens"])
+    if not r2["ragged"]:
+        out["error"] = ("tp=2 engine fell back to the dense windowed "
+                        "tick — the sharded ragged path did not arm")
+    elif r2["decode_programs"] != 1 or (
+            r2["decode_gauge"] is not None and r2["decode_gauge"] != 1.0):
+        out["error"] = (f"tp=2 ragged engine minted "
+                        f"{r2['decode_programs']} decode program(s), "
+                        f"gauge={r2['decode_gauge']} — expected 1")
+    beat()
+
+    # ---- Part B: capacity — refuse at tp=1, serve at tp=2 ---------------
+    # nano_test's footprint vanishes under the budget's rounding, so
+    # the demo runs on mini_bench (~25M params, heads divisible by 2):
+    # big enough that halving the per-chip share is a REAL gap, small
+    # enough to actually serve on the CPU box.
+    cap_tier = dataclasses.replace(
+        base, model_preset="mini_bench", decode_batch=2,
+        max_new_tokens=8, prefill_buckets=(16, 32, 64))
+    b1 = tier_hbm_budget(cap_tier)
+    b2 = tier_hbm_budget(dataclasses.replace(cap_tier, tp=2),
+                         mesh=mesh2)
+    # A budget straddling the two per-chip footprints (+0.75 GB is the
+    # budget's fixed activation headroom): tp=1 cannot fit, tp=2 can.
+    hbm = round((b1["total_gb_per_chip"] + b2["total_gb_per_chip"]) / 2
+                + 0.75, 4)
+    cap = {"model": "mini_bench", "hbm_gb_per_chip": hbm,
+           "tp1_gb_per_chip": b1["total_gb_per_chip"],
+           "tp2_gb_per_chip": b2["total_gb_per_chip"]}
+    mgr1 = EngineManager(
+        dataclasses.replace(cap_tier, hbm_gb_per_chip=hbm),
+        devices=[devs[0]], warmup_on_start=False, seed=cluster.seed)
+    try:
+        mgr1.start_server()
+        cap["tp1_refused"] = False
+        mgr1.stop_server()
+    except TierOverCapacityError as exc:
+        cap["tp1_refused"] = True
+        cap["refusal"] = str(exc)[:160]
+    mgr2 = EngineManager(
+        dataclasses.replace(cap_tier, tp=2, hbm_gb_per_chip=hbm),
+        mesh=mesh2, warmup_on_start=False, seed=cluster.seed)
+    try:
+        mgr2.start_server()
+        res = mgr2.engine().generate(short_q, max_new_tokens=8)
+        cap["tp2_served"] = bool(res.token_ids)
+    except TierOverCapacityError as exc:
+        cap["tp2_served"] = False
+        cap["tp2_refusal"] = str(exc)[:160]
+    finally:
+        mgr2.stop_server()
+    out["capacity"] = cap
+    if not (cap.get("tp1_refused") and cap.get("tp2_served")):
+        out.setdefault("error", f"capacity demo failed: {cap}")
+    beat()
+
+    # ---- Part C: speculation at tp=2 ------------------------------------
+    spec_tier = dataclasses.replace(base, spec_decode=True,
+                                    draft_preset="draft_test")
+    rs = measure(spec_tier, mesh2)
+    st = rs["spec_stats"] or {}
+    out["spec_tp2"] = {
+        "tok_per_s": rs["tok_per_s"],
+        "errors": rs["errors"],
+        "armed": rs["spec"],
+        "accept_ratio": st.get("accept_ratio"),
+        "drafted_total": st.get("drafted_total"),
+        "verify_programs": rs["verify_programs"],
+        "gamma_family": rs["gamma_family"],
+    }
+    if rs["tok_per_s"] and r2["tok_per_s"]:
+        out["spec_tok_ratio"] = round(rs["tok_per_s"] / r2["tok_per_s"], 3)
+    ident_spec = rs["tokens"] == r2["tokens"]
+    if not rs["spec"]:
+        out.setdefault("error", "spec_decode did not arm on the tp=2 mesh")
+    elif rs["verify_programs"] > rs["gamma_family"]:
+        out.setdefault("error",
+                       f"verify compile churn at tp=2: "
+                       f"{rs['verify_programs']} programs for a "
+                       f"(γ_bucket) family of {rs['gamma_family']}")
+
+    out["outputs_identical"] = bool(ident_tp and ident_spec)
+    if not out["outputs_identical"]:
+        out.setdefault("error",
+                       "sharded outputs diverged (tp identical: "
+                       f"{ident_tp}, spec identical: {ident_spec})")
+
+    # Checkpoint beside the driver's dryrun captures: next free slot.
+    try:
+        root = os.path.dirname(os.path.abspath(__file__))
+        n = 1
+        while os.path.exists(os.path.join(root,
+                                          f"MULTICHIP_r{n:02d}.json")):
+            n += 1
+        with open(os.path.join(root, f"MULTICHIP_r{n:02d}.json"),
+                  "w") as f:
+            json.dump({"phase": "multichip", **out}, f, indent=1,
+                      default=str)
+    except OSError:
+        pass                              # read-only checkout: keep the leg
+    return out
+
+
 def concurrent_phase(cluster, n_requests: int = 12, n_sequential: int = 4,
                      slots: int = 4, max_new: int = 32, repeat: int = 3,
                      beat=lambda: None) -> dict:
@@ -3368,6 +3623,21 @@ def run(progress: "Progress" = None, budget: "Budget" = None) -> dict:
     else:
         replica = {"skipped": budget.skip_stamp()}
     progress.section("replica", replica)
+    progress.flush_compact()
+
+    # Multichip tensor-parallel leg (ISSUE 16): tp=2 vs tp=1 parity +
+    # decode-rate ratio on the DLLM_TP-forced carve, the capacity
+    # demonstration (a per-chip HBM budget only tp=2 fits — refusal at
+    # tp=1 is clean), and speculation surviving sharding (spec-on /
+    # spec-off decode ratio, both at tp=2) — BENCHMARKS.md r18.
+    if budget.allows(120):
+        try:
+            multichip = multichip_phase(beat=progress.beat)
+        except Exception as exc:          # never lose the headline line
+            multichip = {"error": str(exc)[:200]}
+    else:
+        multichip = {"skipped": budget.skip_stamp()}
+    progress.section("multichip", multichip)
     progress.flush_compact()
 
     # Open-loop SLO goodput leg right after the skew leg (ISSUE 7; same

@@ -1,0 +1,44 @@
+"""JAX API compatibility shims.
+
+``shard_map`` graduated out of ``jax.experimental`` (jax 0.4.35+ exposes
+``jax.shard_map``; newer releases also renamed ``check_rep`` to
+``check_vma``).  This container's jax only ships the experimental
+spelling, which used to kill seven test modules at import time (PR 12
+turned those into env-skips).  Import from here instead of from jax so
+the package runs on either side of the move:
+
+    from ..compat import shard_map
+
+The wrapper also translates the replication-check kwarg: callers write
+the modern ``check_vma=`` and the shim renames it to ``check_rep=`` when
+the underlying implementation predates the rename (and vice versa), so
+call sites never need a version switch.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # modern spelling (jax >= 0.4.35): jax.shard_map
+    from jax import shard_map as _impl
+    if not callable(_impl):  # some versions expose a module of that name
+        _impl = _impl.shard_map  # type: ignore[attr-defined]
+except ImportError:  # pre-graduation spelling
+    from jax.experimental.shard_map import shard_map as _impl
+
+_PARAMS = inspect.signature(_impl).parameters
+_ACCEPTS_CHECK_VMA = "check_vma" in _PARAMS
+_ACCEPTS_CHECK_REP = "check_rep" in _PARAMS
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` with the replication-check kwarg translated to
+    whatever this jax calls it (``check_vma`` <-> ``check_rep``)."""
+    if "check_vma" in kwargs and not _ACCEPTS_CHECK_VMA:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif "check_rep" in kwargs and not _ACCEPTS_CHECK_REP:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _impl(f, **kwargs)
+
+
+__all__ = ["shard_map"]

@@ -256,6 +256,14 @@ class TierConfig:
     # dispatch collectives; attention/caches stay on 'tp'.  Dense models
     # ignore it.
     ep: int = 1
+    # Per-chip HBM residency budget in GB (utils/hbm_budget.py).  When
+    # set, EngineManager.start_server budgets params + KV against the
+    # tier's DEPLOYED submesh before building the engine and refuses
+    # cleanly (TierOverCapacityError) when the footprint doesn't fit —
+    # the tp=1-vs-tp=2 capacity demonstration in bench.py's multichip
+    # leg rides this.  None (the default) keeps the historical behavior:
+    # no admission-time budget, OOM surfaces wherever XLA hits it.
+    hbm_gb_per_chip: Optional[float] = None
     max_new_tokens: int = 256       # decode cap (reference: num_predict, -1=unbounded)
     temperature: float = 0.0        # greedy by default (src/devices/nano_api.py:21)
     prefill_buckets: Tuple[int, ...] = (64, 128, 256, 512, 1024, 2048)
@@ -283,9 +291,12 @@ class TierConfig:
     # ladder minted one per (bucket, window) pair), the host stops
     # re-uploading sliced tables every tick, and on TPU the Pallas kernel
     # streams each slot's own frontier so length skew costs per-slot
-    # work, not the batch max.  Unsharded engines only — TP meshes keep
-    # the dense windowed path (a pallas_call has no GSPMD rule, and the
-    # shard-mapped hook is rung-specialized).  On TPU the request is
+    # work, not the batch max.  On a ('batch','tp') tier mesh the fused
+    # tick runs UNDER shard_map over the kv-head axis (PR 16,
+    # parallel/tp_attention.tp_ragged_decode_attn) when the mesh
+    # qualifies — dense model, sp=ep=1, tp divides both head counts
+    # (parallel/tp_attention._tp_ragged_ok); non-qualifying meshes keep
+    # the dense windowed path.  On TPU the request is
     # additionally GATED by the measured dispatch verdict: while
     # ab_dispatch.json still says 'xla' for ragged_decode (the
     # conservative pre-measure rows), the engine keeps the dense

@@ -64,9 +64,17 @@ ENV_VARS: Dict[str, EnvVar] = {v.name: v for v in (
     _e("DLLM_RAGGED", None, "engine/batching.py",
        "'1' forces the batched engine's ragged fused decode TICK on, "
        "'0' forces the dense windowed path; unset = "
-       "TierConfig.attention_ragged decides (TP meshes stay dense "
-       "either way; the kernel inside the tick is DLLM_ATTENTION / "
-       "dispatch-table territory)."),
+       "TierConfig.attention_ragged decides.  On a qualifying TP mesh "
+       "the fused tick runs under shard_map over the kv-head axis "
+       "(parallel/tp_attention._tp_ragged_ok); non-qualifying meshes "
+       "keep the dense windowed path regardless of this flag.  The "
+       "kernel inside the tick is DLLM_ATTENTION / dispatch-table "
+       "territory."),
+    _e("DLLM_TP", None, "parallel/mesh.py",
+       "Forces every tier's REQUESTED tensor-parallel degree for the "
+       "mesh carve (parallel/mesh.requested_tp — the multichip bench "
+       "leg's A/B lever), overriding TierConfig.tp; feasibility clamps "
+       "(head divisibility, available chips) still apply."),
     _e("DLLM_NATIVE", None, "native/__init__.py",
        "'0' disables the g++-built native tokenizer/counter helpers; "
        "behavior is bit-identical to the pure-Python fallback."),
@@ -165,6 +173,13 @@ CONFIG_FIELDS: Dict[str, str] = {
                      "attention over the 'sp' axis; dense only).",
     "TierConfig.ep": "Expert-parallel degree for MoE tiers (whole experts "
                      "sharded over 'ep').",
+    "TierConfig.hbm_gb_per_chip": "Per-chip HBM budget (GB): when set, "
+                                  "start_server eval_shape-budgets "
+                                  "params + KV against the deployed "
+                                  "submesh and refuses cleanly "
+                                  "(TierOverCapacityError) when it "
+                                  "doesn't fit; None = no admission "
+                                  "budget.",
     "TierConfig.max_new_tokens": "Decode cap per request (reference "
                                  "num_predict).",
     "TierConfig.temperature": "Sampling temperature; 0 = greedy "
@@ -181,7 +196,8 @@ CONFIG_FIELDS: Dict[str, str] = {
                                    "ragged paged-attention call over "
                                    "full block tables with per-slot "
                                    "lengths (no bucketed window rungs); "
-                                   "unsharded engines only.",
+                                   "qualifying TP meshes run it under "
+                                   "shard_map over the kv-head axis.",
     "TierConfig.prefill_chunk_tokens": "Cold prompts past one chunk "
                                        "prefill in fixed chunks of this "
                                        "many tokens interleaved with "

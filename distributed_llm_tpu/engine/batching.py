@@ -484,26 +484,43 @@ class ContinuousBatchingEngine:
         # a skewed mix's low-acceptance tenant is visible next to the
         # aggregate ratio.
         self._spec_slot_acc: Dict[int, List[int]] = {}
+        self._pool_shardings_d = None
         if tier.spec_decode and self._resolve_spec():
             self.spec = True
             dcfg = tier.draft_model()
-            self.cfg_d = upgrade_attention_impl(dcfg, None)
+            self.cfg_d = upgrade_attention_impl(dcfg, mesh)
             if tier.draft_preset == tier.model_preset:
                 # Self-draft: the draft IS the target (weights shared,
                 # zero extra parameter memory) — acceptance approaches
                 # 1.0 and the tick's win is the fused γ+1-token verify
                 # amortizing the per-tick dispatch.  The bench's spec
                 # leg measures this configuration; a genuinely smaller
-                # draft_preset swaps in transparently.
+                # draft_preset swaps in transparently.  Under a TP mesh
+                # the shared weights are the SHARDED weights, so the
+                # draft rounds run through the same shard-mapped ragged
+                # hook as the tick (PR 16).
                 self.params_d = self.params
+                self._pool_shardings_d = self._pool_shardings
             else:
                 init_d = jax.jit(partial(models.init_params, self.cfg_d),
                                  static_argnames=("seed",))
                 from ..ops.quant import maybe_quantize as _mq
                 self.params_d = _mq(init_d(seed=seed + 1), tier, self.cfg_d)
+                if mesh is not None:
+                    # A genuinely smaller draft stays REPLICATED: each
+                    # chip drafts the whole batch locally (its params
+                    # are small by construction) and only the verify is
+                    # sharded — no draft-side collectives, and the COW /
+                    # rewind bookkeeping sees one draft pool image.
+                    self.params_d = jax.device_put(self.params_d,
+                                                   self._replicated)
+                    self._pool_shardings_d = self._replicated
             # Draft pool: same geometry (block count/size) as the target
             # pool so the target's block tables index it directly.
             self.pool_d = init_pool(self.cfg_d, self.paged, tier.kv_quantize)
+            if self._pool_shardings_d is not None:
+                self.pool_d = jax.device_put(self.pool_d,
+                                             self._pool_shardings_d)
             from ..utils import roofline as _roofline
             self._wbytes_d = _roofline.weight_bytes(self.cfg_d,
                                                     tier.quantize)
@@ -552,8 +569,12 @@ class ContinuousBatchingEngine:
     def _resolve_ragged(self) -> bool:
         """Whether the decode tick runs the ragged fused path.
 
-        Policy: (a) TP meshes never do — a pallas_call has no GSPMD rule
-        and the shard-mapped hook is rung-specialized; (b) DLLM_RAGGED
+        Policy: (a) meshes ride along IF the shard-mapped ragged hook
+        can own whole kv-head groups per chip (tp-only mesh, dense
+        model, tp divides both head counts — parallel/tp_attention.py
+        ``_tp_ragged_ok``); a mesh the hook can't serve keeps the dense
+        windowed path, since inside a plain jit a pallas_call has no
+        GSPMD rule; (b) DLLM_RAGGED
         forces the TICK SHAPE ('1' fused, '0' dense windowed) — which
         KERNEL serves the fused tick's attention is a separate, measured
         choice (the dispatch table, overridable by DLLM_ATTENTION=pallas
@@ -571,7 +592,13 @@ class ContinuousBatchingEngine:
         the table is that an on-chip A/B flipping ragged_decode to
         'pallas' flips this engine to the kernel with no code change."""
         if self.mesh is not None:
-            return False
+            from ..parallel.tp_attention import _tp_ragged_ok
+            if not _tp_ragged_ok(self.mesh, self.cfg):
+                return False
+            try:
+                from ..compat import shard_map  # noqa: F401
+            except ImportError:
+                return False
         from ..config_registry import env_str
         raw = env_str("DLLM_RAGGED")
         if raw is not None and raw not in ("0", "1"):
@@ -595,8 +622,9 @@ class ContinuousBatchingEngine:
         blocks: a ``draft_preset`` (the drafting model — the target's
         own preset is the zero-extra-weights self-draft), the fused
         ragged tick (the verify call IS the ragged kernel's q_len=γ+1
-        face; the dense windowed tick has no verify shape), no TP mesh
-        (same rule as ragged), a greedy tier default (per-REQUEST
+        face; the dense windowed tick has no verify shape — a TP mesh
+        qualifies exactly when its tick went ragged, PR 16), a greedy
+        tier default (per-REQUEST
         temperature>0 just degrades that slot to γ=0; a sampled tier
         default would degrade every slot, so it reads as
         misconfiguration), and a draft context covering the target's
@@ -606,7 +634,7 @@ class ContinuousBatchingEngine:
             logger.warning("tier %s: spec_decode=True ignored — no "
                            "draft_preset configured", tier.name)
             return False
-        if not self.ragged or self.mesh is not None:
+        if not self.ragged:
             logger.warning(
                 "tier %s: spec_decode=True ignored — batched speculation "
                 "needs the fused ragged tick (ragged=%s, mesh=%s)",
@@ -637,6 +665,15 @@ class ContinuousBatchingEngine:
                 self.cfg.max_seq_len)
             return False
         return True
+
+    def _tp_degree(self) -> int:
+        """Tensor-parallel degree of this engine's mesh (1 unsharded) —
+        part of every decode/draft/verify program-family key, so a tp=2
+        engine's programs never alias a tp=1 engine's in the compiled-
+        program accounting (ISSUE 16)."""
+        if self.mesh is None:
+            return 1
+        return dict(self.mesh.shape).get("tp", 1)
 
     def _gamma_bucket(self, g: int) -> int:
         """Smallest registered γ bucket covering ``g`` — the static
@@ -726,12 +763,18 @@ class ContinuousBatchingEngine:
         quantized = self.tier.kv_quantize == "int8"
 
         def run(params, pool, tables, pos, cur, temps, rng):
-            # TP tiers: per-head-shard paged flash decode (the window
-            # width is static per trace, so the hook resolves here).
-            # Ragged engines are unsharded by construction, so the two
-            # paths never meet.
+            # TP tiers: ragged ticks wrap the DISPATCHING ragged decode
+            # in shard_map over the kv-head axis (PR 16 — the fused
+            # paged path runs sharded, combine is a head concat); dense
+            # ticks keep the per-head-shard paged flash decode (the
+            # window width is static per trace, so the hook resolves
+            # here).
             attn = None
-            if cfg.num_experts == 1 and not ragged:
+            if cfg.num_experts == 1 and ragged:
+                from ..parallel.tp_attention import tp_ragged_decode_attn
+                attn = tp_ragged_decode_attn(mesh, cfg,
+                                             quantized=quantized)
+            elif cfg.num_experts == 1:
                 from ..parallel.tp_attention import tp_paged_decode_attn
                 attn = tp_paged_decode_attn(
                     mesh, cfg, tables.shape[1] * self.paged.block_size,
@@ -896,22 +939,50 @@ class ContinuousBatchingEngine:
         if key in self._spec_fns:
             return self._spec_fns[key]
         self._note_compile("draft", (gb, self.paged.blocks_per_slot
-                                     * self.paged.block_size))
+                                     * self.paged.block_size,
+                                     self._tp_degree()))
         cfg_d = self.cfg_d
         max_pos = self.cfg.max_seq_len - 1
+        quantized = self.tier.kv_quantize == "int8"
+        attn = None
+        if self.mesh is not None and cfg_d.num_experts == 1:
+            if self.params_d is self.params:
+                # Self-draft shares the SHARDED target weights: draft
+                # rounds run the same shard-mapped ragged hook as the
+                # decode tick (PR 16).
+                from ..parallel.tp_attention import tp_ragged_decode_attn
+                attn = tp_ragged_decode_attn(self.mesh, cfg_d,
+                                             quantized=quantized)
+            else:
+                # Replicated small draft: every chip drafts the full
+                # batch locally inside an all-replicated shard_map
+                # region (the dispatcher may pick Pallas per device,
+                # which a plain jit over the mesh cannot).
+                from ..parallel.tp_attention import tp_local_ragged_decode
+                attn = tp_local_ragged_decode(self.mesh,
+                                              impl=cfg_d.attention_impl,
+                                              quantized=quantized)
 
         def run(params_d, pool_d, tables, pos, cur):
             def step(carry, _):
                 pool_d, tok, p = carry
                 logits, pool_d = decode_step_paged(
-                    cfg_d, params_d, tok, p, pool_d, tables, ragged=True)
+                    cfg_d, params_d, tok, p, pool_d, tables, attn=attn,
+                    ragged=True)
                 nxt = jnp.argmax(logits, -1).astype(jnp.int32)
                 return (pool_d, nxt, jnp.minimum(p + 1, max_pos)), nxt
             (pool_d, _, _), drafted = jax.lax.scan(
                 step, (pool_d, cur, pos), None, length=gb + 1)
             return jnp.swapaxes(drafted, 0, 1)[:, :gb], pool_d   # [B, γ]
         donate = (1,) if jax.default_backend() != "cpu" else ()
-        fn = jax.jit(run, donate_argnums=donate)
+        kw = {}
+        if self._pool_shardings_d is not None:
+            # Pin the draft pool's placement (sharded for self-draft,
+            # replicated for a small draft) — an unpinned output is free
+            # to come back resharded, silently multiplying KV memory.
+            kw["out_shardings"] = (self._replicated,
+                                   self._pool_shardings_d)
+        fn = jax.jit(run, donate_argnums=donate, **kw)
         self._spec_fns[key] = fn
         return fn
 
@@ -920,21 +991,30 @@ class ContinuousBatchingEngine:
         ``verify_step_paged`` call over every slot's γ+1 chunk (q_len =
         γ+1 on the ragged kernel face), greedy acceptance with the
         per-slot runtime γ cap, and the emitted-token assembly, all on
-        device.  Keyed ONLY by (γ_bucket, pool span) through
+        device.  Keyed ONLY by (γ_bucket, pool span, tp) through
         ``_note_compile("verify")``: per-slot γ and acceptance lengths
         are runtime operands, so adaptation never mints a program."""
         key = ("spec_verify", gb)
         if key in self._spec_fns:
             return self._spec_fns[key]
         self._note_compile("verify", (gb, self.paged.blocks_per_slot
-                                      * self.paged.block_size))
+                                      * self.paged.block_size,
+                                      self._tp_degree()))
         cfg = self.cfg
+        attn = None
+        if self.mesh is not None and cfg.num_experts == 1:
+            # ONE fused sharded verify call (PR 16): q [B, γ+1, Nq, D]
+            # sharded on its head axis, combine is a head concat.
+            from ..parallel.tp_attention import tp_ragged_verify_attn
+            attn = tp_ragged_verify_attn(
+                self.mesh, cfg,
+                quantized=self.tier.kv_quantize == "int8")
 
         def run(params, pool, tables, pos, cur, drafted, gammas, temps,
                 rng):
             chunk = jnp.concatenate([cur[:, None], drafted], axis=1)
             logits, pool = verify_step_paged(cfg, params, chunk, pos,
-                                             pool, tables)
+                                             pool, tables, attn=attn)
             picks = jnp.argmax(logits, -1).astype(jnp.int32)  # [B, γ+1]
             # First-row pick is temperature-aware: a sampled slot rides
             # γ=0 and its one token per round must come from the same
@@ -954,7 +1034,11 @@ class ContinuousBatchingEngine:
                                     axis=1))
             return out, n_acc, pool
         donate = (1,) if jax.default_backend() != "cpu" else ()
-        fn = jax.jit(run, donate_argnums=donate)
+        kw = {}
+        if self._pool_shardings is not None:
+            kw["out_shardings"] = (self._replicated, self._replicated,
+                                   self._pool_shardings)
+        fn = jax.jit(run, donate_argnums=donate, **kw)
         self._spec_fns[key] = fn
         return fn
 
@@ -2339,7 +2423,7 @@ class ContinuousBatchingEngine:
                                      jnp.asarray(self._temps), rng)
                         out, n_acc = _fetch_tick((out, n_acc))
                 else:
-                    self._note_compile("decode", wb)
+                    self._note_compile("decode", (wb, self._tp_degree()))
                     with self.phases.phase("decode"), \
                             self.profiler.phase("decode"):
                         toks, self.pool = self._decode_step()(
@@ -2885,7 +2969,7 @@ class ContinuousBatchingEngine:
         # nothing left to warm.
         for w in ([] if self.ragged else self._buckets[1:2]):
             wb = min(w // self.paged.block_size, self.paged.blocks_per_slot)
-            self._note_compile("decode", wb)
+            self._note_compile("decode", (wb, self._tp_degree()))
             self._rng, rng = jax.random.split(self._rng)
             toks, self.pool = self._decode_step()(
                 self.params, self.pool, jnp.asarray(self._tables[:, :wb]),

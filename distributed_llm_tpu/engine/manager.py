@@ -27,6 +27,16 @@ from .inference import InferenceEngine
 logger = logging.getLogger(__name__)
 
 
+class TierOverCapacityError(RuntimeError):
+    """A tier with ``hbm_gb_per_chip`` set does not fit its deployed
+    submesh: params + KV per chip exceed the budget
+    (utils/hbm_budget.tier_hbm_budget).  Raised by ``start_server``
+    BEFORE any weights materialize, so the refusal is clean — no
+    half-allocated engine, no device OOM mid-warmup.  The fix is a
+    config change: raise ``tp`` (shard the footprint over more chips),
+    shrink the model/KV, or clear the budget."""
+
+
 class EngineManager:
     def __init__(
         self,
@@ -85,6 +95,30 @@ class EngineManager:
                 except Exception:
                     pass                     # stub controllers in tests
             t0 = time.perf_counter()
+            if self.tier.hbm_gb_per_chip is not None:
+                # Admission-time residency budget (PR 16): eval_shape
+                # only — nothing materializes before the verdict.
+                from ..utils.hbm_budget import tier_hbm_budget
+                budget = tier_hbm_budget(
+                    self.tier, devices=self.devices,
+                    hbm_per_chip_gb=self.tier.hbm_gb_per_chip,
+                    mesh=self.mesh)
+                if not budget["fits"]:
+                    raise TierOverCapacityError(
+                        f"tier {self.tier.name}: "
+                        f"{budget['total_gb_per_chip']} GB/chip "
+                        f"(params {budget['params_gb_per_chip']} + KV "
+                        f"{budget['kv_gb_per_chip']}) plus the 0.75 GB "
+                        f"activation headroom exceeds the "
+                        f"hbm_gb_per_chip={self.tier.hbm_gb_per_chip} "
+                        f"budget on {budget['chips']} chip(s) — raise "
+                        f"tp to shard the footprint over more chips")
+                logger.info(
+                    "tier %s: fits %s GB/chip budget (%s GB/chip over "
+                    "%d chip(s), headroom %s GB)", self.tier.name,
+                    self.tier.hbm_gb_per_chip,
+                    budget["total_gb_per_chip"], budget["chips"],
+                    budget["headroom_gb"])
             params = None
             if self.tier.checkpoint_path:
                 from ..utils.checkpoint import load_params_for_tier
@@ -94,13 +128,21 @@ class EngineManager:
                 if beat is not None:
                     beat()
             use_speculative = bool(self.tier.draft_preset)
-            if use_speculative and (self.mesh is not None
-                                    or self.tier.temperature > 0):
+            if use_speculative and (self.tier.temperature > 0
+                                    or (self.mesh is not None
+                                        and self.tier.decode_batch <= 1)):
+                # The SEQUENTIAL speculative engine stays unsharded; the
+                # batched path (decode_batch>1) rides the ragged tick,
+                # which PR 16 runs under shard_map on a TP mesh — a mesh
+                # no longer disqualifies it.  Sampling still does: both
+                # paths are greedy-exact.
                 logger.warning(
-                    "tier %s: draft_preset=%s ignored (speculative decoding "
-                    "is greedy-only and unsharded; mesh=%s temperature=%s)",
+                    "tier %s: draft_preset=%s ignored (sequential "
+                    "speculative decoding is greedy-only and unsharded; "
+                    "mesh=%s temperature=%s decode_batch=%d)",
                     self.tier.name, self.tier.draft_preset,
-                    self.mesh is not None, self.tier.temperature)
+                    self.mesh is not None, self.tier.temperature,
+                    self.tier.decode_batch)
                 use_speculative = False
             if use_speculative and self.tier.decode_batch > 1:
                 # Batched speculative path (ISSUE 15, retiring the PR 1
@@ -142,7 +184,7 @@ class EngineManager:
 
                 from .batching import ContinuousBatchingEngine
                 tier_eff = self.tier
-                if (self.tier.draft_preset and self.mesh is None
+                if (self.tier.draft_preset
                         and self.tier.temperature <= 0
                         and self.tier.spec_decode is None):
                     # AUTO (the tri-state default): the draft is the

@@ -389,6 +389,7 @@ def verify_step_paged(
     pos: jax.Array,            # [B] the FIRST chunk token's position
     pool: KVPool,
     tables: jax.Array,         # [B, MB] FULL table rows (ragged contract)
+    attn=None,                 # (q, kp, vp, tables, pos, ks, vs) override
 ) -> Tuple[jax.Array, KVPool]:
     """One batched SPECULATIVE-VERIFY forward over paged caches: the
     q_len=γ+1 twin of ``decode_step_paged`` (ISSUE 15).  Each slot's
@@ -428,6 +429,10 @@ def verify_step_paged(
         TRASH_BLOCK)                                   # [B, G]
     off = wpos % bs
     quantized = "ks" in pool
+    if attn is None:
+        attn = lambda q, kp, vp, tbl, p, ks, vs: attention.ragged_verify(
+            q, kp, vp, tbl, p, impl=cfg.attention_impl,
+            k_scale=ks, v_scale=vs)
 
     def layer(x, scanned):
         if quantized:
@@ -455,9 +460,8 @@ def verify_step_paged(
         k_pool = k_pool.at[:, blk, off].set(k_rows)
         v_pool = v_pool.at[:, blk, off].set(v_rows)
 
-        attn_out = attention.ragged_verify(
-            q, k_pool, v_pool, tables, pos, impl=cfg.attention_impl,
-            k_scale=ks_pool, v_scale=vs_pool)          # [B, G, Nq, d]
+        attn_out = attn(q, k_pool, v_pool, tables, pos,
+                        ks_pool, vs_pool)              # [B, G, Nq, d]
 
         x = x + quant.matmul(
             attn_out.reshape(b, g, cfg.num_heads * d), lp["wo"])

@@ -154,13 +154,23 @@ def _fit_sp(tier: TierConfig, available: int, tp: int) -> int:
     return sp
 
 
+def requested_tp(tier: TierConfig) -> int:
+    """The tier's requested tensor-parallel degree with the ``DLLM_TP``
+    env override applied — the bench A/B lever (multichip leg): force
+    every tier's carve to one tp degree without editing presets.
+    Feasibility clamps (head divisibility, available chips) still run
+    after this in ``_fit_tp``."""
+    from ..config_registry import env_int
+    return max(1, env_int("DLLM_TP", tier.tp))
+
+
 def _fit_tp(tier: TierConfig, available: int) -> int:
     """Largest feasible tensor-parallel degree ≤ requested, dividing the
     model's kv-head count (GQA shards whole kv heads)."""
     if available <= 0:
         return 0
     cfg = tier.model()
-    tp = min(tier.tp, available)
+    tp = min(requested_tp(tier), available)
     while tp > 1 and (cfg.num_kv_heads % tp or cfg.num_heads % tp):
         tp -= 1
     return max(tp, 1)

@@ -419,7 +419,9 @@ class ReplicatedTierClient:
         n = tier.replicas
         devs = (list(mesh.devices.flat) if mesh is not None
                 else list(devices or []))
-        groups = _split_devices(devs, n, tier.tp)
+        from ..parallel.mesh import requested_tp
+        tp_req = requested_tp(tier)       # honors the DLLM_TP override
+        groups = _split_devices(devs, n, tp_req)
         self.clients: List[TierClient] = []
         managers: List[EngineManager] = []
         for i in range(n):
@@ -439,7 +441,7 @@ class ReplicatedTierClient:
                 # must not inflate tp past the config).
                 mgr = EngineManager(
                     rtier,
-                    mesh=tp_mesh(group, min(max(1, tier.tp), len(group))),
+                    mesh=tp_mesh(group, min(max(1, tp_req), len(group))),
                     seed=seed, warmup_on_start=warmup_on_start)
             else:
                 mgr = EngineManager(rtier,

@@ -126,6 +126,19 @@ class Trainer:
         # Eager init: optax moments are zeros_like(param), which preserves
         # each param's NamedSharding; scalar counters stay replicated.
         self.opt_state = self.optimizer.init(self.params)
+        # Pin the opt state's shardings too: it is DONATED, and an
+        # unpinned jit output is free to come back resharded (some jax
+        # releases do exactly that once a shard_map sits in the grad
+        # path), which breaks the in-place aliasing at runtime.  Moments
+        # inherit their param's NamedSharding; eager-created scalars
+        # (optax step counters) land on one device, so they are pinned
+        # replicated and re-placed onto the mesh.
+        rep = NamedSharding(mesh, P())
+        self._opt_shardings = jax.tree_util.tree_map(
+            lambda x: x.sharding
+            if isinstance(x.sharding, NamedSharding) else rep,
+            self.opt_state)
+        self.opt_state = jax.device_put(self.opt_state, self._opt_shardings)
 
         self.step_count = 0
         self._step_fn = self._build_step()
@@ -145,12 +158,17 @@ class Trainer:
         # Pin the params' output shardings to the canonical placement —
         # otherwise GSPMD may legally return e.g. a dp-sharded norm vector,
         # which would then fail the next call's in_shardings check.
+        # Donation is the HBM lever on device backends only — the same
+        # rule as the serving engines' jits: on CPU it buys nothing, and
+        # a donated executable reloaded from the persistent compile
+        # cache aborts this jax release outright.
+        donate = (0, 1) if jax.default_backend() != "cpu" else ()
         return jax.jit(
             step,
-            in_shardings=(self._param_shardings, None,
+            in_shardings=(self._param_shardings, self._opt_shardings,
                           self._batch_sharding, self._batch_sharding),
-            out_shardings=(self._param_shardings, None, None),
-            donate_argnums=(0, 1),
+            out_shardings=(self._param_shardings, self._opt_shardings, None),
+            donate_argnums=donate,
         )
 
     def train_step(self, tokens: np.ndarray,

@@ -61,6 +61,10 @@ from typing import Any, Dict, List, Optional, Tuple
 # mix, same seed, warmed — the batched-speculation win; a drift below
 # 1.0 means drafting+fused-verify stopped paying for itself on the
 # trend config) joined in r17.
+# multichip.tp_ratio (ISSUE 16's tp=2/tp=1 decode tok/s on the DLLM_TP
+# carve — on the CPU box sharding is pure overhead so the value sits
+# below 1.0; the pin is a canary for the sharded ragged tick's host
+# cost creeping up, not a speedup claim) joined in r18.
 PINNED: Tuple[Tuple[str, bool], ...] = (
     ("trend_req_per_s", True),
     ("skew_tick_ratio", False),
@@ -70,6 +74,7 @@ PINNED: Tuple[Tuple[str, bool], ...] = (
     ("spill.warm_hit_rate", True),
     ("spill.tbt_ratio", False),
     ("spec.tok_ratio", True),
+    ("multichip.tp_ratio", True),
 )
 
 # Context rows printed (no flags): the headline and accuracy travel
@@ -107,6 +112,7 @@ _PATHS: Dict[str, Tuple[Tuple[str, ...], ...]] = {
                        ("spec_phase", "tok_ratio"),),
     "replica.speedup": (("replica", "speedup"),
                         ("replica", "closed_loop_speedup"),),
+    "multichip.tp_ratio": (("multichip", "tp_ratio"),),
     "replica.aff_ret": (("replica", "aff_ret"),
                         ("replica", "affinity_hit_retention"),),
     "profile.coverage": (("profile", "coverage"),),

@@ -71,21 +71,27 @@ def pytest_configure(config):
 
 # -- environment capability flags (ISSUE 12 env-failure hygiene) -------------
 #
-# This container's jax lacks `from jax import shard_map` and its orbax
-# predates `PyTreeRestore(partial_restore=...)`; `hypothesis` is absent.
-# Since PR 1 those surfaced as a FIXED set of red failures/collection
-# errors every session had to eyeball against the seed baseline.  They
-# are now explicit skips: every guard below carries an "env: " reason,
-# and tests/test_env_hygiene.py PINS the guard count per capability —
-# tier-1 is green-or-real, and a genuine regression cannot hide inside
-# a growing skip pile (adding a guard without updating the pin fails).
+# This container's orbax predates `PyTreeRestore(partial_restore=...)`
+# and `hypothesis` is absent.  Since PR 1 those surfaced as a FIXED set
+# of red failures/collection errors every session had to eyeball against
+# the seed baseline.  They are now explicit skips: every guard below
+# carries an "env: " reason, and tests/test_env_hygiene.py PINS the
+# guard count per capability — tier-1 is green-or-real, and a genuine
+# regression cannot hide inside a growing skip pile (adding a guard
+# without updating the pin fails).
+#
+# shard_map: PR 16's compat shim (distributed_llm_tpu/compat) accepts
+# either the modern `jax.shard_map` or the pre-graduation
+# `jax.experimental.shard_map` spelling, so the probe flips True in this
+# container and the seven formerly-skipped modules run.  The guards stay
+# for a jax with neither spelling.
 
 import pytest  # noqa: E402
 
 
 def _probe_shard_map() -> bool:
     try:
-        from jax import shard_map  # noqa: F401
+        from distributed_llm_tpu.compat import shard_map  # noqa: F401
         return True
     except ImportError:
         return False
@@ -115,8 +121,8 @@ HAS_HYPOTHESIS = _probe_hypothesis()
 
 ENV_SKIP_SHARD_MAP = pytest.mark.skipif(
     not HAS_SHARD_MAP,
-    reason="env: `from jax import shard_map` unavailable in this "
-           "container's jax")
+    reason="env: no shard_map spelling (jax.shard_map or "
+           "jax.experimental.shard_map) in this container's jax")
 ENV_SKIP_ORBAX_PARTIAL_RESTORE = pytest.mark.skipif(
     not HAS_ORBAX_PARTIAL_RESTORE,
     reason="env: this container's orbax predates "
@@ -126,10 +132,11 @@ ENV_SKIP_ORBAX_PARTIAL_RESTORE = pytest.mark.skipif(
 
 def env_require_shard_map() -> None:
     """Module-level guard for test modules whose IMPORTS need
-    jax.shard_map (they used to die as collection errors)."""
+    shard_map (they used to die as collection errors)."""
     if not HAS_SHARD_MAP:
-        pytest.skip("env: `from jax import shard_map` unavailable in "
-                    "this container's jax", allow_module_level=True)
+        pytest.skip("env: no shard_map spelling (jax.shard_map or "
+                    "jax.experimental.shard_map) in this container's "
+                    "jax", allow_module_level=True)
 
 
 def env_require_hypothesis() -> None:

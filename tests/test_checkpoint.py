@@ -3,7 +3,8 @@ the train → serve weight handoff."""
 
 import jax
 
-from conftest import env_require_shard_map
+from conftest import (ENV_SKIP_ORBAX_PARTIAL_RESTORE,
+                      env_require_shard_map)
 
 env_require_shard_map()   # this module's imports need jax.shard_map
 import numpy as np
@@ -63,6 +64,7 @@ def test_cross_mesh_restore(tmp_path):
     assert np.isfinite(t_small.train_step(tokens, mask)["loss"])
 
 
+@ENV_SKIP_ORBAX_PARTIAL_RESTORE   # restores a published checkpoint
 def test_train_then_serve_from_checkpoint(tmp_path):
     t = _trainer(jax.devices()[:2], seed=5)
     tokens, mask = next(batches(4, 32, seed=2))

@@ -536,6 +536,41 @@ def test_jit_purity_pallas_near_miss_host_timing_around_call_clean():
     assert _lint(JitPurityChecker(), {ENGINE: src}).findings == []
 
 
+def test_jit_purity_shard_map_wrapped_pallas_dispatcher_flagged():
+    """ISSUE 16: the TP path wraps the ragged Pallas dispatchers in
+    ``shard_map`` (parallel/tp_attention) — the shard_map BODY is a
+    traced root even though it is also ordinary host code that builds
+    a ``pallas_call``.  A blocking host callback inside that body runs
+    once per shard per trace and wedges the sharded program; the
+    checker must flag it through the composed idiom (shard_map body
+    containing a pallas_call dispatch)."""
+    src = """
+        import time
+        from functools import partial
+
+        from jax.experimental import pallas as pl
+        from distributed_llm_tpu.compat import shard_map
+
+
+        def _kernel(q_ref, o_ref, *, bs):
+            o_ref[0] = q_ref[0]
+
+
+        def _shard_body(q, pool):
+            time.sleep(0.01)             # host callback inside the shard
+            kernel = partial(_kernel, bs=16)
+            return pl.pallas_call(kernel, grid=(4,))(q, pool)
+
+
+        def tp_decode(mesh, specs):
+            return shard_map(_shard_body, mesh=mesh, in_specs=specs,
+                             out_specs=specs[0])
+    """
+    result = _lint(JitPurityChecker(), {ENGINE: src})
+    assert _rules(result) == ["jit-host-impurity"], result.findings
+    assert "time.sleep" in result.findings[0].message
+
+
 def test_jit_purity_wrapper_call_inside_lambda_body_still_roots():
     """A jit/pallas_call ISSUED inside a lambda body must keep rooting
     its function argument (lambdas are not scope entries, so the scoped
@@ -1325,6 +1360,37 @@ def test_retrace_shape_cache_key_flagged_and_slice_clean():
             return window, msg
     """
     assert _lint(RetraceChecker(), {ENGINE: good}).findings == []
+
+
+def test_retrace_tp_program_family_bounded_key_clean():
+    """ISSUE 16's per-shard program family — compiled fns cached under
+    the bounded ``(γ_bucket, pool span, tp)`` tuple and filled once per
+    key outside any loop — is the sanctioned keyed-cache shape: every
+    component is a bucketed/config int, not an array ``.shape``, so the
+    retrace checker must stay silent even with a hot-path caller (the
+    ``.shape``-keyed BAD twin is covered above)."""
+    from distributed_llm_tpu.lint.checkers.retrace import RetraceChecker
+    src = """
+        import jax
+
+        _FAMILY = {}
+
+        def _bucket(n, ladder=(4, 8)):
+            return min(g for g in ladder if g >= n)
+
+        def _verify_fn(gb, span, tp):
+            key = (gb, span, tp)       # bounded bucket tuple, not .shape
+            if key not in _FAMILY:
+                def step(q, pool):
+                    return q + pool
+                _FAMILY[key] = jax.jit(step)
+            return _FAMILY[key]
+
+        def handle(q, pool, gamma, span, tp):    # dllm-lint: hot-path
+            gb = _bucket(gamma)
+            return _verify_fn(gb, span, tp)(q, pool)
+    """
+    assert _lint(RetraceChecker(), {ENGINE: src}).findings == []
 
 
 def test_retrace_shape_scalar_index_is_not_a_cache_key():

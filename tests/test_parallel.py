@@ -339,8 +339,13 @@ def test_sp_decode_budget_scales_context_capacity():
     from distributed_llm_tpu.config import flagship_cluster
     from distributed_llm_tpu.utils.hbm_budget import tier_hbm_budget
 
+    # decode_batch=1: sp decode shards the SEQUENTIAL engine's dense
+    # cache (parallel/sp_attention.py); the batched paged pool shards
+    # its kv-head axis over tp instead (the flagship orin preset is
+    # batched these days, so pin the engine the story is about).
     base = dataclasses.replace(flagship_cluster(n_devices=8).orin, tp=1,
-                               quantize="none", enable_prefix_cache=False)
+                               quantize="none", enable_prefix_cache=False,
+                               decode_batch=1)
     b1 = tier_hbm_budget(dataclasses.replace(base, sp=1))
     b4 = tier_hbm_budget(dataclasses.replace(base, sp=4))
     # (reported values round to 3 decimals)

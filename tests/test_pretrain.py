@@ -3,7 +3,8 @@ the published-artifact layout serving reads (VERDICT r1 #4 machinery)."""
 
 import jax
 
-from conftest import env_require_shard_map
+from conftest import (ENV_SKIP_ORBAX_PARTIAL_RESTORE,
+                      env_require_shard_map)
 
 env_require_shard_map()   # this module's imports need jax.shard_map
 import pytest
@@ -11,6 +12,7 @@ import pytest
 from distributed_llm_tpu.training import pretrain as pt
 
 
+@ENV_SKIP_ORBAX_PARTIAL_RESTORE   # restores a published checkpoint
 def test_pretrain_plateaus_and_publishes(tmp_path):
     out = tmp_path / "ck"
     res = pt.pretrain("nano_test", str(out), batch_size=4, seq_len=32,
@@ -108,6 +110,7 @@ def test_resume_extends_lr_schedule_past_horizon(tmp_path):
     assert any("extended LR schedule to 1206" in line for line in logs)
 
 
+@ENV_SKIP_ORBAX_PARTIAL_RESTORE   # restores a published checkpoint
 def test_heldout_eval_deterministic_and_seed_disjoint(tmp_path):
     """Same (cfg, params, seed) -> identical numbers; the held-out stream
     differs from the training stream (seed separation is the train/test
@@ -135,6 +138,7 @@ def test_heldout_eval_deterministic_and_seed_disjoint(tmp_path):
     assert 0.0 <= a["next_token_acc"] <= 1.0
 
 
+@ENV_SKIP_ORBAX_PARTIAL_RESTORE   # restores a published checkpoint
 def test_tier_quality_asymmetry_on_committed_checkpoints():
     """The routing premise, measured (VERDICT r3 missing #2): the bigger
     orin_test checkpoint beats nano_test on held-out per-token loss over

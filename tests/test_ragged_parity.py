@@ -301,15 +301,25 @@ def test_ragged_request_gated_by_measured_verdict_on_tpu(monkeypatch):
         eng.stop()
 
 
-def test_tp_mesh_engine_stays_dense():
-    """A sharded tier never takes the ragged path (pallas_call has no
-    GSPMD rule; the TP hook is rung-specialized) even when the tier and
-    env ask for it."""
-    devs = np.array(jax.devices()[:2])
-    mesh = jax.sharding.Mesh(devs, ("tp",))
-    eng = ContinuousBatchingEngine(_tier(attention_ragged=True), seed=0,
-                                   mesh=mesh)
+def test_tp_mesh_engine_ragged_iff_qualifying():
+    """PR 16 flipped the mesh rule: a QUALIFYING TP mesh (dense model,
+    sp=ep=1, tp dividing both head counts —
+    parallel/tp_attention._tp_ragged_ok) runs the fused ragged tick
+    under shard_map; a non-qualifying one (here an MoE model, which
+    param-shards fine over 'tp' but whose expert dispatch the ragged
+    wrap doesn't cover) still keeps the dense windowed path even when
+    the tier asks for ragged."""
+    eng = ContinuousBatchingEngine(
+        _tier(attention_ragged=True), seed=0,
+        mesh=jax.sharding.Mesh(np.array(jax.devices()[:2]), ("tp",)))
     try:
-        assert eng.ragged is False
+        assert eng.ragged is True
+    finally:
+        eng.stop()
+    eng = ContinuousBatchingEngine(
+        _tier(attention_ragged=True, model_preset="moe_test"), seed=0,
+        mesh=jax.sharding.Mesh(np.array(jax.devices()[:2]), ("tp",)))
+    try:
+        assert eng.ragged is False    # MoE: _tp_ragged_ok rejects experts
     finally:
         eng.stop()
