@@ -230,6 +230,46 @@ MODEL_PRESETS: Dict[str, ModelConfig] = {
 # =============================================================================
 
 @dataclasses.dataclass(frozen=True)
+class TenantQuota:
+    """Per-tenant isolation budgets (serving/tenants.py, ISSUE 17).
+
+    All limits are per TIER (each TierClient owns one TenantQuotas
+    registry).  ``None`` on any field disables that criterion for the
+    tenant; a tenant absent from ``TierConfig.tenant_quotas`` gets the
+    registry's default quota (the ``DLLM_TENANT_*`` env defaults, or
+    unlimited when those are unset too).
+    """
+
+    # DWRR scheduling weight (engine/batching.py): a tenant with weight
+    # 2 drains its admission queue twice as fast as a weight-1 tenant
+    # under contention.  Must be > 0.
+    weight: float = 1.0
+    # Requests a tenant may have in flight (admitted, occupying engine
+    # capacity) at once; the next one queues against max_queued.
+    max_inflight: Optional[int] = None
+    # Requests a tenant may have WAITING beyond max_inflight before
+    # admission rejects with the reference error shape + retry_after_s.
+    max_queued: Optional[int] = None
+    # Device-time rate budget in measured milliseconds per wall second,
+    # enforced by a token bucket debited from each finished request's
+    # PR 11 ``device_time_ms`` bill: a tenant that burned more device
+    # time than its rate allows is rejected until the bucket refills.
+    device_ms_per_s: Optional[float] = None
+    # Burst ceiling of that token bucket in device-milliseconds; None
+    # defaults to 2 s worth of the rate.
+    device_ms_burst: Optional[float] = None
+    # Resident KV budget in physical refcounted blocks, billed at
+    # 1/refcount per block (PR 10 dedup lowers the bill): over it, the
+    # tenant's parked prefixes evict first and its COLD admissions are
+    # gated by the PR 5 KV-aware gate until the bill drops.
+    kv_blocks: Optional[int] = None
+    # Per-tenant speculative γ cap: PR 14's per-slot EWMA γ clamps to
+    # this, so one tenant's speculation cannot monopolize draft/verify
+    # rounds.  None = the tier's spec_gamma_max.
+    spec_gamma_max: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class TierConfig:
     """One serving tier = one model resident on one device submesh.
 
@@ -563,6 +603,17 @@ class TierConfig:
     # least-loaded — a hot replica must not starve the others to keep
     # its cache locality.
     replica_affinity_override_s: float = 1.0
+    # Per-tenant isolation (serving/tenants.py, ISSUE 17): tenant name →
+    # TenantQuota for this tier.  Tenants absent from the map get the
+    # registry's default quota, whose fields come from the
+    # ``DLLM_TENANT_*`` env defaults (unset = unlimited).  The quota
+    # layer enforces admission budgets (max in-flight / max queued / a
+    # device-time-rate token bucket debited from the measured PR 11
+    # bill), DWRR scheduling weights, resident-KV block budgets billed
+    # at 1/refcount, and per-tenant speculative γ caps.  None = quotas
+    # OFF: every code path is byte-identical to pre-tenant behavior
+    # (pinned by test), and tenant_id only flows into observability.
+    tenant_quotas: Optional[Dict[str, "TenantQuota"]] = None
 
     def model(self) -> ModelConfig:
         return MODEL_PRESETS[self.model_preset]

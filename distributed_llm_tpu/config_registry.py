@@ -151,6 +151,27 @@ ENV_VARS: Dict[str, EnvVar] = {v.name: v for v in (
        "budget of the hierarchical KV spill tier in bytes ('0' disables "
        "it everywhere); unset = each tier's config decides.  The bench "
        "spill leg A/Bs through this."),
+    _e("DLLM_TENANT_MAX_INFLIGHT", None, "serving/tenants.py",
+       "Default per-tenant in-flight request cap for tenants absent "
+       "from TierConfig.tenant_quotas (int); unset = unlimited.  Only "
+       "read when a tier has tenant quotas ON (tenant_quotas set)."),
+    _e("DLLM_TENANT_MAX_QUEUED", None, "serving/tenants.py",
+       "Default per-tenant cap on requests waiting beyond the in-flight "
+       "cap before admission rejects (int); unset = unlimited.  Quota-ON "
+       "tiers only."),
+    _e("DLLM_TENANT_DEVICE_MS_PER_S", None, "serving/tenants.py",
+       "Default per-tenant device-time rate budget in measured "
+       "device-milliseconds per wall second (float) — the token-bucket "
+       "refill rate debited from each request's PR 11 device_time_ms "
+       "bill; unset = unlimited.  Quota-ON tiers only."),
+    _e("DLLM_TENANT_KV_BLOCKS", None, "serving/tenants.py",
+       "Default per-tenant resident-KV budget in physical refcounted "
+       "blocks, billed at 1/refcount per block (int); unset = "
+       "unlimited.  Quota-ON tiers only."),
+    _e("DLLM_TENANT_GAMMA_MAX", None, "serving/tenants.py",
+       "Default per-tenant speculative γ cap (int) — PR 14's per-slot "
+       "EWMA γ clamps to it; unset = the tier's spec_gamma_max.  "
+       "Quota-ON tiers only."),
     _e("DLLM_REPLICA_POLICY", None, "serving/replicas.py",
        "Global replica-dispatch policy override for replicated tiers "
        "('affinity' | 'load' | 'random'); unset = "
@@ -314,6 +335,14 @@ CONFIG_FIELDS: Dict[str, str] = {
                                               "exceeds the best "
                                               "replica's by more than "
                                               "this many seconds.",
+    "TierConfig.tenant_quotas": "Per-tenant isolation budgets (tenant "
+                                "name → TenantQuota): admission caps, a "
+                                "device-time-rate token bucket billed "
+                                "from measured cost, DWRR weights, "
+                                "resident-KV block budgets at "
+                                "1/refcount, and speculative γ caps; "
+                                "None = quotas OFF (byte-identical "
+                                "pre-tenant behavior).",
     # -- ClusterConfig -----------------------------------------------------
     "ClusterConfig.nano": "The weak/cheap tier's TierConfig.",
     "ClusterConfig.orin": "The strong/costly tier's TierConfig.",

@@ -135,6 +135,10 @@ class _Request:
     # prefill lane was busy: the scheduler skips re-popping (and
     # re-tokenizing) the head request every tick until the lane frees.
     needs_chunk: bool = False
+    # Billing identity (ISSUE 17): which tenant's quota this request
+    # draws down.  None (direct engine use, quotas off) bills to the
+    # shared default tenant where tenant state exists at all.
+    tenant: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -540,6 +544,21 @@ class ContinuousBatchingEngine:
         # (GIL-safe deque ops; stop() drains it after joining the loop).
         self._head: "deque[_Request]" = deque()
         self._admit_seq = 0
+        # Per-tenant scheduling state (ISSUE 17).  None = quotas OFF:
+        # _next_request/_ensure_growth/_release/_slot_go_live all take
+        # their exact pre-tenant paths (byte-identity contract, pinned
+        # by tests).  When ON, _queue drains into per-tenant FIFO lanes
+        # and admission order is deficit-weighted round-robin over them
+        # (weights from the quota table); the head lane stays absolute-
+        # first either way.  Scheduler-thread-only state.
+        self._tenant_quotas = (dict(tier.tenant_quotas)
+                               if tier.tenant_quotas is not None else None)
+        self._tenant_default_q = None
+        if self._tenant_quotas is not None:
+            from ..serving.tenants import default_quota
+            self._tenant_default_q = default_quota()
+        self._tenant_lanes: Dict[str, "deque[_Request]"] = {}
+        self._tenant_deficits: Dict[str, float] = {}
         # Mid-decode preemptions performed over this engine's life (the
         # chaos leg and tests read it; the obs counter mirrors it).
         self.preempted_total = 0
@@ -682,14 +701,27 @@ class ContinuousBatchingEngine:
         never mints a new one)."""
         return next(b for b in self._gamma_buckets if b >= g)
 
-    def _adapt_gamma(self, ewma: float) -> int:
+    def _adapt_gamma(self, ewma: float, cap: Optional[int] = None) -> int:
         """Acceptance EWMA → the slot's next γ: proportional scaling
         with a floor at 0 (degrade to plain ragged decode — the verify's
-        first row only) once acceptance stops paying for draft FLOPs."""
-        if ewma < SPEC_EWMA_FLOOR:
+        first row only) once acceptance stops paying for draft FLOPs.
+        ``cap`` is the tenant γ clamp (quotas ON; None = unclamped)."""
+        gmax = (self.spec_gamma_max if cap is None
+                else min(cap, self.spec_gamma_max))
+        if gmax <= 0 or ewma < SPEC_EWMA_FLOOR:
             return 0
-        return max(1, min(self.spec_gamma_max,
-                          int(ewma * self.spec_gamma_max + 0.5)))
+        return max(1, min(gmax, int(ewma * gmax + 0.5)))
+
+    def _tenant_gamma_cap(self, req: Optional[_Request]) -> Optional[int]:
+        """The tenant's speculative-γ clamp, or None (no clamp — quotas
+        off, or the tenant's quota leaves spec_gamma_max unset)."""
+        if self._tenant_quotas is None or req is None:
+            return None
+        q = self._tenant_quota(req.tenant)
+        cap = q.spec_gamma_max if q is not None else None
+        if cap is None:
+            return None
+        return max(0, min(int(cap), self.spec_gamma_max))
 
     # -- compiled stages ---------------------------------------------------
 
@@ -1173,12 +1205,69 @@ class ContinuousBatchingEngine:
 
     def _alloc_evicting(self, n_blocks: int) -> Optional[List[int]]:
         """Allocate, evicting parked prefix entries (LRU) under pressure:
-        live admissions always outrank parked caches."""
+        live admissions always outrank parked caches.  Quotas ON adds a
+        first pass over parked entries whose OWNING TENANT is over its
+        KV block budget — an over-quota tenant's cold cache is sacrificed
+        before any in-budget tenant's (ISSUE 17)."""
         blocks = self.allocator.alloc(n_blocks)
+        if (self._tenant_quotas is not None and blocks is None
+                and self.prefix_cache is not None):
+            # The over-quota set is computed ONCE before the sweep (the
+            # pop_oldest predicate runs under the cache lock, so it
+            # cannot re-walk the cache itself); the slight over-eviction
+            # of a tenant whose bill drops below budget mid-sweep is
+            # the intended bias against the noisy tenant.
+            over = self._overquota_parked_tenants()
+            while (blocks is None and over
+                   and self.prefix_cache.pop_oldest(
+                       match=lambda e: isinstance(e.cache, dict)
+                       and e.cache.get("tenant") in over) is not None):
+                blocks = self.allocator.alloc(n_blocks)
         while (blocks is None and self.prefix_cache is not None
                and self.prefix_cache.pop_oldest() is not None):
             blocks = self.allocator.alloc(n_blocks)
         return blocks
+
+    def _overquota_parked_tenants(self) -> set:
+        """Tenants that (a) own tagged parked prefix entries and (b) are
+        over their KV block budget — the eviction sweep's first-pass
+        victims (quotas ON)."""
+        tenants = set()
+        for e in self.prefix_cache.entries_snapshot():
+            if isinstance(e.cache, dict):
+                t = e.cache.get("tenant")
+                if t:
+                    tenants.add(t)
+        over = set()
+        for t in tenants:
+            q = self._tenant_quota(t)
+            if (q is not None and q.kv_blocks
+                    and self.tenant_kv_blocks(t) > float(q.kv_blocks)):
+                over.add(t)
+        return over
+
+    def tenant_kv_blocks(self, tenant: Optional[str]) -> float:
+        """The tenant's resident-KV bill in pool blocks, each block
+        billed at 1/refcount (the PR 11 attribution currency: a block
+        shared k ways costs each sharer 1/k, so prefix dedup LOWERS the
+        bill).  Covers live slots owned by the tenant plus its tagged
+        parked prefix entries; untagged entries (parked while quotas
+        were off) bill nobody.  Advisory cross-thread read — the
+        serving gate and the scheduler's victim policy both call it."""
+        t = tenant or "default"
+        owned: List[int] = []
+        for slot in self._slots:
+            if slot is not None and (slot.request.tenant or "default") == t:
+                owned.extend(slot.blocks)
+        if self.prefix_cache is not None:
+            for e in self.prefix_cache.entries_snapshot():
+                cache = e.cache
+                if (isinstance(cache, dict) and cache.get("tenant") == t):
+                    owned.extend(cache.get("blocks") or [])
+        if not owned:
+            return 0.0
+        return sum(1.0 / r if r > 0 else 1.0
+                   for r in self.allocator.refcounts(owned))
 
     def _slot_go_live(self, req: _Request, slot_ix: int,
                       blocks: List[int], *, prompt_len: int,
@@ -1207,12 +1296,17 @@ class ContinuousBatchingEngine:
         # (spec_ok) and the slot must be greedy — a sampled slot rides
         # the verify's sampled first row at γ=0.
         spec = bool(self.spec and spec_ok and temp <= 0)
+        # Tenant γ clamp (quotas ON): a capped tenant starts at its cap
+        # — cap 0 disables drafting for the slot's life (γ is sticky at
+        # 0, exactly the degraded-slot path).  None = no clamp.
+        cap = self._tenant_gamma_cap(req)
+        gamma0 = self.spec_gamma_max if cap is None else cap
         slot = _Slot(request=req, blocks=blocks, prompt_len=prompt_len,
                      budget=budget, temperature=temp, ttft_ms=ttft_ms,
                      tokens=tokens, prompt_ids=prompt_ids,
                      max_blocks=max_blocks, pinned_entry=pinned_entry,
                      spec=spec,
-                     gamma=self.spec_gamma_max if spec else 0)
+                     gamma=gamma0 if spec else 0)
         if gen is None:
             obs_spans.add_token(req.trace)   # the prefill's primed token
             if req.token_queue is not None:
@@ -2078,21 +2172,101 @@ class ContinuousBatchingEngine:
                                     generated=len(slot.tokens))
                     self._finish(ix)
                     break
-                victim = max(victims,
-                             key=lambda j: self._slots[j].request.admit_seq)
+                if self._tenant_quotas is None:
+                    victim = max(victims, key=lambda j:
+                                 self._slots[j].request.admit_seq)
+                else:
+                    # Quotas ON: preempt the MOST-OVER-QUOTA tenant's
+                    # slot first (resident-KV bill / block budget;
+                    # budget-less tenants rank 0.0), breaking ties
+                    # youngest-first — the noisy tenant pays for the
+                    # pressure it created before any quiet tenant does.
+                    bills: Dict[Optional[str], float] = {}
+                    def _over(j: int) -> float:
+                        t = self._slots[j].request.tenant
+                        if t not in bills:
+                            q = self._tenant_quota(t)
+                            if q is None or not q.kv_blocks:
+                                bills[t] = 0.0
+                            else:
+                                bills[t] = (self.tenant_kv_blocks(t)
+                                            / float(q.kv_blocks))
+                        return bills[t]
+                    victim = max(victims, key=lambda j: (
+                        _over(j), self._slots[j].request.admit_seq))
                 self._preempt(victim)
                 if victim == ix:
                     break                    # the grower itself yielded
 
     def _next_request(self) -> Optional[_Request]:
         """Head lane (KV-pressure deferrals, preempted replays) first,
-        then the submission queue."""
+        then the submission queue — FIFO when quotas are off, deficit-
+        weighted round-robin over per-tenant lanes when on."""
         if self._head:
             return self._head.popleft()
-        try:
-            return self._queue.get_nowait()
-        except queue.Empty:
+        if self._tenant_quotas is None:
+            try:
+                return self._queue.get_nowait()
+            except queue.Empty:
+                return None
+        return self._next_request_dwrr()
+
+    def _next_request_dwrr(self) -> Optional[_Request]:
+        """Deficit-weighted round-robin (quotas ON only): arrivals drain
+        into per-tenant FIFO lanes; each pass tops every occupied lane's
+        deficit up by the tenant's quota weight and serves lanes whose
+        deficit covers one request (cost 1).  Tenants iterate in sorted
+        order so admission order is deterministic for a given arrival
+        interleaving; a lane that empties forfeits its deficit (no
+        banking idle weight into a later burst)."""
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            t = req.tenant or "default"
+            self._tenant_lanes.setdefault(t, deque()).append(req)
+            self._tenant_deficits.setdefault(t, 0.0)
+        occupied = sorted(t for t, lane in self._tenant_lanes.items()
+                          if lane)
+        if not occupied:
             return None
+        # Each top-up adds >= the weight floor to every occupied lane,
+        # so some deficit reaches 1.0 within a bounded pass count; the
+        # final fallback pop keeps this loop total even if weights are
+        # degenerate.
+        for _ in range(64):
+            for t in occupied:
+                if self._tenant_deficits[t] >= 1.0:
+                    self._tenant_deficits[t] -= 1.0
+                    lane = self._tenant_lanes[t]
+                    req = lane.popleft()
+                    if not lane:
+                        self._tenant_deficits[t] = 0.0
+                    return req
+            for t in occupied:
+                self._tenant_deficits[t] += self._tenant_weight(t)
+        t = occupied[0]
+        lane = self._tenant_lanes[t]
+        req = lane.popleft()
+        if not lane:
+            self._tenant_deficits[t] = 0.0
+        return req
+
+    def _tenant_quota(self, tenant: Optional[str]):
+        """The quota row billing decisions read for ``tenant``: the
+        tier's explicit map, else the env-assembled default (None only
+        when quotas are off entirely)."""
+        if self._tenant_quotas is None:
+            return None
+        return self._tenant_quotas.get(tenant or "default",
+                                       self._tenant_default_q)
+
+    def _tenant_weight(self, tenant: Optional[str]) -> float:
+        q = self._tenant_quota(tenant)
+        if q is None:
+            return 1.0
+        return max(1e-6, float(q.weight))
 
     def _finish(self, slot_ix: int) -> None:
         slot = self._slots[slot_ix]
@@ -2127,8 +2301,14 @@ class ContinuousBatchingEngine:
             # store); generation-only trailing blocks go back to the pool.
             keep = -(-slot.prompt_len // self.paged.block_size)
             if 0 < keep <= len(slot.blocks):
-                parked = self.prefix_cache.put(
-                    slot.prompt_ids, {"blocks": slot.blocks[:keep]})
+                cache: Dict[str, Any] = {"blocks": slot.blocks[:keep]}
+                if self._tenant_quotas is not None:
+                    # Tag the parked entry with its owning tenant so
+                    # tenant_kv_blocks bills it and _parked_overquota
+                    # can sacrifice it first (quotas-off dict shape
+                    # unchanged — byte-identity contract).
+                    cache["tenant"] = slot.request.tenant or "default"
+                parked = self.prefix_cache.put(slot.prompt_ids, cache)
                 if parked:
                     self.allocator.free(slot.blocks[keep:])
         if not parked:
@@ -2174,7 +2354,9 @@ class ContinuousBatchingEngine:
                     slot.accept_ewma = ((1.0 - SPEC_EWMA_ALPHA)
                                         * slot.accept_ewma
                                         + SPEC_EWMA_ALPHA * rate)
-                    slot.gamma = self._adapt_gamma(slot.accept_ewma)
+                    slot.gamma = self._adapt_gamma(
+                        slot.accept_ewma,
+                        cap=self._tenant_gamma_cap(slot.request))
                     slot.spec_drafted += g_i
                     slot.spec_accepted += k
                     tick_drafted += g_i
@@ -2621,19 +2803,31 @@ class ContinuousBatchingEngine:
     def submit(self, history: History,
                max_new_tokens: Optional[int] = None,
                temperature: Optional[float] = None,
-               token_queue: Optional["queue.Queue"] = None) -> _Request:
+               token_queue: Optional["queue.Queue"] = None,
+               tenant: Optional[str] = None) -> _Request:
         self.start()
+        trace = obs_spans.current_trace()
+        if tenant is None and trace is not None:
+            # Serving path: the router stamps the tenant on the trace
+            # (route_query annotate), so TierClient's generate() calls
+            # need no signature change to bill correctly.
+            try:
+                tenant = trace.attrs.get("tenant")
+            except Exception:
+                tenant = None
         req = _Request(history=history, max_new_tokens=max_new_tokens,
                        temperature=temperature, token_queue=token_queue,
-                       trace=obs_spans.current_trace())
+                       trace=trace, tenant=tenant)
         self._queue.put(req)
         self._wake.set()
         return req
 
     def generate(self, history: History,
                  max_new_tokens: Optional[int] = None,
-                 temperature: Optional[float] = None) -> GenerationResult:
-        req = self.submit(history, max_new_tokens, temperature)
+                 temperature: Optional[float] = None,
+                 tenant: Optional[str] = None) -> GenerationResult:
+        req = self.submit(history, max_new_tokens, temperature,
+                          tenant=tenant)
         req.done.wait()
         if req.error is not None:
             raise req.error
@@ -2641,7 +2835,8 @@ class ContinuousBatchingEngine:
 
     def generate_stream(self, history: History,
                         max_new_tokens: Optional[int] = None,
-                        temperature: Optional[float] = None):
+                        temperature: Optional[float] = None,
+                        tenant: Optional[str] = None):
         """Yield text deltas as tokens come off the shared decode loop
         (SURVEY.md §7 hard part 6 — the reference API is non-streaming,
         but TTFT-aware serving wants streaming internals).  The final
@@ -2650,7 +2845,7 @@ class ContinuousBatchingEngine:
         until complete."""
         from .tokenizer import StreamDecoder
         req = self.submit(history, max_new_tokens, temperature,
-                          token_queue=queue.Queue())
+                          token_queue=queue.Queue(), tenant=tenant)
 
         def deltas():
             decoder = StreamDecoder(self.tokenizer)
@@ -2677,7 +2872,12 @@ class ContinuousBatchingEngine:
         the head lane, and the in-flight chunked prefill — admitted to
         the LANE but not yet decoding, it must stay visible to routing,
         drain, and the wait predictor)."""
-        return (self._queue.qsize() + len(self._head)
+        # Quotas ON parks arrivals in per-tenant DWRR lanes between
+        # _queue and admission; they are still waiting work (lanes are
+        # always empty when quotas are off).  list() snapshots the dict
+        # against concurrent lane creation (advisory read).
+        laned = sum(len(l) for l in list(self._tenant_lanes.values()))
+        return (self._queue.qsize() + len(self._head) + laned
                 + (1 if self._prefill is not None else 0))
 
     def pending_work(self) -> int:

@@ -342,21 +342,33 @@ class PrefixCache:
                 self.on_evict(e)
         return True
 
-    def pop_oldest(self) -> Optional[PrefixEntry]:
+    def pop_oldest(self, match=None) -> Optional[PrefixEntry]:
         """Evict (and return, after on_evict) the LRU UNPINNED entry —
         used by the paged engine to reclaim pool blocks under admission
         pressure.  Entries with live sharers are skipped: their blocks
         could not reach the free list anyway (the sharers hold
-        references), so evicting them would only burn a warm prefix."""
+        references), so evicting them would only burn a warm prefix.
+        ``match`` restricts candidates (entry -> bool): the engine's
+        per-tenant KV budgets evict over-quota tenants' parked entries
+        first (ISSUE 17); None keeps the plain LRU sweep.  The predicate
+        runs under the lock — it must not call back into this cache."""
         with self._lock:
             ix = next((i for i, e in enumerate(self._entries)
-                       if e.pins == 0), None)
+                       if e.pins == 0
+                       and (match is None or match(e))), None)
             if ix is None:
                 return None
             entry = self._entries.pop(ix)
         if self.on_evict is not None:
             self.on_evict(entry)
         return entry
+
+    def entries_snapshot(self) -> List[PrefixEntry]:
+        """Point-in-time copy of the entry list (advisory reads: the
+        engine's per-tenant resident-KV billing walks parked entries
+        without holding this lock across refcount lookups)."""
+        with self._lock:
+            return list(self._entries)
 
     def reclaimable_blocks(self) -> int:
         """Pool blocks an eviction sweep could ACTUALLY return to the

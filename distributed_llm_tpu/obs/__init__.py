@@ -27,7 +27,7 @@ import threading
 from typing import Optional
 
 from . import metrics, recorder, spans                       # noqa: F401
-from .metrics import MetricsRegistry, ServingMetrics
+from .metrics import BoundedLabels, MetricsRegistry, ServingMetrics
 from .recorder import FlightRecorder
 from .spans import RequestTrace, current_trace, use_trace    # noqa: F401
 
@@ -42,6 +42,11 @@ class Observability:
         from .recorder import DEFAULT_CAPACITY
         self.metrics = registry if registry is not None else MetricsRegistry()
         self.m = ServingMetrics(self.metrics)
+        # ONE tenant-label bound per registry: every dllm_tenant_*
+        # write site (router billing, SLO windows, quota registries)
+        # funnels tenant ids through this so the label space they share
+        # stays consistent AND cardinality-bounded (ISSUE 17).
+        self.tenant_labels = BoundedLabels()
         self.recorder = (flight if flight is not None
                          else FlightRecorder(
                              capacity=(flight_capacity

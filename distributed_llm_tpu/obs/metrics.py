@@ -554,6 +554,60 @@ class ServingMetrics:
             "Replicas of the tier currently serving (running, not "
             "wedged, breaker not open) out of TierConfig.replicas "
             "(sampled)", ("tier",))
+        # Per-tenant isolation family (ISSUE 17, serving/tenants.py):
+        # the measured bill and enforcement decisions per tenant.  Every
+        # ``tenant`` label value MUST pass through a BoundedLabels set
+        # (64-char truncation, 256 distinct then '~overflow') — metric
+        # children are permanent, so an unbounded tenant flood would
+        # otherwise grow /metrics without bound.
+        self.tenant_device_time = registry.counter(
+            "dllm_tenant_device_time_ms_total",
+            "Attributed decode device time billed to the tenant "
+            "(PR 11 per-request attribution, '-' = tenantless direct "
+            "engine use)", ("tier", "tenant"))
+        self.tenant_kv_block_ticks = registry.counter(
+            "dllm_tenant_kv_block_ticks_total",
+            "Attributed KV residency billed to the tenant (blocks held "
+            "x decode ticks at 1/refcount)", ("tier", "tenant"))
+        self.tenant_rejected = registry.counter(
+            "dllm_tenant_rejected_total",
+            "Requests shed by per-tenant quota enforcement (in-flight/"
+            "queue caps, device-time token bucket, or KV budget)",
+            ("tier", "tenant"))
+        self.tenant_inflight_g = registry.gauge(
+            "dllm_tenant_inflight",
+            "Requests a tenant currently has admitted against its "
+            "quota (in flight or waiting)", ("tier", "tenant"))
+        self.tenant_goodput_g = registry.gauge(
+            "dllm_tenant_goodput",
+            "Sliding-window fraction of the tenant's requests meeting "
+            "their SLO (obs/slo.py per-tenant windows)", ("tenant",))
+
+
+class BoundedLabels:
+    """Cardinality bound for caller-supplied metric label values — the
+    PR 11 session-label policy, reusable: '-' when absent, values
+    truncated to 64 chars, and past ``cap`` DISTINCT values every new
+    one collapses to '~overflow'.  Metric children are permanent, so
+    without this a client minting fresh tenant/session ids would grow
+    /metrics (and every labeled family) without bound."""
+
+    def __init__(self, cap: int = 256):
+        self._cap = cap
+        self._seen: set = set()
+        self._lock = threading.Lock()
+
+    def label(self, raw: Any) -> str:
+        if not raw:
+            return "-"
+        s = str(raw)[:64]
+        with self._lock:
+            if s in self._seen:
+                return s
+            if len(self._seen) < self._cap:
+                self._seen.add(s)
+                return s
+        return "~overflow"
 
 
 _BREAKER_STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}

@@ -87,6 +87,14 @@ class SLOMonitor:
         self._lock = threading.Lock()
         self._windows: Dict[Tuple[str, str], deque] = {}
         self._tier_windows: Dict[str, deque] = {}
+        # Per-tenant goodput windows (ISSUE 17).  The feed passes
+        # ALREADY-BOUNDED tenant labels (Observability.tenant_labels:
+        # 64-char truncation, 256 distinct then '~overflow'), so this
+        # dict — and the dllm_tenant_goodput gauge children it mirrors
+        # to — inherits the same cardinality bound; the belt-and-braces
+        # local cap below covers recorder-less monitors fed raw ids.
+        self._tenant_windows: Dict[str, deque] = {}
+        self._tenant_window_cap = 256
         self.observed_total = 0
         self.good_total = 0
         self.violations: Dict[str, int] = {"error": 0, "ttft": 0, "tbt": 0}
@@ -108,10 +116,14 @@ class SLOMonitor:
     def record_request(self, strategy: str, tier: Optional[str], ok: bool,
                        ttft_ms: Optional[float] = None,
                        tbt_p95_ms: Optional[float] = None,
-                       cache_hit: bool = False) -> bool:
+                       cache_hit: bool = False,
+                       tenant: Optional[str] = None) -> bool:
         """Score one finished request against its tier's SLO; returns
         whether it met it.  ``ok`` must already fold in degraded service
-        (a degraded reply is not goodput)."""
+        (a degraded reply is not goodput).  ``tenant`` (ISSUE 17,
+        already label-bounded by the caller) additionally feeds that
+        tenant's goodput window and gauge — the per-tenant view the
+        noisy-neighbor bench reads: whose SLO actually degraded."""
         tier = tier or "none"
         ttft_target, tbt_target = self.targets_for(tier)
         kind: Optional[str] = None
@@ -145,11 +157,24 @@ class SLOMonitor:
             twin.append(good)
             tier_goodput = sum(twin) / len(twin)
             tier_samples = len(twin)
+            tenant_goodput = None
+            if tenant is not None:
+                tw = self._tenant_windows.get(tenant)
+                if tw is None and (len(self._tenant_windows)
+                                   < self._tenant_window_cap):
+                    tw = self._tenant_windows[tenant] = deque(
+                        maxlen=self.window)
+                if tw is not None:
+                    tw.append(good)
+                    tenant_goodput = sum(tw) / len(tw)
         if m is not None:
             try:
                 if not good:
                     m.slo_violations.labels(kind).inc()
                 m.slo_goodput.labels(key[0], tier).set(round(goodput, 4))
+                if tenant_goodput is not None:
+                    m.tenant_goodput_g.labels(tenant).set(
+                        round(tenant_goodput, 4))
             except Exception:
                 pass
         self._incident_edge(tier, tier_goodput, tier_samples)
@@ -284,6 +309,9 @@ class SLOMonitor:
                           if k != "timeline"}
                       for t, e in self._active.items()
                       if e is not _OPENING}
+            tenants = {t: round(sum(w) / len(w), 4)
+                       for t, w in sorted(self._tenant_windows.items())
+                       if w}
             return {
                 "targets": {t: {"slo_ttft_ms": tt, "slo_tbt_ms": tb}
                             for t, (tt, tb) in sorted(self.targets.items())},
@@ -293,6 +321,7 @@ class SLOMonitor:
                                            / self.observed_total, 4)
                                      if self.observed_total else None),
                 "goodput": goodput,
+                "tenants": tenants,
                 "violations": dict(self.violations),
                 "incidents_total": self.incidents_total,
                 "active_incidents": active,

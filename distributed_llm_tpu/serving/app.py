@@ -68,14 +68,14 @@ def create_app(router: Optional[Router] = None,
 
     @app.route("/chat", methods=["POST"])
     def chat():
-        err, turn, requested, session_id, history, snapshot = \
+        err, turn, requested, session_id, tenant_id, history, snapshot = \
             _begin_chat_turn()
         if err is not None:
             return err
 
         try:
             response_data, tokens, device = state["router"].route_query(
-                snapshot, session_id=session_id)
+                snapshot, session_id=session_id, tenant_id=tenant_id)
 
             if isinstance(response_data, dict):
                 reply = response_data.get("response", "")
@@ -118,19 +118,22 @@ def create_app(router: Optional[Router] = None,
         """One 400 shape for every input-hardening rejection (reference
         error dict, like the original missing-message branch)."""
         return ((jsonify({"error": msg}), 400),
-                None, None, None, None, None)
+                None, None, None, None, None, None)
 
     def _begin_chat_turn():
         """Shared /chat + /chat/stream front half: parse AND VALIDATE the
         request, hot-swap the strategy, append the user turn.  Returns
         (error_response | None, user_input, requested, session_id,
-        history, snapshot).
+        tenant_id, history, snapshot).
 
         Input hardening: bad JSON / non-object bodies, non-string or
-        oversized messages, and non-string strategy/session_id are all
-        400 with the reference error shape — before this, only a missing
-        message was caught and a non-string one crashed downstream in
-        the tokenizer."""
+        oversized messages, and non-string strategy/session_id/tenant_id
+        are all 400 with the reference error shape — before this, only a
+        missing message was caught and a non-string one crashed
+        downstream in the tokenizer.  ``tenant_id`` (ISSUE 17, additive
+        field) is capped at 64 chars and must be printable — it becomes
+        a metric label and a quota key; absent means the shared
+        ``default`` tenant, so tenant-less clients are unchanged."""
         if getattr(state["router"], "draining", False):
             # Graceful drain: the edge stops admitting FIRST.  503 + the
             # sanctioned retry hint; in-flight requests keep finishing.
@@ -138,7 +141,7 @@ def create_app(router: Optional[Router] = None,
                 "error": "Request failed: server is draining "
                          "(graceful shutdown in progress)",
                 "retry_after_s": state["router"].drain_retry_after_s(),
-            }), 503), None, None, None, None, None)
+            }), 503), None, None, None, None, None, None)
         data = request.get_json(silent=True)
         if data is None:
             return _bad_request("Request failed: body must be valid JSON")
@@ -148,6 +151,7 @@ def create_app(router: Optional[Router] = None,
         user_input = data.get("message", "")
         requested = data.get("strategy", "hybrid")
         session_id = data.get("session_id", "default")
+        tenant_id = data.get("tenant_id", "default")
         if not isinstance(user_input, str):
             return _bad_request("Request failed: 'message' must be a "
                                 "string")
@@ -158,6 +162,15 @@ def create_app(router: Optional[Router] = None,
                                                             str):
             return _bad_request("Request failed: 'strategy' and "
                                 "'session_id' must be strings")
+        if not isinstance(tenant_id, str) or not tenant_id:
+            return _bad_request("Request failed: 'tenant_id' must be a "
+                                "non-empty string")
+        if len(tenant_id) > 64:
+            return _bad_request("Request failed: 'tenant_id' exceeds "
+                                "64 characters")
+        if any(ord(c) < 32 or ord(c) == 127 for c in tenant_id):
+            return _bad_request("Request failed: 'tenant_id' must not "
+                                "contain control characters")
         if requested == "token-counting":   # UI dropdown name
             requested = "token"
         if not user_input.strip():
@@ -172,12 +185,13 @@ def create_app(router: Optional[Router] = None,
                 except Exception as exc:
                     return ((jsonify({"error":
                                       f"Failed to switch strategy: {exc}"}),
-                             500), None, None, None, None, None)
+                             500), None, None, None, None, None, None)
             history = state["histories"].setdefault(session_id, [])
             turn = {"role": "user", "content": user_input}
             history.append(turn)
             snapshot = list(history)
-        return None, turn, requested, session_id, history, snapshot
+        return (None, turn, requested, session_id, tenant_id, history,
+                snapshot)
 
     def _rollback_user_turn(history, turn):
         """Remove THIS request's user turn by identity — popping the tail
@@ -209,14 +223,14 @@ def create_app(router: Optional[Router] = None,
         failover, fault model, and perf feedback as the sync path.  The
         response cache does not participate (a stream is consumed as it
         is produced)."""
-        err, turn, requested, session_id, history, snapshot = \
+        err, turn, requested, session_id, tenant_id, history, snapshot = \
             _begin_chat_turn()
         if err is not None:
             return err
 
         try:
             routed = state["router"].route_query_stream(
-                snapshot, session_id=session_id)
+                snapshot, session_id=session_id, tenant_id=tenant_id)
         except Exception as exc:
             logger.exception("stream routing failed")
             _rollback_user_turn(history, turn)
@@ -354,6 +368,14 @@ def create_app(router: Optional[Router] = None,
                     entry["kv"] = agg
             else:
                 entry.update(engine_stats(getattr(mgr, "_engine", None)))
+            # Per-tenant quota state (ISSUE 17): active counts, token-
+            # bucket levels, admit/reject totals — quota-ON tiers only.
+            tq = getattr(tier, "tenants", None)
+            if tq is not None:
+                try:
+                    entry["tenants"] = tq.snapshot()
+                except Exception:
+                    pass
             tiers[name] = entry
         try:
             cache_stats = router_.query_router.get_cache_stats()

@@ -43,6 +43,7 @@ from ..routing.engine import QueryRouter
 from ..routing.token_counter import TokenCounter
 from ..utils.faults import FaultInjector
 from .errors import is_error_shape
+from .tenants import DEFAULT_TENANT
 from .tiers import TierClient, build_tiers
 
 logger = logging.getLogger(__name__)
@@ -199,6 +200,14 @@ class Router:
         # "~overflow".  The ledger evicts; label children cannot.
         self._session_labels: set = set()
         self._session_label_cap = 256
+        # Tenant over-quota incident edge (ISSUE 17): the FIRST quota
+        # rejection for a tenant opens a flight-recorder incident naming
+        # it (the over-quota tenant that triggered shedding is exactly
+        # what the noisy-neighbor post-mortem needs); a later ADMITTED
+        # request from the same tenant finalizes it with the rejection
+        # count absorbed meanwhile.  Bounded: at most
+        # ``_session_label_cap`` distinct open-tenant slots ever.
+        self._tenant_incidents: Dict[str, Dict[str, Any]] = {}
 
         self.enable_response_cache = (
             not benchmark_mode
@@ -546,11 +555,11 @@ class Router:
         return "~overflow"
 
     def _note_cost(self, tier: str, strategy: str, session: str,
-                   device_ms: float, kv_ticks: float) -> None:
+                   tenant: str, device_ms: float, kv_ticks: float) -> None:
         """Fold one finished request's attributed cost into the bounded
         ledger (oldest key evicted past the cap — dict insertion order
         is the age order; a re-charged key keeps its slot)."""
-        key = (tier, strategy, session)
+        key = (tier, strategy, session, tenant)
         with self._cost_lock:
             entry = self._cost_ledger.get(key)
             if entry is None:
@@ -566,11 +575,12 @@ class Router:
 
     def cost_snapshot(self) -> List[Dict[str, Any]]:
         """The GET /stats ``cost`` block: attributed device time and KV
-        block-ticks per (tier, strategy, session), most expensive
-        first."""
+        block-ticks per (tier, strategy, session, tenant), most
+        expensive first."""
         with self._cost_lock:
             rows = [
                 {"tier": k[0], "strategy": k[1], "session": k[2],
+                 "tenant": k[3],
                  "device_time_ms": round(v["device_time_ms"], 3),
                  "kv_block_ticks": round(v["kv_block_ticks"], 3),
                  "requests": int(v["requests"])}
@@ -674,9 +684,17 @@ class Router:
         # on every path of both pipelines, so goodput counts requests,
         # never attempts.  Degraded service is not goodput even when the
         # stale-cache reply carried ok=True.
+        tenant_raw = trace.attrs.get("tenant") or DEFAULT_TENANT
+        tenant = self.obs.tenant_labels.label(tenant_raw)
         self.slo.record_request(strategy, which, ok=ok and not degraded,
                                 ttft_ms=ttft, tbt_p95_ms=tbt_p95,
-                                cache_hit=cache_hit)
+                                cache_hit=cache_hit, tenant=tenant)
+        # A completed (admitted) request is the falling edge of this
+        # tenant's over-quota incident, if one is open; a tenant-quota
+        # rejection is not completion.
+        if not (isinstance(raw, dict)
+                and "tenant '" in str(raw.get("error", ""))):
+            self._tenant_incident_edge(tenant_raw, rejected=False)
         # Per-request cost attribution (ISSUE 11): the batched engine
         # charged decode device time + KV block-ticks onto the trace;
         # this exactly-once exit aggregates them per (tier, strategy,
@@ -691,8 +709,22 @@ class Router:
                                  session).inc(dev_ms)
             m.kv_block_ticks.labels(which or "none", strategy,
                                     session).inc(kv_ticks)
-            self._note_cost(which or "none", strategy, session,
+            m.tenant_device_time.labels(which or "none", tenant).inc(dev_ms)
+            m.tenant_kv_block_ticks.labels(which or "none",
+                                           tenant).inc(kv_ticks)
+            self._note_cost(which or "none", strategy, session, tenant,
                             dev_ms, kv_ticks)
+            # Post-paid quota billing (ISSUE 17): debit the serving
+            # tier's per-tenant token bucket with the MEASURED device
+            # time — quotas enforce observed cost, not declared cost.
+            # No-op when the tier runs quotas-off (tenants is None).
+            tier_client = self.tiers.get(which) if which else None
+            tq = getattr(tier_client, "tenants", None)
+            if tq is not None:
+                try:
+                    tq.debit(tenant_raw, dev_ms)
+                except Exception:
+                    pass
         reason = self.obs.recorder.classify(ok, degraded, dur)
         if reason is not None:
             m.flight_records.labels(reason).inc()
@@ -872,12 +904,67 @@ class Router:
     def _note_admission_rejection(self, raw: Any, which: str) -> None:
         """Admission-rejection metrics: every rejection counts, and the
         KV-pressure subset gets its own counter (the signal the pressure
-        chaos leg and dashboards key on)."""
+        chaos leg and dashboards key on).  Tenant-quota rejections
+        (ISSUE 17; reason names the tenant) additionally feed the
+        per-tenant shed counter and the over-quota incident edge."""
         if not self._is_admission_rejection(raw):
             return
         self.obs.m.admission_rejected.labels(which).inc()
-        if "KV demand" in str(raw.get("error", "")):
+        err = str(raw.get("error", ""))
+        if "KV demand" in err:
             self.obs.m.kv_admission_rejected.labels(which).inc()
+        if "tenant '" in err:
+            trace = current_trace()
+            tenant = (trace.attrs.get("tenant")
+                      if trace is not None else None) or DEFAULT_TENANT
+            self.obs.m.tenant_rejected.labels(
+                which, self.obs.tenant_labels.label(tenant)).inc()
+            self._tenant_incident_edge(tenant, rejected=True,
+                                       which=which, reason=err)
+
+    def _tenant_incident_edge(self, tenant: str, rejected: bool,
+                              which: Optional[str] = None,
+                              reason: str = "") -> None:
+        """Over-quota incident lifecycle (ISSUE 17): a tenant's FIRST
+        quota rejection opens a flight-recorder incident naming it
+        (rising edge — post-mortem survives a crash mid-shed, same
+        contract as the SLO overload incidents); subsequent rejections
+        only bump its count; the tenant's next COMPLETED request
+        finalizes it.  At most ``_session_label_cap`` distinct tenants
+        tracked — past that, rejections still count in metrics but mint
+        no new incidents."""
+        if rejected:
+            with self._cost_lock:
+                st = self._tenant_incidents.get(tenant)
+                if st is not None:
+                    st["rejections"] += 1
+                    return
+                if len(self._tenant_incidents) >= self._session_label_cap:
+                    return
+                st = {"entry": None, "rejections": 1}
+                self._tenant_incidents[tenant] = st
+            info = {"tenant": tenant, "tier": which or "none",
+                    "first_reason": (reason or "")[:200],
+                    "start_unix": round(time.time(), 3), "open": True}
+            try:
+                st["entry"] = self.obs.recorder.record_incident(
+                    "tenant_overquota", info)
+                self.obs.m.flight_records.labels("tenant_overquota").inc()
+            except Exception:
+                pass
+            return
+        with self._cost_lock:
+            st = self._tenant_incidents.pop(tenant, None)
+        if st is None:
+            return
+        entry = st.get("entry")
+        if entry is not None:
+            try:
+                self.obs.recorder.update_incident(
+                    entry, open=False, end_unix=round(time.time(), 3),
+                    rejections_while_open=int(st["rejections"]))
+            except Exception:
+                pass
 
     # -- context-overflow policy (serving edge) ----------------------------
 
@@ -1155,7 +1242,8 @@ class Router:
         return device, method, confidence, reasoning, cache_hit, overhead_ms
 
     def route_query(self, history: List[Dict[str, Any]],
-                    session_id: Optional[str] = None
+                    session_id: Optional[str] = None,
+                    tenant_id: Optional[str] = None
                     ) -> Tuple[Dict[str, Any], int, str]:
         """Instrumented entry: creates the request's span tree (obs/),
         binds it for this thread (tiers/engines pick it up via
@@ -1165,11 +1253,16 @@ class Router:
         the reference contract (return shape, error semantics) is
         untouched.  ``session_id`` (optional, additive — the serving
         edge passes its /chat session) keys the per-session cost
-        attribution; None aggregates under '-'."""
+        attribution; None aggregates under '-'.  ``tenant_id``
+        (ISSUE 17; validated at the serving edge) rides the trace into
+        the tier quota layer and keys per-tenant billing; None bills to
+        the shared default tenant."""
         self._ensure_sampler()
         trace = self.obs.trace(strategy=self.query_router.strategy)
         if session_id:
             trace.annotate(session=str(session_id))
+        if tenant_id:
+            trace.annotate(tenant=str(tenant_id))
         with use_trace(trace):
             try:
                 response, tokens, which = self._route_query_inner(
@@ -1342,7 +1435,8 @@ class Router:
         return out, tokens, which
 
     def route_query_stream(self, history: List[Dict[str, Any]],
-                           session_id: Optional[str] = None
+                           session_id: Optional[str] = None,
+                           tenant_id: Optional[str] = None
                            ) -> "RoutedStream":
         """Streaming twin of ``route_query``: same decision stage
         (``_decide`` incl. the ctx-size fallback), the same circuit-
@@ -1360,6 +1454,8 @@ class Router:
                                stream=True)
         if session_id:
             trace.annotate(session=str(session_id))
+        if tenant_id:
+            trace.annotate(tenant=str(tenant_id))
         with use_trace(trace):
             try:
                 return self._route_stream_inner(trace, history)

@@ -28,6 +28,7 @@ from ..obs import spans as obs_spans
 from ..obs.spans import current_trace, use_trace
 from ..parallel.mesh import carve_tier_meshes
 from ..utils.faults import FaultInjector
+from .tenants import DEFAULT_TENANT, TenantQuotas
 from .turns import ClippedStream, clip_turn
 
 logger = logging.getLogger(__name__)
@@ -241,6 +242,11 @@ class TierClient:
         # already 1.
         slots = max(1, tier.decode_batch)
         self.admission = AdmissionController(tier, slots=slots)
+        # Per-tenant quota layer (ISSUE 17) — constructed ONLY when the
+        # tier opts in; ``tenant_quotas=None`` keeps every request on
+        # the exact pre-tenant code path (byte-identity contract).
+        self.tenants: Optional[TenantQuotas] = (
+            TenantQuotas(tier) if tier.tenant_quotas is not None else None)
         try:
             manager.admission = self.admission
         except Exception:
@@ -280,12 +286,28 @@ class TierClient:
         the reference error shape in microseconds instead of blocking a
         serving thread for the full cap (AdmissionController)."""
         trace = current_trace()
+        tenant = self._tenant_of(trace)
+        # Tenant quota gate runs BEFORE the tier controller: a shed
+        # over-quota tenant never consumes tier admission state (queue
+        # slot, EWMA evidence, KV gate work) — the isolation property
+        # the noisy-neighbor bench pins.  No-op when quotas are off.
+        tenant_err = self._tenant_try_admit(trace, tenant)
+        if tenant_err is not None:
+            logger.warning("tier %s tenant quota rejected a request: %s",
+                           self.name, tenant_err)
+            return self._admission_error(tenant_err, tenant=tenant)
+
+        def release_tenant():
+            if self.tenants is not None:
+                self.tenants.release(tenant)
+
         kv_demand, kv_supply = self._kv_admission_args(history)
         with obs_spans.span(trace, "admission", tier=self.name) as adm_sp:
             admit_err = self.admission.try_admit(kv_demand, kv_supply)
             if admit_err is not None:
                 adm_sp.annotate(rejected=admit_err)
         if admit_err is not None:
+            release_tenant()
             logger.warning("tier %s admission rejected a request: %s",
                            self.name, admit_err)
             return self._admission_error(admit_err)
@@ -293,6 +315,7 @@ class TierClient:
             fault = self.faults.intercept(self.name)
             if fault is not None:
                 self.admission.release()     # never reached the engine
+                release_tenant()
                 return fault
 
         timeout = self.tier.request_timeout_s
@@ -302,6 +325,7 @@ class TierClient:
                 resp, result = self._process_body(history)
             finally:
                 self.admission.release(time.perf_counter() - t0)
+                release_tenant()
             if result is not None:
                 # Same lock as the timeout path's worker: last_result is
                 # read/written cross-thread once timeouts can abandon
@@ -314,6 +338,7 @@ class TierClient:
             abandoned_outstanding = self._abandoned
         if abandoned_outstanding and not self._engine_concurrent_safe():
             self.admission.release()
+            release_tenant()
             logger.warning("tier %s has an abandoned timed-out call "
                            "outstanding — failing fast", self.name)
             return {"error": f"Request failed: {self.name} is busy with "
@@ -346,8 +371,11 @@ class TierClient:
                 # The admission slot is held for the worker's whole
                 # life — an abandoned worker still occupies the engine,
                 # and its true duration is exactly the slow evidence
-                # the EWMA should see.
+                # the EWMA should see.  Same lifetime for the tenant
+                # quota slot: an abandoned worker still burns the
+                # tenant's share of the engine.
                 self.admission.release(time.perf_counter() - t0)
+                release_tenant()
 
         threading.Thread(target=work, daemon=True,
                          name=f"{self.name}-request").start()
@@ -405,19 +433,63 @@ class TierClient:
         except Exception:
             return None, None               # estimation must never reject
 
-    def _admission_error(self, admit_err: str) -> Dict[str, Any]:
+    def _admission_error(self, admit_err: str,
+                         tenant: Optional[str] = None) -> Dict[str, Any]:
         """Reference error shape for an admission rejection.  Drain and
         KV-pressure rejections carry the sanctioned ``retry_after_s``
         hint (serving/errors.py): both are transient-by-design states a
         client should retry past, unlike a full waiting line where
-        failover is the productive move."""
+        failover is the productive move.  Tenant-quota rejections
+        (ISSUE 17) always carry the hint, computed from the TENANT's
+        own budget (token-bucket time-to-positive) rather than the
+        tier EWMA — the tier may be idle while this tenant is shed."""
         from .errors import error_dict
         msg = (f"Request failed: {self.name} admission rejected: "
                f"{admit_err}")
+        if (tenant is not None and self.tenants is not None
+                and "tenant '" in admit_err):
+            return error_dict(
+                msg, retry_after_s=self.tenants.retry_after_s(tenant))
         if "draining" in admit_err or "KV demand" in admit_err:
             return error_dict(msg,
                               retry_after_s=self.admission.retry_after_s())
         return {"error": msg}
+
+    def _tenant_of(self, trace) -> str:
+        """The request's tenant identity, annotated onto the trace by
+        the Router (serving/app.py validated it at the edge); requests
+        arriving without one — direct TierClient callers, tests —
+        bill to the shared default tenant."""
+        try:
+            t = trace.attrs.get("tenant") if trace is not None else None
+        except Exception:
+            t = None
+        return t if isinstance(t, str) and t else DEFAULT_TENANT
+
+    def _tenant_try_admit(self, trace, tenant: str) -> Optional[str]:
+        """Quota-layer admission (None when quotas are off or the
+        tenant is in budget; else the rejection reason).  The KV bill
+        fed to the per-tenant block budget is the tenant's LIVE
+        resident bill at 1/refcount from the engine — dedup lowers it,
+        so a tenant whose prompts share prefixes is billed for its
+        marginal footprint, not its nominal one."""
+        if self.tenants is None:
+            return None
+        kv_bill = None
+        if self.tenants.kv_budget(tenant) is not None:
+            engine = getattr(self.server_manager, "_engine", None)
+            bill_fn = getattr(engine, "tenant_kv_blocks", None)
+            if callable(bill_fn):
+                try:
+                    kv_bill = bill_fn(tenant)
+                except Exception:
+                    kv_bill = None       # billing must never reject
+        with obs_spans.span(trace, "tenant_admission", tier=self.name,
+                            tenant=tenant) as t_sp:
+            tenant_err = self.tenants.try_admit(tenant, kv_bill)
+            if tenant_err is not None:
+                t_sp.annotate(rejected=tenant_err)
+        return tenant_err
 
     def _maybe_break_stream(self, handle):
         """Apply a scripted mid-stream kill (FaultInjector.
@@ -518,12 +590,24 @@ class TierClient:
         it to the EWMA would let slow readers poison the predictive
         fail-fast against an idle engine)."""
         trace = current_trace()
+        tenant = self._tenant_of(trace)
+        tenant_err = self._tenant_try_admit(trace, tenant)
+        if tenant_err is not None:
+            logger.warning("tier %s tenant quota rejected a stream: %s",
+                           self.name, tenant_err)
+            return self._admission_error(tenant_err, tenant=tenant)
+
+        def release_tenant():
+            if self.tenants is not None:
+                self.tenants.release(tenant)
+
         kv_demand, kv_supply = self._kv_admission_args(history)
         with obs_spans.span(trace, "admission", tier=self.name) as adm_sp:
             admit_err = self.admission.try_admit(kv_demand, kv_supply)
             if admit_err is not None:
                 adm_sp.annotate(rejected=admit_err)
         if admit_err is not None:
+            release_tenant()
             logger.warning("tier %s admission rejected a stream: %s",
                            self.name, admit_err)
             return self._admission_error(admit_err)
@@ -535,12 +619,14 @@ class TierClient:
             engine_ms = getattr(result, "total_ms", 0) if result else 0
             self.admission.release(engine_ms / 1000.0 if engine_ms
                                    else time.perf_counter() - t0)
+            release_tenant()
 
         try:
             if self.faults is not None:
                 fault = self.faults.intercept(self.name)
                 if fault is not None:
                     self.admission.release()   # never reached the engine
+                    release_tenant()
                     return fault
             if not self.server_manager.is_server_running():
                 logger.info("No running %s engine found, starting...", self.name)
@@ -549,6 +635,7 @@ class TierClient:
             engine = self.server_manager.engine()
             if not hasattr(engine, "generate_stream"):
                 self.admission.release()
+                release_tenant()
                 return {"error": "Request failed: engine does not support "
                                  "token streaming"}
             if getattr(engine, "concurrent_safe", False):
@@ -569,6 +656,7 @@ class TierClient:
                     timeout=timeout if timeout is not None else -1)
             if not acquired:
                 self.admission.release()
+                release_tenant()
                 logger.warning("tier %s stream setup could not take the "
                                "engine lock within %.0fs — failing over",
                                self.name, timeout)
@@ -591,6 +679,7 @@ class TierClient:
                 raise
         except Exception as exc:
             self.admission.release()
+            release_tenant()
             shape = getattr(exc, "shape", None)
             if isinstance(shape, dict) and "error" in shape:
                 return dict(shape)         # engine-stopped: exact shape

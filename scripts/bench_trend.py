@@ -65,6 +65,11 @@ from typing import Any, Dict, List, Optional, Tuple
 # carve — on the CPU box sharding is pure overhead so the value sits
 # below 1.0; the pin is a canary for the sharded ragged tick's host
 # cost creeping up, not a speedup claim) joined in r18.
+# noisy.quiet_p95_ratio (ISSUE 17's quiet-tenant under-flood/solo
+# latency p95 with per-tenant quotas ON — drifting up toward the
+# quotas-OFF collateral means isolation stopped isolating) and
+# noisy.flood_shed_precision (tenant-shaped rejections landing on the
+# flooder, not the quiet tenant) joined in r19.
 PINNED: Tuple[Tuple[str, bool], ...] = (
     ("trend_req_per_s", True),
     ("skew_tick_ratio", False),
@@ -75,6 +80,8 @@ PINNED: Tuple[Tuple[str, bool], ...] = (
     ("spill.tbt_ratio", False),
     ("spec.tok_ratio", True),
     ("multichip.tp_ratio", True),
+    ("noisy.quiet_p95_ratio", False),
+    ("noisy.flood_shed_precision", True),
 )
 
 # Context rows printed (no flags): the headline and accuracy travel
@@ -116,6 +123,10 @@ _PATHS: Dict[str, Tuple[Tuple[str, ...], ...]] = {
     "replica.aff_ret": (("replica", "aff_ret"),
                         ("replica", "affinity_hit_retention"),),
     "profile.coverage": (("profile", "coverage"),),
+    "noisy.quiet_p95_ratio": (("noisy", "p95_ratio_on"),
+                              ("noisy", "quiet_p95_ratio"),),
+    "noisy.flood_shed_precision": (("noisy", "shed_precision"),
+                                   ("noisy", "flood_shed_precision"),),
 }
 
 

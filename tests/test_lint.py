@@ -351,6 +351,61 @@ def test_lock_mixed_guard_all_bare_worker_writes_presumed_single_writer():
     assert _lint(LockChecker(), {ENGINE: src}).findings == []
 
 
+def test_lock_mixed_guard_flags_bare_tenant_counter_read():
+    """ISSUE 17 shape: the tenant-quota registry's in-flight counters
+    are debited from router worker threads under the registry lock — a
+    bare read feeding an admission decision elsewhere is exactly the
+    torn-count race the registry lock exists to prevent."""
+    bad = """
+        import threading
+
+        class TenantQuotas:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._inflight = {}
+
+            def watch(self):
+                threading.Thread(target=self._drain).start()
+
+            def _drain(self):
+                with self._lock:
+                    for t in list(self._inflight):
+                        self._inflight[t] = max(0, self._inflight[t] - 1)
+
+            def try_admit(self, tenant):
+                return self._inflight[tenant] < 4   # bare read
+    """
+    result = _lint(LockChecker(), {SERVING: bad})
+    assert "lock-mixed-guard" in _rules(result), result.findings
+    assert any("_inflight" in f.message for f in result.findings)
+
+
+def test_lock_mixed_guard_tenant_counter_under_lock_clean():
+    """Near-miss: the shipped TenantQuotas shape — every counter touch
+    under the registry lock — stays silent."""
+    src = """
+        import threading
+
+        class TenantQuotas:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._inflight = {}
+
+            def watch(self):
+                threading.Thread(target=self._drain).start()
+
+            def _drain(self):
+                with self._lock:
+                    for t in list(self._inflight):
+                        self._inflight[t] = max(0, self._inflight[t] - 1)
+
+            def try_admit(self, tenant):
+                with self._lock:
+                    return self._inflight[tenant] < 4
+    """
+    assert _lint(LockChecker(), {SERVING: src}).findings == []
+
+
 def test_lock_checker_manual_release_ends_held_region():
     """acquire/try/finally-release then blocking work must not flag:
     the held region ends at the release."""

@@ -185,6 +185,78 @@ def test_kv_pool_blocks_validation():
                                  seed=0)
 
 
+# -- tenant-aware preemption (ISSUE 17) --------------------------------------
+
+def _tight_quota_engine(quotas):
+    from distributed_llm_tpu.config import TenantQuota  # noqa: F401
+    return ContinuousBatchingEngine(
+        _tier(decode_batch=2, max_new_tokens=24, kv_pool_blocks=5,
+              enable_prefix_cache=False, tenant_quotas=quotas), seed=1)
+
+
+def test_preemption_victim_is_most_over_quota_first():
+    """Quotas ON: the ELDER slot owned by the over-KV-budget tenant is
+    preempted before the younger in-budget tenant's — deterministic,
+    pinned by the per-request preempt counters."""
+    from distributed_llm_tpu.config import TenantQuota
+    engine = _tight_quota_engine({"hog": TenantQuota(kv_blocks=1)})
+    try:
+        ra = engine.submit(PROBE_A, tenant="hog")   # elder, over budget
+        time.sleep(0.05)
+        rb = engine.submit(PROBE_B, tenant="ok")    # younger, no budget
+        ra.done.wait(timeout=120)
+        rb.done.wait(timeout=120)
+        assert engine.preempted_total >= 1
+        assert ra.preempt_count >= 1, "over-quota elder was never preempted"
+        assert rb.preempt_count == 0, "in-budget youngster was victimized"
+        assert ra.error is None and rb.error is None
+    finally:
+        engine.stop()
+
+
+def test_preemption_same_tenant_falls_back_to_youngest():
+    """Equal over-quota ratios (same tenant) tie-break youngest-first —
+    the historical policy, unchanged under quotas."""
+    from distributed_llm_tpu.config import TenantQuota
+    engine = _tight_quota_engine({"hog": TenantQuota(kv_blocks=1)})
+    try:
+        ra = engine.submit(PROBE_A, tenant="hog")
+        time.sleep(0.05)
+        rb = engine.submit(PROBE_B, tenant="hog")   # same tenant: youngest
+        ra.done.wait(timeout=120)
+        rb.done.wait(timeout=120)
+        assert engine.preempted_total >= 1
+        assert ra.preempt_count == 0, "elder preempted despite tie"
+        assert rb.preempt_count >= 1
+    finally:
+        engine.stop()
+
+
+def test_preempt_replay_byte_identical_under_quotas(solo_texts):
+    """The preempt->replay byte-identity contract holds with quotas ON:
+    both texts match their unpreempted quotas-OFF runs."""
+    from distributed_llm_tpu.config import TenantQuota
+    engine = _tight_quota_engine({"hog": TenantQuota(kv_blocks=1)})
+    res = {}
+    try:
+        threads = [threading.Thread(
+            target=lambda k, q, t: res.__setitem__(
+                k, engine.generate(q, tenant=t)),
+            args=(k, q, t))
+            for k, q, t in (("a", PROBE_A, "hog"), ("b", PROBE_B, "ok"))]
+        threads[0].start()
+        time.sleep(0.02)
+        threads[1].start()
+        for t in threads:
+            t.join(timeout=120)
+        assert engine.preempted_total >= 1
+        assert res["a"].text == solo_texts["a"]
+        assert res["b"].text == solo_texts["b"]
+        assert engine.allocator.available == engine.paged.num_blocks - 1
+    finally:
+        engine.stop()
+
+
 # -- context-overflow policy -------------------------------------------------
 
 @pytest.fixture(scope="module")

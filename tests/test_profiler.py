@@ -282,7 +282,7 @@ def test_cost_ledger_is_bounded():
     r._cost_ledger = {}
     r._cost_ledger_cap = 8
     for i in range(50):
-        r._note_cost("nano", "perf", f"s{i}", 1.0, 2.0)
+        r._note_cost("nano", "perf", f"s{i}", "default", 1.0, 2.0)
     assert len(r._cost_ledger) == 8
     rows = r.cost_snapshot()
     assert len(rows) == 8
