@@ -435,6 +435,31 @@ def compact(result: dict) -> dict:
         }.items() if v is not None}
         if cm:
             out["replica"] = cm
+    el = result.get("elastic")
+    if isinstance(el, dict) and not el.get("skipped"):
+        # One number each (BENCHMARKS.md r20): the autoscaled
+        # goodput-per-replica-second with its vs-static-max ratios (the
+        # >= 0.9x goodput / strictly-better-gprs acceptance pair ride
+        # as booleans), the effective scale-event count + flap count,
+        # the handoff sub-check verdict, and the per-mode gprs row.
+        cm = {k: v for k, v in {
+            "gprs": el.get("goodput_per_replica_s"),
+            "gprs_vs_max": el.get("gprs_vs_max"),
+            "goodput_vs_max": el.get("goodput_vs_max"),
+            "goodput_ok": el.get("goodput_ok"),
+            "gprs_ok": el.get("gprs_ok"),
+            "events": el.get("scale_events"),
+            "flaps": el.get("flap_count"),
+            "gprs_min": (el.get("static_min")
+                         or {}).get("goodput_per_replica_s"),
+            "gprs_max": (el.get("static_max")
+                         or {}).get("goodput_per_replica_s"),
+            "handoff": (el.get("handoff") or {}).get("handed_off"),
+            "ident": el.get("outputs_identical"),
+            "err": (el.get("error") or "")[:80] or None,
+        }.items() if v is not None}
+        if cm:
+            out["elastic"] = cm
     mc = result.get("multichip")
     if isinstance(mc, dict) and not mc.get("skipped"):
         # One number each (BENCHMARKS.md r18): the judged tp=2/tp=1
@@ -2544,6 +2569,366 @@ def replica_phase(n_clients: int = 12, n_requests: int = 48,
     return out
 
 
+def _elastic_handoff_subcheck(base_cl, tier, beat=lambda: None) -> dict:
+    """Deterministic scale-down byte-identity sub-check (ISSUE 18): a
+    2-replica client answers K sessions, scales down to 1 (the victim's
+    refcount-1 parked prefixes demoted through the host spill tier and
+    handed to the survivor's store), then answers the SAME prompts again
+    — outputs must be byte-identical (scale-down costs warm TTFT, never
+    correctness).  The scale-UP half carries the per-replica
+    one-decode-program pin: a replica minted mid-flight warms against
+    the process XLA compile cache, so it must land with exactly one
+    compiled decode program and its gauge must agree."""
+    import dataclasses
+
+    from distributed_llm_tpu.engine.paged_kv import pool_block_bytes
+    from distributed_llm_tpu.obs import get_observability
+    from distributed_llm_tpu.serving.replicas import ReplicatedTierClient
+
+    import jax
+
+    blk = pool_block_bytes(tier.model(), tier.kv_block_size,
+                           tier.kv_quantize)
+    s_tier = dataclasses.replace(
+        tier, replicas=1, enable_prefix_cache=True,
+        prefix_cache_entries=8, prefill_chunk_tokens=16,
+        # Host tier sized so every demoted session fits: the handoff
+        # must be capacity-limited by NOTHING here — what it carries is
+        # the sub-check's subject.
+        host_kv_bytes=blk * 64)
+    prompts = [f"session {n} tell me about rivers in one short sentence"
+               for n in ("alpha", "bravo", "charlie", "delta",
+                         "echo", "foxtrot")]
+    out: dict = {}
+    client = ReplicatedTierClient(
+        s_tier, dataclasses.replace(base_cl, nano=s_tier),
+        devices=list(jax.devices()[:2]), seed=base_cl.seed)
+    try:
+        client.server_manager.start_server(beat=beat)
+        beat()
+        up = client.scale_to(2, reason="subcheck")
+        beat()
+        out["scale_up_errors"] = [str(e)[:120] for e in up["errors"]]
+        # One-decode-program pin at width 2 — BOTH replicas, including
+        # the one just minted mid-flight.
+        programs: dict = {}
+        for key, eng in client.server_manager.live_engines():
+            compiled = len(getattr(eng, "_compiled", {}).get("decode",
+                                                             ()))
+            gauge = None
+            try:
+                gauge = get_observability().m.compiled_programs.labels(
+                    eng.tier.name, "decode").value
+            except Exception:
+                pass
+            programs[key] = {"compiled": compiled, "gauge": gauge}
+        out["decode_programs_per_replica"] = programs
+        if getattr(s_tier, "attention_ragged", False) and any(
+                p["compiled"] != 1
+                or (p["gauge"] is not None and p["gauge"] != 1.0)
+                for p in programs.values()):
+            out["error"] = (f"scaled-up replica minted != 1 decode "
+                            f"program: {programs}")
+        pre = [client.process(p) for p in prompts]
+        beat()
+        down = client.scale_to(1, reason="subcheck")
+        beat()
+        removed = (down.get("removed") or [{}])[0]
+        out["victim"] = removed.get("replica")
+        out["demoted_entries"] = removed.get("demoted_entries")
+        out["handed_off"] = removed.get("handed_off")
+        post = [client.process(p) for p in prompts]
+        beat()
+        pre_txt = [r.get("response") if isinstance(r, dict) else None
+                   for r in pre]
+        post_txt = [r.get("response") if isinstance(r, dict) else None
+                    for r in post]
+        out["identical"] = (pre_txt == post_txt
+                            and None not in pre_txt)
+        if not out["identical"] and "error" not in out:
+            out["error"] = ("scale-down changed answers: same prompts "
+                            "diverged across the 2->1 transition")
+    finally:
+        client.server_manager.stop_server()
+    return out
+
+
+def elastic_phase(period_s: float = 20.0, beat=lambda: None) -> dict:
+    """Elastic-capacity leg (ISSUE 18): the SAME seeded diurnal-ramp
+    schedule (bench/scenarios.py) replayed through the full Router +
+    HTTP edge under three capacity policies — static-min (1 replica),
+    static-max (2 replicas), and the SLO-driven autoscaler bounded to
+    [1, 2] — at the same seed.
+
+    Headline: **goodput-per-replica-second** (SLO-good responses per
+    second of replica uptime; the autoscaled run's replica-seconds are
+    integrated from its decision ledger, the static runs' are
+    count x wall).  Acceptance: autoscaled goodput >= 0.9x static-max
+    while goodput-per-replica-second beats static-max STRICTLY — the
+    elastic policy must buy near-max goodput for measurably fewer
+    replica-seconds, or it is just a slower static-max.
+
+    HARD invariants (``error``): the flap bound (<= 2 effective scale
+    events per traffic inflection — the ramp has two — and no
+    up-down-up inside one cooldown window), the scale-down
+    byte-identity sub-check (``_elastic_handoff_subcheck``), and the
+    sub-check's per-replica one-decode-program pin."""
+    import dataclasses
+    import sys
+
+    from distributed_llm_tpu.bench.scenarios import (
+        diurnal_ramp, run_schedule, schedule, total_duration_s)
+    from distributed_llm_tpu.config import tiny_batched_cluster
+    from distributed_llm_tpu.obs import Observability
+    from distributed_llm_tpu.serving.app import create_app
+    from distributed_llm_tpu.serving.router import Router
+
+    print("[bench] elastic capacity leg", file=sys.stderr, flush=True)
+    base_cl = tiny_batched_cluster(nano_slots=2)
+    # Same host-share trim as the replica leg: the 2-core box must
+    # measure the CAPACITY policies, not the GIL serializing two
+    # schedulers' host work.  max_new_tokens is raised so one request
+    # is a real unit of decode work — at the tiny default (24 tokens)
+    # a single 2-slot replica absorbs 30+ req/s and no schedulable
+    # rate ever queues, which would make the leg a no-op (48, not
+    # higher: engine warmup generates to the cap, so the cap is also
+    # the scale-up actuation latency the controller pays mid-peak).
+    # The deepened admission queue keeps the peak's backlog a QUEUE
+    # signal instead of a shed-storm of orin failovers — big-tier
+    # generations grinding the shared cores would swamp what the leg
+    # measures; TTFT > SLO still marks over-queued requests bad.
+    tier = dataclasses.replace(base_cl.nano, decode_steps_per_tick=8,
+                               max_new_tokens=48, admission_max_queue=64)
+    # Autoscaler knobs sized to the compressed "day": windows/cooldowns
+    # must fit several times inside one ramp segment or the controller
+    # could never act inside the leg at all.  Registered knobs — a real
+    # deployment sets the same fields at day scale.
+    auto_tier = dataclasses.replace(
+        tier, autoscale=True,
+        autoscale_min_replicas=1, autoscale_max_replicas=2,
+        autoscale_interval_s=0.2, autoscale_breach_window_s=0.4,
+        autoscale_idle_window_s=1.5, autoscale_up_cooldown_s=1.5,
+        autoscale_down_cooldown_s=4.0, autoscale_queue_high=2.0,
+        autoscale_goodput_floor=0.5)
+    out: dict = {"period_s": period_s,
+                 "slots_per_replica": tier.decode_batch}
+    # Short everyday queries: heuristic routes them to nano (the
+    # elastic tier), and they sit in the smallest prefill bucket so the
+    # replay contains zero first-touch XLA traces.
+    prompts = [f"q{i} rivers?" for i in range(32)]
+    arrivals: list = []
+
+    def run_mode(label: str, mode_tier) -> dict:
+        nonlocal arrivals
+        cl = dataclasses.replace(base_cl, nano=mode_tier)
+        obs = Observability(slow_ms=None)
+        # Failover OFF: a shed must fail fast and score as not-good.
+        # The productive response to overload here is the policy under
+        # test (scale up / stay put), and orin generations stealing the
+        # shared cores mid-peak would poison all three legs' goodput
+        # with cross-tier noise instead of measuring capacity policy.
+        router = Router(strategy="heuristic", benchmark_mode=True,
+                        cluster=cl, observability=obs,
+                        config={"enable_failover": False})
+        app = create_app(router=router)
+        http = app.test_client()
+        res: dict = {"replicas_static": mode_tier.replicas}
+        try:
+            for tc in router.tiers.values():
+                tc.server_manager.start_server(beat=beat)
+                beat()
+            # Warm the edge path untimed, then calibrate the base
+            # sequential rate ONCE (on the first mode) and size the
+            # schedule every mode replays: base well under one
+            # replica's capacity (the idle floor), peak well over it
+            # (the breach) — openloop's calibration idiom.
+            http.post("/chat", json={"message": prompts[0],
+                                     "strategy": "heuristic",
+                                     "session_id": "el-warm"})
+            beat()
+            if not arrivals:
+                # CLOSED-LOOP sustained calibration: a few workers
+                # re-posting back-to-back for a fixed window measure
+                # the one-replica steady completion rate (this first
+                # mode is static-min).  A burst anchor (N threads
+                # fired at once) overstates capacity — it measures
+                # queue absorption, and a schedule sized from it
+                # saturates every mode into SLO chaos.
+                CAL_W, CAL_S = 4, 3.5
+                t_stop = time.perf_counter() + CAL_S
+                done = [0] * CAL_W
+
+                def _cal(w):
+                    i = 0
+                    while time.perf_counter() < t_stop:
+                        http.post("/chat", json={
+                            "message": prompts[(w * 7 + i)
+                                               % len(prompts)],
+                            "strategy": "heuristic",
+                            "session_id": f"el-cal-{w}-{i}"})
+                        done[w] += 1
+                        i += 1
+
+                cal = [threading.Thread(target=_cal, args=(w,),
+                                        daemon=True)
+                       for w in range(CAL_W)]
+                t0c = time.perf_counter()
+                for t in cal:
+                    t.start()
+                for t in cal:
+                    t.join(timeout=120.0)
+                    beat()
+                cap = sum(done) / max(time.perf_counter() - t0c, 1e-3)
+                # Base at a TRUE idle floor (scale-down needs samples
+                # with empty slots); peak at a MILD 1.15x one replica:
+                # enough sustained overload that the queue grows
+                # through the plateau (the controller's breach) while
+                # queue wait stays inside the TTFT budget even at
+                # +-15% calibration error.  A deep overload saturates
+                # the queue cap and every peak request breaches the
+                # SLO in EVERY mode — the comparison would measure
+                # noise at the edges, not capacity policy.
+                segs = diurnal_ramp(
+                    base_rate=max(0.2, 0.05 * cap),
+                    peak_rate=min(60.0, max(1.5, 1.15 * cap)),
+                    period_s=period_s, steps=6)
+                arrivals = schedule(segs, label="elastic-diurnal",
+                                    seed=18, max_arrivals=600)
+                out["capacity_req_per_s"] = round(cap, 3)
+                out["schedule"] = {
+                    "arrivals": len(arrivals),
+                    "base_rate": round(segs[0].rate_req_per_s, 3),
+                    "peak_rate": round(max(s.rate_req_per_s
+                                           for s in segs), 3),
+                    "scheduled_s": round(total_duration_s(segs), 2),
+                }
+
+            def fire(a):
+                # Stateless unit work (one fresh session per arrival):
+                # the leg compares CAPACITY policies, so every request
+                # must cost the same at t=2 and t=18 — session-growth
+                # prefill would silently shift capacity under the
+                # calibrated schedule (the session-mix scenario keeps
+                # its own coverage in bench/scenarios.py).
+                try:
+                    http.post("/chat", json={
+                        "message": prompts[a.index % len(prompts)],
+                        "strategy": "heuristic",
+                        "session_id": f"el-{a.index}"})
+                except Exception:
+                    pass
+
+            g0 = router.slo.good_total
+            o0 = router.slo.observed_total
+            t0_wall = time.time()
+            rep = run_schedule(fire, arrivals, beat=beat,
+                               join_grace_s=20.0, label=label)
+            wall = max(rep["wall_s"], 1e-6)
+            res.update({
+                "arrivals": rep["arrivals"],
+                "hung_clients": rep["hung_clients"],
+                "wall_s": rep["wall_s"],
+                "goodput_total": router.slo.good_total - g0,
+                "observed_total": router.slo.observed_total - o0,
+            })
+            scaler = getattr(router, "autoscalers", {}).get("nano")
+            if scaler is not None:
+                # Replica-seconds INTEGRATED from the decision ledger
+                # over the replay window; effective events only (a
+                # refused actuation changed nothing and bills nothing).
+                snap = scaler.snapshot()
+                t_end = t0_wall + wall
+                events = [e for e in snap["ledger"]
+                          if e.get("ok")
+                          and e["from_replicas"] != e["to_replicas"]
+                          and t0_wall <= e["ts"] <= t_end]
+                n0 = (events[0]["from_replicas"] if events
+                      else router.tiers["nano"].replica_count())
+                rs, cur, t_prev = 0.0, n0, t0_wall
+                for e in events:
+                    ts = min(max(e["ts"], t0_wall), t_end)
+                    rs += cur * (ts - t_prev)
+                    cur, t_prev = e["to_replicas"], ts
+                rs += cur * (t_end - t_prev)
+                res["replica_s"] = round(rs, 2)
+                res["scale_events"] = len(events)
+                res["max_replicas"] = max([e["to_replicas"]
+                                           for e in events] + [n0])
+                res["events"] = [{"t": round(e["ts"] - t0_wall, 2),
+                                  "dir": e["direction"],
+                                  "reason": e["reason"],
+                                  "to": e["to_replicas"]}
+                                 for e in events]
+                # Flap: a full up-down-up (or down-up-down) reversal
+                # pair landing inside ONE combined cooldown window —
+                # the hysteresis exists to make this impossible.
+                window = (mode_tier.autoscale_up_cooldown_s
+                          + mode_tier.autoscale_down_cooldown_s)
+                res["flap_count"] = sum(
+                    1 for a_e, b_e, c_e in zip(events, events[1:],
+                                               events[2:])
+                    if a_e["direction"] != b_e["direction"]
+                    and b_e["direction"] != c_e["direction"]
+                    and (c_e["ts"] - a_e["ts"]) < window)
+            else:
+                res["replica_s"] = round(mode_tier.replicas * wall, 2)
+            res["goodput_per_replica_s"] = round(
+                res["goodput_total"] / max(res["replica_s"], 1e-6), 4)
+        finally:
+            try:
+                router.drain(timeout_s=10.0)
+            except Exception:
+                for tc in router.tiers.values():
+                    tc.server_manager.stop_server()
+        beat()
+        return res
+
+    out["static_min"] = run_mode(
+        "static-min", dataclasses.replace(tier, replicas=1))
+    out["static_max"] = run_mode(
+        "static-max", dataclasses.replace(tier, replicas=2))
+    out["auto"] = run_mode("auto", auto_tier)
+
+    auto, smax = out["auto"], out["static_max"]
+    out["goodput_per_replica_s"] = auto.get("goodput_per_replica_s")
+    out["scale_events"] = auto.get("scale_events")
+    out["flap_count"] = auto.get("flap_count")
+    if smax.get("goodput_total"):
+        out["goodput_vs_max"] = round(
+            auto["goodput_total"] / smax["goodput_total"], 3)
+    if smax.get("goodput_per_replica_s"):
+        out["gprs_vs_max"] = round(
+            auto["goodput_per_replica_s"]
+            / smax["goodput_per_replica_s"], 3)
+    # Acceptance columns (soft on a loaded box, recorded always):
+    out["goodput_ok"] = (out.get("goodput_vs_max") is not None
+                         and out["goodput_vs_max"] >= 0.9)
+    out["gprs_ok"] = (out.get("gprs_vs_max") is not None
+                      and out["gprs_vs_max"] > 1.0)
+    # HARD: the flap bound — the diurnal ramp has two inflections, so
+    # more than 4 effective events (or ANY reversal pair inside one
+    # cooldown window) is control-loop oscillation, not tracking.
+    if out.get("flap_count", 0) > 0:
+        out["error"] = (f"autoscaler flapped: {out['flap_count']} "
+                        f"reversal pairs inside one cooldown window "
+                        f"({auto.get('events')})")
+    elif out.get("scale_events", 0) > 4:
+        out["error"] = (f"autoscaler over-actuated: "
+                        f"{out['scale_events']} scale events on a "
+                        f"2-inflection ramp ({auto.get('events')})")
+
+    # Scale-down byte-identity + one-decode-program sub-check (HARD).
+    try:
+        hand = _elastic_handoff_subcheck(base_cl, tier, beat=beat)
+    except Exception as exc:
+        hand = {"error": str(exc)[:200]}
+    out["handoff"] = hand
+    out["outputs_identical"] = bool(hand.get("identical"))
+    if hand.get("error") and "error" not in out:
+        out["error"] = f"handoff sub-check: {hand['error']}"
+    return out
+
+
 def multichip_phase(n_requests: int = 8, beat=lambda: None) -> dict:
     """Tensor-parallel serving leg (ISSUE 16): tp=2 vs tp=1 on the
     multi-device carve, three parts.
@@ -3855,6 +4240,22 @@ def run(progress: "Progress" = None, budget: "Budget" = None) -> dict:
     else:
         replica = {"skipped": budget.skip_stamp()}
     progress.section("replica", replica)
+    progress.flush_compact()
+
+    # Elastic-capacity leg (ISSUE 18): the same seeded diurnal-ramp
+    # schedule under static-min / static-max / autoscaled membership —
+    # goodput-per-replica-second headline (autoscaled must buy >= 0.9x
+    # static-max goodput for strictly fewer replica-seconds), the flap
+    # bound, and the scale-down byte-identity + one-decode-program
+    # sub-check (BENCHMARKS.md r20).
+    if budget.allows(180):
+        try:
+            elastic = elastic_phase(beat=progress.beat)
+        except Exception as exc:          # never lose the headline line
+            elastic = {"error": str(exc)[:200]}
+    else:
+        elastic = {"skipped": budget.skip_stamp()}
+    progress.section("elastic", elastic)
     progress.flush_compact()
 
     # Multichip tensor-parallel leg (ISSUE 16): tp=2 vs tp=1 parity +

@@ -614,6 +614,59 @@ class TierConfig:
     # OFF: every code path is byte-identical to pre-tenant behavior
     # (pinned by test), and tenant_id only flows into observability.
     tenant_quotas: Optional[Dict[str, "TenantQuota"]] = None
+    # SLO-driven elastic capacity (serving/autoscaler.py, ISSUE 18):
+    # True arms a per-tier ReplicaAutoscaler control loop that reads the
+    # signals the system already emits (SLOMonitor goodput window, queue
+    # depth / slot occupancy, admission shed rate) and actuates replica
+    # membership through ReplicatedTierClient.scale_to — scale-up warms
+    # the new replica fully off-membership before go-live (dispatch
+    # never blocks on a cold start), scale-down drains the least-affine
+    # replica with its refcount-1 parked prefixes demoted through the
+    # PR 13 spill tier and handed to a survivor.  False (default) keeps
+    # membership exactly the static PR 12 path, byte-identical (pinned);
+    # the DLLM_AUTOSCALE=0 env kill switch disarms ALL tiers at once.
+    autoscale: bool = False
+    # Membership bounds: the autoscaler never scales below min (capacity
+    # floor — also the initial size when ``replicas`` is smaller) or
+    # above max (cost ceiling; also bounds warm-up burst).
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 4
+    # Controller cadence: one signal read + decision per interval.
+    autoscale_interval_s: float = 1.0
+    # Scale-up trigger 1 — goodput floor: the tier's windowed SLO
+    # goodput (obs/slo.py, fed by real request outcomes) sustained
+    # below this fraction for autoscale_breach_window_s.  Same scale
+    # as the SLO monitor's goodput (0..1).
+    autoscale_goodput_floor: float = 0.5
+    # Scale-up trigger 2 — queue growth: tier queue depth sustained
+    # above this many requests PER live replica (queueing theory's
+    # backlog signal; per-replica so the bar scales with membership).
+    autoscale_queue_high: float = 2.0
+    # How long a breach (goodput floor or queue growth) must persist
+    # before scale-up fires — hysteresis against one-sample spikes.
+    autoscale_breach_window_s: float = 3.0
+    # How long the tier must be fully idle (no queue, no active slots,
+    # no admission sheds, goodput at/above floor) before scale-down
+    # fires — idle windows are long on purpose: adding capacity late
+    # costs SLO, removing it late only costs replica-seconds.
+    autoscale_idle_window_s: float = 10.0
+    # Per-direction cooldowns from the LAST membership event (either
+    # direction): up re-arms fast (load is load), down re-arms slow.
+    # Together with the windows these bound flap — an up-down-up needs
+    # at least up+down cooldowns of wall time.
+    autoscale_up_cooldown_s: float = 5.0
+    autoscale_down_cooldown_s: float = 15.0
+    # Warm standby pool: True pre-builds and pre-warms the replicas
+    # between min and max at tier start (riding replica 0's compile
+    # cache, off-membership), so a scale-up PUBLISHES a fully-warm
+    # standby in milliseconds instead of paying an engine build + warm
+    # trace mid-peak — exactly when capacity is short — and scale-down
+    # PARKS the drained replica (after its spill handoff) for the next
+    # peak.  The trade is memory: parked engines hold params + pools
+    # while off-membership.  False = build-at-actuation (the engine is
+    # constructed and warmed inside scale_to, and destroyed on
+    # scale-down).  Only consulted when ``autoscale`` arms the tier.
+    autoscale_warm_pool: bool = True
 
     def model(self) -> ModelConfig:
         return MODEL_PRESETS[self.model_preset]

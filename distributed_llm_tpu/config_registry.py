@@ -178,6 +178,11 @@ ENV_VARS: Dict[str, EnvVar] = {v.name: v for v in (
        "TierConfig.replica_affinity decides (affinity when True, else "
        "least-loaded).  'random' exists for the bench's dilution "
        "comparison, not production."),
+    _e("DLLM_AUTOSCALE", "1", "serving/router.py",
+       "Elastic-capacity kill switch: '0' disarms every tier's "
+       "ReplicaAutoscaler (no controller threads, membership stays the "
+       "static PR 12 path, pinned byte-identical); any other value "
+       "lets TierConfig.autoscale decide per tier."),
 )}
 
 
@@ -343,6 +348,46 @@ CONFIG_FIELDS: Dict[str, str] = {
                                 "1/refcount, and speculative γ caps; "
                                 "None = quotas OFF (byte-identical "
                                 "pre-tenant behavior).",
+    "TierConfig.autoscale": "Arms the per-tier SLO-driven replica "
+                            "autoscaler (serving/autoscaler.py); False "
+                            "= static membership, byte-identical to the "
+                            "replicated-tier path (pinned).  "
+                            "DLLM_AUTOSCALE=0 disarms globally.",
+    "TierConfig.autoscale_min_replicas": "Membership floor the "
+                                         "autoscaler never scales "
+                                         "below (also the initial size "
+                                         "when larger than replicas).",
+    "TierConfig.autoscale_max_replicas": "Membership ceiling the "
+                                         "autoscaler never scales "
+                                         "above.",
+    "TierConfig.autoscale_interval_s": "Controller cadence: one signal "
+                                       "read + decision per interval.",
+    "TierConfig.autoscale_goodput_floor": "Scale-up trigger: windowed "
+                                          "SLO goodput sustained below "
+                                          "this fraction breaches.",
+    "TierConfig.autoscale_queue_high": "Scale-up trigger: queue depth "
+                                       "sustained above this many "
+                                       "requests per live replica "
+                                       "breaches.",
+    "TierConfig.autoscale_breach_window_s": "How long a breach must "
+                                            "persist before scale-up "
+                                            "fires (hysteresis).",
+    "TierConfig.autoscale_idle_window_s": "How long the tier must be "
+                                          "fully idle before "
+                                          "scale-down fires.",
+    "TierConfig.autoscale_up_cooldown_s": "Minimum seconds after any "
+                                          "membership event before the "
+                                          "next scale-up.",
+    "TierConfig.autoscale_down_cooldown_s": "Minimum seconds after any "
+                                            "membership event before "
+                                            "the next scale-down.",
+    "TierConfig.autoscale_warm_pool": "True pre-warms min..max standby "
+                                      "replicas at tier start and "
+                                      "parks drained replicas, so "
+                                      "scale-up publishes a warm "
+                                      "standby in milliseconds; False "
+                                      "builds/destroys engines at "
+                                      "actuation time.",
     # -- ClusterConfig -----------------------------------------------------
     "ClusterConfig.nano": "The weak/cheap tier's TierConfig.",
     "ClusterConfig.orin": "The strong/costly tier's TierConfig.",

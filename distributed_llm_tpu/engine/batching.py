@@ -3147,6 +3147,22 @@ class ContinuousBatchingEngine:
                 ids, max_len=self.cfg.max_seq_len - self._reuse_buckets[0]))
         return best
 
+    def demote_parked(self) -> int:
+        """Evict every unpinned parked prefix entry NOW, each routed
+        through the normal eviction sink (``_prefix_evicted`` →
+        ``_try_demote``) — the scale-down retirement sweep
+        (serving/replicas.py): with a spill tier attached, the retiring
+        replica's refcount-1 prefixes land in host RAM for the caller to
+        hand to a survivor; without one this is just an eviction sweep.
+        Must run BEFORE ``stop()``/``drain`` flips ``_stop`` (after
+        which ``_try_demote`` stands down).  Returns entries evicted."""
+        if self.prefix_cache is None:
+            return 0
+        n = 0
+        while self.prefix_cache.pop_oldest() is not None:
+            n += 1
+        return n
+
     def warmup(self, beat=None) -> None:
         """Compile the decode tick + smallest cold-prefill bucket (via one
         real request), then the chunk-prefill programs for the two smallest

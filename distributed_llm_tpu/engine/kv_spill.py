@@ -176,6 +176,65 @@ class HostKVSpill:
         self._ensure_copier()
         return True
 
+    # -- scale-down handoff (serving/replicas.py scale_to) ------------------
+
+    def export_resident(self) -> List[Tuple[Tuple[int, ...],
+                                            Dict[str, np.ndarray], int, int]]:
+        """Snapshot of RESIDENT, unpinned entries as ``(ids, tiles,
+        nbytes, nb)`` tuples — the read side of the scale-down handoff:
+        a retiring replica's landed spill entries move WHOLE into a
+        survivor's store via ``admit_resident``.  Tiles are host arrays
+        already in pool layout, identical across same-config replicas,
+        so adoption is a reference move, not a copy.  Pinned or
+        still-COPYING entries stay behind (the caller flushes first, so
+        COPYING here means the copy failed or never ran)."""
+        with self._lock:
+            return [(e.ids, e.tiles, e.nbytes, e.nb)
+                    for e in self._entries
+                    if e.state is RESIDENT and e.pins == 0
+                    and e.tiles is not None]
+
+    def admit_resident(self, ids: Sequence[int],
+                       tiles: Dict[str, np.ndarray], nbytes: int,
+                       nb: int) -> bool:
+        """Register an ALREADY-host-resident entry (the write side of
+        the scale-down handoff): same extend-replacement and
+        LRU-evict-to-fit rules as ``offer``, but no copier job — the
+        entry is promotable the moment this returns.  False = no room
+        (budget smaller than the entry, or everything pinned)."""
+        nbytes = int(nbytes)
+        if (self._stopping.is_set() or tiles is None
+                or nbytes > self.budget_bytes):
+            return False
+        entry = HostEntry(tuple(ids), int(nb), nbytes)
+        with self._lock:
+            ids_t = entry.ids
+            for e in list(self._entries):
+                if (e.pins == 0 and e.state is not DEAD
+                        and ids_t[:len(e.ids)] == e.ids):
+                    e.state = DEAD
+                    e.tiles = None
+                    self._entries.remove(e)
+                    self._bytes -= e.nbytes
+            while self._bytes + nbytes > self.budget_bytes:
+                victim_ix = next(
+                    (i for i, e in enumerate(self._entries)
+                     if e.pins == 0), None)
+                if victim_ix is None:
+                    return False
+                victim = self._entries.pop(victim_ix)
+                victim.state = DEAD
+                victim.tiles = None
+                self._bytes -= victim.nbytes
+                self.evictions_total += 1
+            entry.tiles = dict(tiles)
+            entry.state = RESIDENT
+            self._bytes += nbytes
+            self._entries.append(entry)
+            self.demotions_total += 1
+        self._mirror_counter("kv_demotions")
+        return True
+
     # -- copier worker (the one sanctioned device→host crossing) -----------
 
     def _ensure_copier(self) -> None:

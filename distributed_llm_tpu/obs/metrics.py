@@ -554,6 +554,19 @@ class ServingMetrics:
             "Replicas of the tier currently serving (running, not "
             "wedged, breaker not open) out of TierConfig.replicas "
             "(sampled)", ("tier",))
+        # Elastic-capacity family (ISSUE 18, serving/autoscaler.py):
+        # live membership and the autoscaler's actuation decisions.
+        self.replica_count_g = registry.gauge(
+            "dllm_replica_count",
+            "Live replica membership of the tier — static it equals "
+            "TierConfig.replicas; under the autoscaler it moves between "
+            "autoscale_min_replicas and autoscale_max_replicas "
+            "(sampled)", ("tier",))
+        self.autoscale_events = registry.counter(
+            "dllm_autoscale_events_total",
+            "Autoscaler membership transitions, by direction (up|down) "
+            "and the signal that fired them (goodput_floor|queue_growth"
+            "|shed|idle|manual)", ("tier", "direction", "reason"))
         # Per-tenant isolation family (ISSUE 17, serving/tenants.py):
         # the measured bill and enforcement decisions per tenant.  Every
         # ``tenant`` label value MUST pass through a BoundedLabels set

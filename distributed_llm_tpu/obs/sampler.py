@@ -66,6 +66,7 @@ _GAUGE_FIELDS = (
     ("decode_tick_p50_ms", "decode_tick_p50_g"),
     ("profile_coverage", "profile_coverage_g"),
     ("replica_healthy", "replica_healthy_g"),
+    ("replica_count", "replica_count_g"),
 )
 
 

@@ -113,10 +113,19 @@ def _fit_replicas(tier: TierConfig, available: int, tp: int) -> int:
     ``tier.replicas`` disjoint tp-sized slices, shrinking gracefully to
     what the box has left — replicas beyond the available slices share
     devices process-locally (serving/replicas.py _split_devices), so a
-    short box degrades placement, never the replica count."""
-    if tier.replicas <= 1:
+    short box degrades placement, never the replica count.  An
+    autoscale-armed tier (ISSUE 18) claims slices for its MAX width:
+    a replica the autoscaler adds later must land on its own devices,
+    and the carve happens once at build time — devices reserved for
+    elastic headroom sit idle at min width, which is exactly the
+    capacity the autoscaler is trusted to spend."""
+    want = tier.replicas
+    if getattr(tier, "autoscale", False):
+        want = max(want, int(getattr(tier, "autoscale_max_replicas",
+                                     want)))
+    if want <= 1:
         return 1
-    return max(1, min(tier.replicas, available // max(1, tp)))
+    return max(1, min(want, available // max(1, tp)))
 
 
 def _fit_ep(tier: TierConfig, available: int, tp: int) -> int:

@@ -436,6 +436,16 @@ def create_app(router: Optional[Router] = None,
             "slo": (router_.slo.snapshot()
                     if getattr(router_, "slo", None) is not None
                     else None),
+            # Elastic capacity (ISSUE 18, serving/autoscaler.py): live
+            # membership, streak/cooldown state, and the bounded
+            # decision ledger per armed tier — why capacity moved, next
+            # to the goodput/breaker evidence that moved it.  None when
+            # no tier arms the autoscaler (or DLLM_AUTOSCALE=0).
+            "autoscaler": (router_.autoscaler_snapshot()
+                           if callable(getattr(router_,
+                                               "autoscaler_snapshot",
+                                               None))
+                           else None),
             # Per-(tier, strategy, session) attributed cost (ISSUE 11):
             # decode device time + KV block-ticks from the bounded
             # ledger _finish_request feeds — who pays for the ticks,

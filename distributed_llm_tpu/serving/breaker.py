@@ -200,6 +200,35 @@ class CircuitBreaker:
             self._consecutive[tier] = 0
             self._probe_inflight[tier] = False
 
+    # -- dynamic membership (serving/replicas.py scale_to) ------------------
+
+    def ensure(self, tier: str) -> None:
+        """Mint state for a key added AFTER construction — dynamic
+        replica membership (ISSUE 18): a replica that goes live mid-run
+        needs its own sub-gate, and without a key here ``allow`` would
+        wave it through unconditionally while ``record`` dropped its
+        verdicts.  New keys start CLOSED; idempotent, never resets an
+        existing key's state."""
+        with self._lock:
+            if tier in self._state:
+                return
+            self._state[tier] = CLOSED
+            self._consecutive[tier] = 0
+            self._probe_inflight[tier] = False
+            self.opened_total.setdefault(tier, 0)
+
+    def forget(self, tier: str) -> None:
+        """Drop a retired key's live state (scale-down removed the
+        replica; replica ids are never reused, so without this every
+        scale cycle would leak a dict entry).  ``opened_total`` keeps
+        its count — it is history, not live state."""
+        with self._lock:
+            self._state.pop(tier, None)
+            self._consecutive.pop(tier, None)
+            self._probe_inflight.pop(tier, None)
+            self._opened_at.pop(tier, None)
+            self._probe_started.pop(tier, None)
+
     # -- observability ------------------------------------------------------
 
     def state(self, tier: str) -> str:

@@ -155,7 +155,7 @@ class HealthMonitor:
             self._last[key] = entry
         return entry, restart
 
-    def _probe_replicated(self, name: str, tier, managers,
+    def _probe_replicated(self, name: str, tier, items,
                           breaker, to_restart) -> Dict[str, Any]:
         """Probe a replicated tier's replicas INDIVIDUALLY: each replica
         keeps its own failure streak and restart target, so one wedged
@@ -163,21 +163,37 @@ class HealthMonitor:
         tier-level entry aggregates (ok while any replica runs).  A
         successful replica restart force-closes only THAT replica's
         breaker sub-gate (ReplicatedTierClient.reset_replica); the
-        tier-level breaker recovers through its own canary."""
+        tier-level breaker recovers through its own canary.
+
+        ``items`` is a (rid, manager) snapshot: rids are the STABLE
+        replica ids (never reused under dynamic membership), so probe
+        keys, failure streaks, and restart targets keep meaning the
+        same engine across scale events."""
         reps: Dict[str, Dict[str, Any]] = {}
         states: List[str] = []
-        for i, sub in enumerate(managers):
-            rkey = f"{name}/r{i}"
+        for rid, sub in items:
+            rkey = f"{name}/r{rid}"
             state, health = self._probe_tier(rkey, sub)
             entry, restart = self._account_probe(rkey, state, health)
             if restart:
-                def _on_restarted(tc=tier, idx=i):
+                def _on_restarted(tc=tier, rid=rid):
                     fn = getattr(tc, "reset_replica", None)
                     if callable(fn):
-                        fn(idx)
+                        fn(rid)
                 to_restart.append((rkey, sub, _on_restarted))
             reps[rkey] = entry
             states.append(state)
+        # Retired replicas (scale-down) leave the per-key bookkeeping:
+        # their streak/restart state must not resurrect if the rid's
+        # slot pattern ever matched a later snapshot key, and /health
+        # must not keep showing a replica membership dropped.
+        with self._lock:
+            prefix = f"{name}/r"
+            for key in [k for k in self._last
+                        if k.startswith(prefix) and k not in reps]:
+                self._last.pop(key, None)
+                self._fail_counts.pop(key, None)
+                self._seen_running.pop(key, None)
         running = sum(1 for s in states if s == "running")
         if running:
             tier_state = "running"
@@ -191,8 +207,8 @@ class HealthMonitor:
             "ok": running > 0,
             "state": tier_state,
             "healthy_replicas": running,
-            "replica_count": len(managers),
-            "degraded": 0 < running < len(managers),
+            "replica_count": len(items),
+            "degraded": 0 < running < len(items),
             "replicas": reps,
         }
         with self._lock:
@@ -216,10 +232,18 @@ class HealthMonitor:
         breaker = getattr(self.router, "breaker", None)
         for name, tier in self.router.tiers.items():
             mgr = tier.server_manager
+            items_fn = getattr(mgr, "replica_items", None)
             subs = getattr(mgr, "replica_managers", None)
-            if callable(subs):
+            if callable(items_fn):
                 snapshot[name] = self._probe_replicated(
-                    name, tier, subs(), breaker, to_restart)
+                    name, tier, items_fn(), breaker, to_restart)
+                continue
+            if callable(subs):
+                # Duck-typed replica sets without stable ids (tests):
+                # positional fallback, the pre-dynamic behavior.
+                snapshot[name] = self._probe_replicated(
+                    name, tier, list(enumerate(subs())), breaker,
+                    to_restart)
                 continue
             state, health = self._probe_tier(name, mgr)
             entry, restart = self._account_probe(name, state, health)

@@ -34,7 +34,7 @@ Dispatch picks a replica by a two-level policy:
    locality must not starve the other replicas behind one hot queue.
 
 Each replica has its own breaker sub-gate (serving/breaker.py, keyed
-``r0..rN-1``, thresholds from the cluster's breaker config): dispatch
+``r<rid>``, thresholds from the cluster's breaker config): dispatch
 skips open replicas, stream/sync verdicts feed back per replica, and
 admission rejections stay breaker-neutral (healthy backpressure — the
 PR 2 rule).  Tier-level ``health()`` / ``kv_stats()`` / ``slot_stats()``
@@ -43,8 +43,29 @@ HealthMonitor probes/restarts replicas INDIVIDUALLY — one wedged
 replica degrades capacity (``healthy_replicas``/``replica_count``)
 instead of the tier.
 
-``replicas = 1`` never builds any of this: build_tiers keeps the plain
-TierClient/EngineManager path, byte-identical to pre-replica behavior.
+**Dynamic membership (ISSUE 18).**  Membership is a LIST OF MEMBER
+RECORDS shared between the client and its ReplicaSetManager, each
+record carrying a monotonic replica id (``rid``) minted at build time
+and NEVER reused — engine-side tier names (``nano/r2``), per-replica
+metric labels, and breaker keys are baked at construction, so removal
+must not shift surviving replicas' identities the way positional
+indices would.  ``scale_to(n)`` is the actuation verb (the autoscaler's
+— serving/autoscaler.py — and the operator's): scale-up builds each new
+replica OFF-membership, warms it fully against the process XLA compile
+cache replica 0 populated (new replicas compile nothing beyond their
+own per-engine one-decode-program), and only then publishes it —
+deferred go-live, dispatch never sees a cold replica; scale-down picks
+the least-affine replica, removes it from membership FIRST (no new
+dispatch), waits out its in-flight work, DEMOTES its refcount-1 parked
+prefixes through the PR 13 host spill tier and hands the resident
+entries to a survivor's store (scale-down costs warm TTFT, never
+correctness), then drains and stops it.  All dispatch/probe/aggregate
+paths iterate SNAPSHOTS (``list(members)`` — atomic under the GIL) so
+they tolerate membership changes mid-flight.
+
+``replicas = 1`` without ``autoscale`` never builds any of this:
+build_tiers keeps the plain TierClient/EngineManager path,
+byte-identical to pre-replica behavior.
 """
 
 from __future__ import annotations
@@ -95,8 +116,26 @@ def _split_devices(devices: List, n: int, tp: int) -> List[List]:
     return [list(devices) for _ in range(n)]
 
 
+class _Replica:
+    """One live member: the stable replica id (metric/breaker identity,
+    minted monotonically, never reused), the request client, and the
+    engine manager.  Records are immutable once published — membership
+    changes replace/append records, never mutate them."""
+
+    __slots__ = ("rid", "client", "mgr")
+
+    def __init__(self, rid: int, client: TierClient, mgr: EngineManager):
+        self.rid = rid
+        self.client = client
+        self.mgr = mgr
+
+    @property
+    def name(self) -> str:
+        return replica_name(self.rid)
+
+
 class ReplicaSetManager:
-    """The EngineManager-shaped facade over a tier's N replica managers.
+    """The EngineManager-shaped facade over a tier's replica managers.
 
     Everything that used to talk to ``tier.server_manager`` — the bench
     harness's start/stop between configs, Router.drain, GET /health —
@@ -104,43 +143,110 @@ class ReplicaSetManager:
     reads aggregate, and ``health()``/``kv_stats()``/``slot_stats()``
     return tier-level aggregates carrying a per-replica breakdown.
     Probe-surface methods stay lock-free exactly like EngineManager's
-    (each sub-manager's health/is_server_running already are)."""
+    (each sub-manager's health/is_server_running already are), and all
+    of them iterate a SNAPSHOT of the member list so dynamic membership
+    (scale_to) can change it mid-flight."""
 
-    def __init__(self, tier: TierConfig, managers: Sequence[EngineManager]):
+    def __init__(self, tier: TierConfig,
+                 managers: Optional[Sequence[EngineManager]] = None,
+                 members: Optional[List[_Replica]] = None,
+                 standby: Optional[List[_Replica]] = None):
         self.tier = tier
-        self.managers = list(managers)
+        if members is not None:
+            # The SAME list object the ReplicatedTierClient mutates —
+            # membership has one source of truth, not two views that
+            # could drift.
+            self._members = members
+        else:
+            self._members = [_Replica(i, None, m)
+                             for i, m in enumerate(managers or [])]
+        # Warm standby pool, shared by reference with the client's
+        # scale_to (same one-source-of-truth rule): start_server warms
+        # these alongside the sibling members, stop_server stops them.
+        # NOT part of the serving surface — health/kv/slot aggregates
+        # and drain cover MEMBERS only (a parked engine serves nothing).
+        self._standby = standby if standby is not None else []
 
     # -- replica access -----------------------------------------------------
+
+    @property
+    def managers(self) -> List[EngineManager]:
+        """Snapshot of the per-replica EngineManagers (historic
+        attribute surface, now derived from the member records)."""
+        return [r.mgr for r in list(self._members)]
 
     def replica_managers(self) -> List[EngineManager]:
         """The per-replica EngineManagers — the HealthMonitor's probe and
         restart targets (one wedged replica restarts alone)."""
-        return list(self.managers)
+        return self.managers
+
+    def replica_items(self) -> List[Tuple[int, EngineManager]]:
+        """(rid, manager) snapshot — the membership-stable iteration for
+        probe keys and metric labels: rids never shift on removal, so
+        ``nano/r1`` keeps meaning the same engine across scale events."""
+        return [(r.rid, r.mgr) for r in list(self._members)]
 
     def live_engines(self) -> List[Tuple[str, Any]]:
         """(replica key, engine) for every RUNNING replica — the obs
         surfaces' iteration point (profiler trace, sampler, /stats).
         Never lazy-starts an engine."""
         out = []
-        for i, mgr in enumerate(self.managers):
-            engine = getattr(mgr, "_engine", None)
+        for r in list(self._members):
+            engine = getattr(r.mgr, "_engine", None)
             if engine is not None:
-                out.append((replica_name(i), engine))
+                out.append((r.name, engine))
         return out
 
     # -- lifecycle (ServerManager surface) ----------------------------------
 
     def start_server(self, beat=None) -> None:
-        """Start every replica (idempotent per replica).  Serial on
-        purpose: replica 0's warmup populates the XLA compile cache the
-        siblings then hit warm, and concurrent cold compiles of the same
-        programs would just contend."""
-        for mgr in self.managers:
-            mgr.start_server(beat=beat)
+        """Start every replica (idempotent per replica).  Replica 0
+        warms FIRST and alone — its warmup populates the in-process XLA
+        compile cache — then the siblings AND the warm-standby pool
+        warm CONCURRENTLY against that warm cache (the same
+        deferred-go-live warm path scale-up rides): concurrent COLD
+        compiles of the same programs would just contend, but cache-hit
+        warmups only pay tracing.  Standbys warm here, at startup,
+        precisely so scale-up never traces mid-peak."""
+        members = list(self._members)
+        if not members:
+            return
+        members[0].mgr.start_server(beat=beat)
+        rest = members[1:] + list(self._standby)
+        if not rest:
+            return
+        # Every key pre-populated BEFORE the workers start (value
+        # overwrites only — safe under the GIL, never a size-changing
+        # insert racing the error scan below).
+        errors: Dict[int, Optional[BaseException]] = {
+            r.rid: None for r in rest}
+        threads = []
+        for r in rest:
+            def _start(r=r):
+                try:
+                    r.mgr.start_server()
+                except BaseException as exc:
+                    errors[r.rid] = exc
+            t = threading.Thread(target=_start, daemon=True,
+                                 name=f"warm-{self.tier.name}-{r.name}")
+            threads.append(t)
+            t.start()
+        # ``beat`` fires from the JOINING loop, not the workers — the
+        # bench watchdog's beat callback is not promised thread-safe.
+        for t in threads:
+            while t.is_alive():
+                t.join(timeout=0.5)
+                if beat is not None:
+                    beat()
+        for r in rest:
+            if errors[r.rid] is not None:
+                raise errors[r.rid]
 
     def stop_server(self) -> None:
         for mgr in self.managers:
             mgr.stop_server()
+        for rec in list(self._standby):
+            rec.mgr.stop_server()
 
     def drain(self, timeout_s: Optional[float] = None) -> Dict[str, Any]:
         """Drain every replica CONCURRENTLY and wait them all out — the
@@ -152,23 +258,24 @@ class ReplicaSetManager:
         timeout = (timeout_s if timeout_s is not None
                    else self.tier.drain_timeout_s)
         t0 = time.monotonic()
+        members = list(self._members)
         # Every key pre-populated BEFORE the workers start: a worker
         # abandoned past the join bound may still finish later, and its
         # write must be a value OVERWRITE (safe under the GIL), never a
         # size-changing insert racing the summary's iteration below.
         results: Dict[str, Any] = {
-            replica_name(i): {"error": "Request failed: replica drain "
-                              "did not return within the join bound"}
-            for i in range(len(self.managers))}
+            r.name: {"error": "Request failed: replica drain "
+                     "did not return within the join bound"}
+            for r in members}
         threads = []
-        for i, mgr in enumerate(self.managers):
-            def _drain(key=replica_name(i), mgr=mgr):
+        for r in members:
+            def _drain(key=r.name, mgr=r.mgr):
                 try:
                     results[key] = mgr.drain(timeout_s=timeout)
                 except Exception as exc:   # a dead replica must not
                     results[key] = {"error": f"Request failed: {exc}"}
             t = threading.Thread(target=_drain, daemon=True,
-                                 name=f"drain-{self.tier.name}-r{i}")
+                                 name=f"drain-{self.tier.name}-{r.name}")
             threads.append(t)
             t.start()
         deadline = time.monotonic() + max(0.0, float(timeout)) + 30.0
@@ -194,17 +301,17 @@ class ReplicaSetManager:
     def draining(self) -> bool:
         """The TIER is draining only when every replica is: a partially
         drained tier still serves traffic on the survivors."""
-        return bool(self.managers) and all(m.draining
-                                           for m in self.managers)
+        members = list(self._members)
+        return bool(members) and all(r.mgr.draining for r in members)
 
     def is_server_running(self) -> bool:
         return any(m.is_server_running() for m in self.managers)
 
     def engine(self):
         """Single-engine compatibility accessor (bench legs and tests
-        that introspect ``server_manager.engine()``): replica 0's
-        engine, lazy-started like EngineManager.engine()."""
-        return self.managers[0].engine()
+        that introspect ``server_manager.engine()``): the first live
+        member's engine, lazy-started like EngineManager.engine()."""
+        return list(self._members)[0].mgr.engine()
 
     # -- aggregate observability --------------------------------------------
 
@@ -213,13 +320,14 @@ class ReplicaSetManager:
         ``ok`` while ANY replica serves (one wedged replica is degraded
         capacity, not a dead tier), ``wedged`` only when every replica
         is, capacity counters, and the full per-replica breakdown."""
+        members = list(self._members)
         reps: Dict[str, Dict[str, Any]] = {}
-        for i, mgr in enumerate(self.managers):
+        for r in members:
             try:
-                reps[replica_name(i)] = mgr.health()
+                reps[r.name] = r.mgr.health()
             except Exception as exc:
-                reps[replica_name(i)] = {"ok": False,  # dllm-lint: disable=error-shape -- health-probe snapshot (GET /health surface), not the tier error path
-                                         "error": str(exc)[:200]}
+                reps[r.name] = {"ok": False,  # dllm-lint: disable=error-shape -- health-probe snapshot (GET /health surface), not the tier error path
+                                "error": str(exc)[:200]}
         healthy = sum(1 for h in reps.values() if h.get("ok"))
         running = sum(1 for h in reps.values() if h.get("uptime_s"))
         entry: Dict[str, Any] = {
@@ -230,9 +338,9 @@ class ReplicaSetManager:
             "uptime_s": max((h.get("uptime_s") or 0.0)
                             for h in reps.values()) if reps else 0.0,
             "devices": None,
-            "replica_count": len(self.managers),
+            "replica_count": len(members),
             "healthy_replicas": healthy,
-            "degraded": 0 < healthy < len(self.managers),
+            "degraded": 0 < healthy < len(members),
             "queue_depth": sum(int(h.get("queue_depth") or 0)
                                for h in reps.values()),
             "active_slots": sum(int(h.get("active_slots") or 0)
@@ -388,7 +496,8 @@ class ReplicatedTierClient:
     TierClient (``process`` / ``process_stream`` / ``load_snapshot`` /
     ``server_manager`` / ``tier`` / ``name``), with dispatch choosing a
     replica per request (module docstring: affinity → least-loaded, with
-    the per-replica breaker veto)."""
+    the per-replica breaker veto) and membership actuatable at runtime
+    (``scale_to`` — the autoscaler's verb)."""
 
     def __init__(
         self,
@@ -417,48 +526,59 @@ class ReplicatedTierClient:
         self.name = tier.name
         self.faults = fault_injector
         n = tier.replicas
-        devs = (list(mesh.devices.flat) if mesh is not None
-                else list(devices or []))
+        if getattr(tier, "autoscale", False):
+            # Elastic tiers start at the autoscaler's capacity floor
+            # (min may exceed the static replicas field, which is then
+            # just the pre-elastic default).
+            n = max(n, int(getattr(tier, "autoscale_min_replicas", 1)))
+        self._devices = (list(mesh.devices.flat) if mesh is not None
+                         else list(devices or []))
         from ..parallel.mesh import requested_tp
-        tp_req = requested_tp(tier)       # honors the DLLM_TP override
-        groups = _split_devices(devs, n, tp_req)
-        self.clients: List[TierClient] = []
-        managers: List[EngineManager] = []
+        self._tp_req = requested_tp(tier)  # honors the DLLM_TP override
+        self._seed = seed
+        self._warmup_on_start = warmup_on_start
+        groups = _split_devices(self._devices, n, self._tp_req)
+        # Membership: ONE list of member records, shared by reference
+        # with the ReplicaSetManager below.  Mutations are atomic list
+        # ops under _scale_lock; every reader takes list() snapshots.
+        self._members: List[_Replica] = []
+        self._next_rid = 0
+        # Scale serialization: the lock guards only the BUSY FLAG, never
+        # the minutes-long warm/quiesce work itself — a scale operation
+        # blocks on compiles and drains, and holding a lock across that
+        # would stall any operator/autoscaler caller (and trips the
+        # lock-blocking-call lint).  Membership mutations stay atomic
+        # list ops; readers take list() snapshots.
+        self._scale_lock = threading.Lock()
+        self._scaling = False
         for i in range(n):
-            # Replica-suffixed tier identity for the ENGINE side: logs,
-            # per-replica metric labels (dllm_decode_tick_ms{tier=
-            # "nano/r0"}, the per-replica compiled-programs gauge the
-            # bench leg pins), profiler timelines.  The CLIENT keeps the
-            # base name: error shapes, fault targeting, and trace spans
-            # must stay byte-identical to the single-replica tier.
-            rtier = dataclasses.replace(
-                tier, name=f"{tier.name}/{replica_name(i)}")
-            group = groups[i] if i < len(groups) else devs
-            if len(group) > 1:
-                from ..parallel.mesh import tp_mesh
-                # Multi-device group = this replica's own TP submesh,
-                # at the TIER's tp degree (a short box sharing devices
-                # must not inflate tp past the config).
-                mgr = EngineManager(
-                    rtier,
-                    mesh=tp_mesh(group, min(max(1, tp_req), len(group))),
-                    seed=seed, warmup_on_start=warmup_on_start)
-            else:
-                mgr = EngineManager(rtier,
-                                    devices=(group or None), seed=seed,
-                                    warmup_on_start=warmup_on_start)
-            client = TierClient(rtier, mgr, fault_injector)
-            client.name = tier.name       # base-name error/fault identity
-            managers.append(mgr)
-            self.clients.append(client)
-        self.server_manager = ReplicaSetManager(tier, managers)
+            group = groups[i] if i < len(groups) else self._devices
+            self._members.append(self._build_replica(self._mint_rid(),
+                                                     group))
+        # Warm standby pool (autoscale tiers): the replicas between min
+        # and max are BUILT here and WARMED by start_server, parked
+        # off-membership.  scale_to(up) then publishes a warm standby in
+        # milliseconds instead of tracing an engine mid-peak, and
+        # scale_to(down) parks the drained replica for the next peak.
+        # The pool shares by reference with the ReplicaSetManager below
+        # (one source of truth, like the member list).
+        self._standby: List[_Replica] = []
+        if getattr(tier, "autoscale", False) and \
+                getattr(tier, "autoscale_warm_pool", False):
+            n_max = max(n, int(getattr(tier, "autoscale_max_replicas", n)))
+            for k in range(n, n_max):
+                self._standby.append(self._build_replica(
+                    self._mint_rid(), self._device_group(k, n_max)))
+        self.server_manager = ReplicaSetManager(tier,
+                                                members=self._members,
+                                                standby=self._standby)
         # Per-replica breaker sub-gate: same thresholds as the cluster's
         # tier-level breaker; breaker_failures=0 disables both.  The
         # tier-level breaker (Router) still owns whole-tier shedding —
         # this one only steers dispatch AWAY from a failing replica
         # while the survivors keep the tier closed.
         self.breaker = CircuitBreaker(
-            [replica_name(i) for i in range(n)],
+            [r.name for r in self._members],
             failure_threshold=getattr(cluster, "breaker_failures", 0),
             cooldown_s=getattr(cluster, "breaker_cooldown_s", 30.0))
         self._rr_lock = threading.Lock()
@@ -469,6 +589,301 @@ class ReplicatedTierClient:
         # a fresh registry after construction (same pattern as the
         # manager's global fallbacks).
         self.obs = None
+
+    # -- membership ----------------------------------------------------------
+
+    @property
+    def clients(self) -> List[TierClient]:
+        """Snapshot of the live replica clients (historic attribute
+        surface, now derived from the member records)."""
+        return [r.client for r in list(self._members)]
+
+    def replica_count(self) -> int:
+        return len(self._members)
+
+    def _mint_rid(self) -> int:
+        rid = self._next_rid
+        self._next_rid += 1
+        return rid
+
+    def _build_replica(self, rid: int, group: List) -> _Replica:
+        """Construct one replica's EngineManager + TierClient (NOT yet
+        published to membership, NOT yet started)."""
+        # Replica-suffixed tier identity for the ENGINE side: logs,
+        # per-replica metric labels (dllm_decode_tick_ms{tier=
+        # "nano/r0"}, the per-replica compiled-programs gauge the
+        # bench leg pins), profiler timelines.  The CLIENT keeps the
+        # base name: error shapes, fault targeting, and trace spans
+        # must stay byte-identical to the single-replica tier.
+        rtier = dataclasses.replace(
+            self.tier, name=f"{self.tier.name}/{replica_name(rid)}")
+        if len(group) > 1:
+            from ..parallel.mesh import tp_mesh
+            # Multi-device group = this replica's own TP submesh,
+            # at the TIER's tp degree (a short box sharing devices
+            # must not inflate tp past the config).
+            mgr = EngineManager(
+                rtier,
+                mesh=tp_mesh(group,
+                             min(max(1, self._tp_req), len(group))),
+                seed=self._seed, warmup_on_start=self._warmup_on_start)
+        else:
+            mgr = EngineManager(rtier, devices=(group or None),
+                                seed=self._seed,
+                                warmup_on_start=self._warmup_on_start)
+        client = TierClient(rtier, mgr, self.faults)
+        client.name = self.tier.name  # base-name error/fault identity
+        return _Replica(rid, client, mgr)
+
+    def scale_to(self, n: int, reason: str = "manual",
+                 timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """Actuate membership to ``n`` replicas (bounded below at 1).
+        One scale operation at a time — a busy flag claimed under
+        ``_scale_lock``; an overlapping call returns immediately with a
+        ``busy`` error rather than queueing behind minutes of warmup
+        (the autoscaler treats a refused actuation as retryable).
+        Dispatch is NEVER blocked, because membership reads are
+        lock-free snapshots and the blocking warm/quiesce work runs
+        with no lock held.
+
+        Scale-UP builds the new replicas off-membership and warms them
+        CONCURRENTLY and fully (start_server → engine warmup, riding
+        the process XLA compile cache an existing replica populated)
+        before publishing: deferred go-live — dispatch never sees a
+        replica that would block on a cold compile or pay first-touch
+        traces mid-peak (a half-warm replica trades cheap actuation
+        for a trace storm exactly when the tier is saturated).
+
+        Scale-DOWN retires the least-affine replica: membership removal
+        first (no new dispatch), bounded quiesce of in-flight work,
+        demote of its refcount-1 parked prefixes through the host spill
+        tier with the resident entries HANDED OFF to a survivor's store
+        (the shrink costs warm TTFT only where no spill tier exists,
+        never correctness), then PR 5 drain-and-stop."""
+        n = max(1, int(n))
+        summary: Dict[str, Any] = {"target": n, "reason": reason,
+                                   "added": [], "removed": [],
+                                   "errors": []}
+        with self._scale_lock:
+            if self._scaling:
+                summary["errors"].append("busy: scale in progress")
+                summary["replicas"] = len(self._members)
+                return summary
+            self._scaling = True
+        try:
+            cur = len(self._members)
+            if cur < n:
+                self._scale_up(n, summary)
+            elif cur > n:
+                while len(self._members) > n:
+                    info = self._scale_down_one(timeout_s)
+                    if info is None:
+                        break
+                    summary["removed"].append(info)
+        finally:
+            with self._scale_lock:
+                self._scaling = False
+        summary["replicas"] = len(self._members)
+        return summary
+
+    def _scale_up(self, n: int, summary: Dict[str, Any]) -> None:
+        """Add members up to ``n`` (busy flag claimed, no lock held):
+        publish warm standbys first (already built and warmed — go-live
+        is a breaker key + an atomic append, milliseconds), then build
+        and warm any remainder concurrently and publish the
+        survivors."""
+        while len(self._members) < n and self._standby:
+            r = self._standby.pop(0)
+            try:
+                r.mgr.start_server()     # idempotent; no-op when warm
+            except BaseException as exc:
+                summary["errors"].append(f"{r.name}: {exc}")
+                try:
+                    r.mgr.stop_server()
+                except Exception:
+                    pass
+                continue
+            self.breaker.ensure(r.name)
+            self._members.append(r)
+            summary["added"].append(r.name)
+            logger.info(
+                "tier %s: replica %s live (scale-up from warm "
+                "standby, %s)", self.name, r.name,
+                summary.get("reason"))
+        count = len(self._members)
+        fresh = []
+        for k in range(n - count):
+            group = self._device_group(count + k, n)
+            fresh.append(self._build_replica(self._mint_rid(), group))
+        errors: Dict[int, Optional[BaseException]] = {
+            r.rid: None for r in fresh}
+        threads = []
+        for r in fresh:
+            def _warm(r=r):
+                try:
+                    r.mgr.start_server()
+                except BaseException as exc:
+                    errors[r.rid] = exc
+            t = threading.Thread(target=_warm, daemon=True,
+                                 name=f"warm-{self.name}-{r.name}")
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join()
+        for r in fresh:
+            if errors[r.rid] is not None:
+                summary["errors"].append(
+                    f"{r.name}: {errors[r.rid]}")
+                try:
+                    r.mgr.stop_server()
+                except Exception:
+                    pass
+                continue
+            # Go-live: breaker key first (a keyless replica would be
+            # waved through ungated), then the atomic membership append.
+            self.breaker.ensure(r.name)
+            self._members.append(r)
+            summary["added"].append(r.name)
+            logger.info("tier %s: replica %s live (scale-up, %s)",
+                        self.name, r.name, summary.get("reason"))
+
+    def _device_group(self, slot: int, count: int) -> List:
+        """The device slice for a NEW replica taking position ``slot``
+        of ``count``: the same carve rule as construction, recomputed at
+        the new width.  Existing replicas keep the groups they were
+        built with — only the new slot's slice is consulted, and on the
+        shared-device (CPU / single-chip) box every slice is the whole
+        group anyway."""
+        groups = _split_devices(self._devices, count, self._tp_req)
+        return groups[slot] if slot < len(groups) else self._devices
+
+    def _pick_victim(self) -> Optional[_Replica]:
+        """The least-affine live replica: fewest parked prefix tokens
+        (its warm state is the cheapest to walk away from), ties broken
+        by least in-flight work, then youngest rid (the most recently
+        added capacity goes first)."""
+        members = list(self._members)
+        if len(members) <= 1:
+            return None
+
+        def score(rec: _Replica):
+            parked = 0
+            engine = getattr(rec.mgr, "_engine", None)
+            cache = getattr(engine, "prefix_cache", None)
+            if cache is not None:
+                try:
+                    parked = sum(len(e.ids)
+                                 for e in cache.entries_snapshot())
+                except Exception:
+                    parked = 0
+            try:
+                snap = rec.client.load_snapshot()
+                busy = (int(snap.get("queue_depth", 0))
+                        + int(snap.get("active_slots", 0)))
+            except Exception:
+                busy = 0
+            return (parked, busy, -rec.rid)
+
+        return min(members, key=score)
+
+    def _scale_down_one(
+            self, timeout_s: Optional[float]) -> Optional[Dict[str, Any]]:
+        """Retire one replica (busy flag claimed).  Ordering is the
+        correctness argument: (1) membership removal — no new dispatch;
+        (2) bounded quiesce — finishing requests PARK their prefixes;
+        (3) demote sweep + spill handoff — BEFORE drain flips the
+        engine's ``_stop``, after which ``_try_demote`` stands down;
+        (4) drain-and-stop; (5) breaker key retired."""
+        victim = self._pick_victim()
+        if victim is None:
+            return None
+        self._members.remove(victim)          # atomic: dispatch stops here
+        timeout = (timeout_s if timeout_s is not None
+                   else self.tier.drain_timeout_s)
+        deadline = time.monotonic() + max(0.5, float(timeout))
+        while time.monotonic() < deadline:
+            try:
+                snap = victim.client.load_snapshot()
+                if not snap.get("queue_depth") \
+                        and not snap.get("active_slots"):
+                    break
+            except Exception:
+                break
+            time.sleep(0.05)
+        demoted = handed = 0
+        engine = getattr(victim.mgr, "_engine", None)
+        if engine is not None:
+            sweep = getattr(engine, "demote_parked", None)
+            if callable(sweep):
+                try:
+                    demoted = int(sweep() or 0)
+                except Exception:
+                    demoted = 0
+            spill = getattr(engine, "kv_spill", None)
+            if spill is not None:
+                try:
+                    spill.flush(timeout_s=5.0)
+                except Exception:
+                    pass
+                target = self._spill_target(exclude=victim)
+                if target is not None:
+                    try:
+                        for ids, tiles, nbytes, nb in \
+                                spill.export_resident():
+                            if target.admit_resident(ids, tiles,
+                                                     nbytes, nb):
+                                handed += 1
+                    except Exception:
+                        logger.exception(
+                            "tier %s: spill handoff from %s failed",
+                            self.name, victim.name)
+        # Warm pool: a QUIESCED victim parks (engine kept warm,
+        # off-membership) instead of draining to destruction — the next
+        # scale-up republishes it in milliseconds.  A victim still busy
+        # at the deadline is NOT parked: parking an engine with live
+        # work would hide in-flight requests from every serving
+        # aggregate, so it falls through to the full drain-and-stop.
+        parked = False
+        if getattr(self.tier, "autoscale", False) and \
+                getattr(self.tier, "autoscale_warm_pool", False):
+            try:
+                snap = victim.client.load_snapshot()
+                parked = (not snap.get("queue_depth")
+                          and not snap.get("active_slots"))
+            except Exception:
+                parked = False
+        if parked:
+            drain = None
+            self._standby.append(victim)
+        else:
+            try:
+                drain = victim.mgr.drain(
+                    timeout_s=max(0.5, deadline - time.monotonic()))
+            except Exception as exc:
+                drain = {"error": f"Request failed: {exc}"}
+        self.breaker.forget(victim.name)
+        logger.info("tier %s: replica %s %s (scale-down; "
+                    "%d entries demoted, %d handed off)",
+                    self.name, victim.name,
+                    "parked to warm standby" if parked else "retired",
+                    demoted, handed)
+        return {"replica": victim.name, "demoted_entries": demoted,
+                "handed_off": handed, "parked": parked,
+                "drained": (drain or {}).get("drained", 0)
+                if isinstance(drain, dict) else 0}
+
+    def _spill_target(self, exclude: _Replica):
+        """A survivor's spill store for the retiring replica's resident
+        entries — the first live member with one (host tiles are in
+        pool layout, identical across same-config replicas)."""
+        for rec in list(self._members):
+            if rec is exclude:
+                continue
+            engine = getattr(rec.mgr, "_engine", None)
+            spill = getattr(engine, "kv_spill", None)
+            if spill is not None:
+                return spill
+        return None
 
     # -- dispatch policy ----------------------------------------------------
 
@@ -495,10 +910,11 @@ class ReplicatedTierClient:
         the first live engine, peek every live replica's cache with the
         same ids (stopped replicas score 0 — the probe never starts an
         engine)."""
-        scores = [0] * len(self.clients)
+        members = list(self._members)
+        scores = [0] * len(members)
         ids = None
-        for i, c in enumerate(self.clients):
-            engine = getattr(c.server_manager, "_engine", None)
+        for i, r in enumerate(members):
+            engine = getattr(r.mgr, "_engine", None)
             peek = getattr(engine, "prefix_affinity_tokens", None)
             if not callable(peek) \
                     or getattr(engine, "prefix_cache", None) is None:
@@ -511,13 +927,25 @@ class ReplicatedTierClient:
                 scores[i] = 0
         return scores
 
-    def _pick_replica(self, history) -> Tuple[int, str]:
-        """(replica index, how) — how ∈ {single, affinity,
-        affinity_overridden, least_loaded, random, breaker_fallback}."""
-        n = len(self.clients)
+    def _pick_replica(self, history,
+                      members: Optional[List[_Replica]] = None
+                      ) -> Tuple[int, str]:
+        """(index into the membership snapshot, how) — how ∈ {single,
+        affinity, affinity_overridden, least_loaded, random,
+        breaker_fallback}.  Callers that must dereference the index
+        pass their own snapshot as ``members`` (dispatch does), so a
+        concurrent scale event can't shift what the index means."""
+        if members is None:
+            members = list(self._members)
+        n = len(members)
         if n == 1:
             return 0, "single"
         waits = self._predicted_waits()
+        if len(waits) < n:
+            # A membership change landed between the snapshot and the
+            # helper's read: pad — the extra members are brand-new and
+            # empty, so zero predicted wait is the truth anyway.
+            waits = waits + [(0.0, 0)] * (n - len(waits))
         with self._rr_lock:
             rr = self._rr
             self._rr += 1
@@ -535,6 +963,8 @@ class ReplicatedTierClient:
             how = "random"
         elif policy == "affinity":
             scores = self._affinity_scores(history)
+            if len(scores) < n:
+                scores = scores + [0] * (n - len(scores))
             best = max(range(n), key=lambda i: (scores[i], -waits[i][0]))
             if scores[best] >= self.tier.replica_affinity_min_tokens:
                 least = order[0]
@@ -548,7 +978,7 @@ class ReplicatedTierClient:
                     # load — re-prefilling elsewhere beats queuing here.
                     how = "affinity_overridden"
         for idx in order:
-            if self.breaker.allow(replica_name(idx)):
+            if self.breaker.allow(members[idx].name):
                 return idx, (how if idx == order[0]
                              else "breaker_fallback")
         # Every replica's circuit is open within cooldown: dispatch the
@@ -557,8 +987,8 @@ class ReplicatedTierClient:
         # replica gate at all (parity).
         return order[0], "breaker_fallback"
 
-    def _note_route(self, idx: int, how: str) -> None:
-        obs_spans.annotate(current_trace(), replica=replica_name(idx),
+    def _note_route(self, member: _Replica, how: str) -> None:
+        obs_spans.annotate(current_trace(), replica=member.name,
                            replica_policy=how)
         try:
             m = (self.obs or get_observability()).m
@@ -566,11 +996,19 @@ class ReplicatedTierClient:
         except Exception:
             pass
 
-    def _feed_breaker(self, idx: int, raw: Any) -> None:
+    def _feed_breaker(self, member, raw: Any) -> None:
         """Sync/setup outcome → the replica breaker.  Admission
         rejections are breaker-neutral (healthy backpressure; the PR 2
-        rule) but repay a half-open canary permit."""
-        key = replica_name(idx)
+        rule) but repay a half-open canary permit.  ``member`` is the
+        dispatched record — or a positional index into the current
+        membership (the historic call shape tests drive directly)."""
+        if isinstance(member, _Replica):
+            key = member.name
+        else:
+            members = list(self._members)
+            i = int(member)
+            key = (members[i].name if 0 <= i < len(members)
+                   else replica_name(i))
         if is_error_shape(raw):
             if "admission rejected" in str(raw.get("error", "")):
                 self.breaker.release_probe(key)
@@ -579,22 +1017,22 @@ class ReplicatedTierClient:
         else:
             self.breaker.record(key, True)
 
-    def reset_replica(self, idx: int) -> None:
+    def reset_replica(self, rid: int) -> None:
         """Force-close one replica's circuit (the HealthMonitor calls
         this after successfully restarting that replica's engine)."""
-        self.breaker.reset(replica_name(idx))
+        self.breaker.reset(replica_name(rid))
 
     def healthy_replicas(self) -> int:
         """Replicas currently able to serve: running, not draining, not
         watchdog-stalled, circuit not open.  Lock-free advisory reads
         only (the sampler calls this at cadence)."""
         n = 0
-        for i, mgr in enumerate(self.server_manager.managers):
-            if not mgr.is_server_running() or mgr.draining:
+        for r in list(self._members):
+            if not r.mgr.is_server_running() or r.mgr.draining:
                 continue
-            if self.breaker.state(replica_name(i)) == OPEN:
+            if self.breaker.state(r.name) == OPEN:
                 continue
-            engine = getattr(mgr, "_engine", None)
+            engine = getattr(r.mgr, "_engine", None)
             stall = getattr(engine, "progress_stall_s", None)
             deadline = self.tier.watchdog_stall_s
             if callable(stall) and deadline is not None:
@@ -609,24 +1047,28 @@ class ReplicatedTierClient:
     # -- request surface (TierClient parity) --------------------------------
 
     def process(self, history) -> Dict[str, Any]:
-        idx, how = self._pick_replica(history)
-        self._note_route(idx, how)
-        client = self.clients[idx]
+        members = list(self._members)
+        idx, how = self._pick_replica(history, members=members)
+        member = members[min(idx, len(members) - 1)]
+        self._note_route(member, how)
+        client = member.client
         self._last_client = client
         raw = client.process(history)
-        self._feed_breaker(idx, raw)
+        self._feed_breaker(member, raw)
         return raw
 
     def process_stream(self, history):
-        idx, how = self._pick_replica(history)
-        self._note_route(idx, how)
-        client = self.clients[idx]
+        members = list(self._members)
+        idx, how = self._pick_replica(history, members=members)
+        member = members[min(idx, len(members) - 1)]
+        self._note_route(member, how)
+        client = member.client
         self._last_client = client
         handle = client.process_stream(history)
         if is_error_shape(handle):
-            self._feed_breaker(idx, handle)
+            self._feed_breaker(member, handle)
             return handle
-        key = replica_name(idx)
+        key = member.name
         return _ReplicaStream(
             handle, lambda ok: self.breaker.record(key, ok))
 

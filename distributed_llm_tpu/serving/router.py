@@ -241,6 +241,24 @@ class Router:
                 mesh=self.config.get("health_mesh"))
             self.health_monitor.start()
 
+        # Elastic capacity (serving/autoscaler.py, ISSUE 18): one
+        # control loop per ARMED tier (TierConfig.autoscale), actuating
+        # replica membership from the SLO/queue/shed signals above.
+        # The DLLM_AUTOSCALE=0 kill switch — or simply no armed tier —
+        # builds nothing: the static PR 12 membership path stays
+        # byte-identical (pinned by test).
+        self.autoscalers: Dict[str, Any] = {}
+        if (env_str("DLLM_AUTOSCALE", "1") or "1") != "0":
+            from .autoscaler import ReplicaAutoscaler
+            for t in self.cluster.tiers():
+                client = self.tiers.get(t.name)
+                if (getattr(t, "autoscale", False)
+                        and callable(getattr(client, "scale_to", None))):
+                    scaler = ReplicaAutoscaler(
+                        t.name, t, client, self.slo, metrics=self.obs.m)
+                    scaler.start()
+                    self.autoscalers[t.name] = scaler
+
     # -- back-compat (src/router.py:65-67) ---------------------------------
 
     def set_threshold(self, threshold: int) -> None:
@@ -270,6 +288,13 @@ class Router:
         if self.health_monitor is not None:
             try:
                 self.health_monitor.stop()
+            except Exception:
+                pass
+        # Autoscalers stop BEFORE the tier drains fan out: a controller
+        # mid-tick must not actuate membership against a draining tier.
+        for scaler in getattr(self, "autoscalers", {}).values():
+            try:
+                scaler.stop()
             except Exception:
                 pass
         # The state sampler dies with the router: a drained process must
@@ -419,9 +444,17 @@ class Router:
                         st["replica_healthy"] = int(healthy_fn())
                     except Exception:
                         pass
-                sub_mgrs = mgr.replica_managers()
+                # Keyed by replica NAME, not position: dynamic
+                # membership (ISSUE 18) removes members mid-run, and a
+                # positional lookup would pin the wrong manager's
+                # draining flag on the survivors.
+                items_fn = getattr(mgr, "replica_items", None)
+                mgr_by_key = ({f"r{rid}": sub for rid, sub in items_fn()}
+                              if callable(items_fn)
+                              else {f"r{i}": sub for i, sub in
+                                    enumerate(mgr.replica_managers())})
                 rb = getattr(tier, "breaker", None)
-                st["replica_count"] = len(sub_mgrs)
+                st["replica_count"] = len(mgr_by_key)
                 rep_kv = (agg_kv or {}).get("replicas") or {}
                 for key, engine in subs():
                     rst = self._collect_engine_state(
@@ -435,11 +468,9 @@ class Router:
                             rst["max_slots"] = ss.get("max_slots")
                         except Exception:
                             pass
-                    try:
-                        sub = sub_mgrs[int(key.lstrip("r"))]
+                    sub = mgr_by_key.get(key)
+                    if sub is not None:
                         rst["draining"] = bool(sub.draining)
-                    except (ValueError, IndexError):
-                        pass
                     if rb is not None:
                         rst["breaker"] = rb.state(key)
                     out[f"{name}/{key}"] = rst
@@ -572,6 +603,16 @@ class Router:
             entry["device_time_ms"] += device_ms
             entry["kv_block_ticks"] += kv_ticks
             entry["requests"] += 1
+
+    def autoscaler_snapshot(self) -> Optional[Dict[str, Any]]:
+        """The GET /stats ``autoscaler`` block: per armed tier, the
+        bounds/windows, live membership, streak state, event counters,
+        and the bounded decision ledger.  None when no tier arms the
+        autoscaler (static configs keep their historical /stats shape)."""
+        if not getattr(self, "autoscalers", None):
+            return None
+        return {name: scaler.snapshot()
+                for name, scaler in self.autoscalers.items()}
 
     def cost_snapshot(self) -> List[Dict[str, Any]]:
         """The GET /stats ``cost`` block: attributed device time and KV

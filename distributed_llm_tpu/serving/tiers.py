@@ -782,10 +782,13 @@ def build_tiers(
                 spawn_cmd=tier.spawn_cmd)
             continue
         mesh = meshes[tier.name]
-        if tier.replicas > 1:
+        if tier.replicas > 1 or tier.autoscale:
             # Replicated tier (ISSUE 12, serving/replicas.py): N engine
             # replicas behind one tier client with prefix-affinity
-            # dispatch.  replicas=1 NEVER takes this path — the plain
+            # dispatch.  An autoscale-armed tier takes this path even
+            # at replicas=1 — elastic membership (ISSUE 18) needs the
+            # replica layer to actuate, and min may be 1.  Plain
+            # replicas=1 WITHOUT autoscale never takes it — the
             # TierClient below stays byte-identical to pre-replica
             # behavior.
             from .replicas import ReplicatedTierClient

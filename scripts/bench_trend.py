@@ -70,6 +70,13 @@ from typing import Any, Dict, List, Optional, Tuple
 # quotas-OFF collateral means isolation stopped isolating) and
 # noisy.flood_shed_precision (tenant-shaped rejections landing on the
 # flooder, not the quiet tenant) joined in r19.
+# elastic.goodput_per_replica_s (ISSUE 18's autoscaled SLO-good
+# responses per replica-second on the seeded diurnal ramp — the
+# capacity-economics headline; drifting down means elasticity stopped
+# buying goodput cheaper than static provisioning) and
+# elastic.flap_count (effective scale-event reversal pairs inside one
+# cooldown window — 0 by construction, ANY positive value is the
+# control loop oscillating) joined in r20.
 PINNED: Tuple[Tuple[str, bool], ...] = (
     ("trend_req_per_s", True),
     ("skew_tick_ratio", False),
@@ -82,12 +89,17 @@ PINNED: Tuple[Tuple[str, bool], ...] = (
     ("multichip.tp_ratio", True),
     ("noisy.quiet_p95_ratio", False),
     ("noisy.flood_shed_precision", True),
+    ("elastic.goodput_per_replica_s", True),
+    ("elastic.flap_count", False),
 )
 
 # Context rows printed (no flags): the headline and accuracy travel
 # with the pinned numbers so a trend break can be read in context.
+# elastic.scale_events rides as context — the event count sizes the
+# flap/gprs rows (2 is the diurnal ideal) but is not itself a verdict.
 CONTEXT = ("value", "routing_accuracy", "mixed.tbt95_ratio",
-           "replica.aff_ret", "profile.coverage")
+           "replica.aff_ret", "profile.coverage",
+           "elastic.scale_events")
 
 
 def _get(doc: Any, *path: str) -> Optional[Any]:
@@ -127,6 +139,13 @@ _PATHS: Dict[str, Tuple[Tuple[str, ...], ...]] = {
                               ("noisy", "quiet_p95_ratio"),),
     "noisy.flood_shed_precision": (("noisy", "shed_precision"),
                                    ("noisy", "flood_shed_precision"),),
+    "elastic.goodput_per_replica_s": (("elastic", "gprs"),
+                                      ("elastic",
+                                       "goodput_per_replica_s"),),
+    "elastic.flap_count": (("elastic", "flaps"),
+                           ("elastic", "flap_count"),),
+    "elastic.scale_events": (("elastic", "events"),
+                             ("elastic", "scale_events"),),
 }
 
 
@@ -206,6 +225,13 @@ def flag_regressions(rounds: List[Tuple[str, Dict[str, float]]],
         label, latest = series[-1]
         baseline = statistics.median(v for _, v in series[:-1])
         if baseline <= 0:
+            # Ratio flagging needs a positive baseline — but a
+            # lower-is-better counter whose healthy value IS zero
+            # (elastic.flap_count) regresses on ANY positive reading.
+            if not higher_better and latest > 0:
+                flags.append(
+                    f"REGRESSION {metric}: {label} rose to {latest:g} "
+                    f"(prior-round median {baseline:g})")
             continue
         ratio = latest / baseline
         regressed = (ratio < 1.0 - threshold if higher_better

@@ -1,0 +1,566 @@
+"""Elastic capacity (ISSUE 18): the SLO-driven replica autoscaler.
+
+Controller tests drive ``tick()`` directly with an injected clock and a
+fake tier client — the decision rules (breach/idle streaks, hysteresis,
+per-direction cooldowns, bounds, refused-actuation retry) are pure host
+arithmetic and must be testable without threads or engines.  Membership
+tests run real tiny engines through ``scale_to`` (the autoscaler's
+actuation verb): deferred go-live, least-affine drain-and-remove with
+the spill handoff, monotonic rids, and the byte-identity /
+one-decode-program invariants the bench leg hard-fails on.  The static
+PR 12 path (autoscale off / DLLM_AUTOSCALE=0) is pinned byte-identical.
+"""
+
+import dataclasses
+import types
+
+import pytest
+
+from distributed_llm_tpu.config import tiny_batched_cluster
+from distributed_llm_tpu.serving.autoscaler import (
+    IDLE_GOODPUT_MARGIN,
+    LEDGER_CAP,
+    ReplicaAutoscaler,
+)
+from distributed_llm_tpu.serving.replicas import ReplicatedTierClient
+from distributed_llm_tpu.serving.tiers import build_tiers
+
+
+# -- fakes --------------------------------------------------------------------
+
+class _FakeAdmission:
+    def __init__(self):
+        self.rejected = 0
+
+    def snapshot(self):
+        return {"rejected": self.rejected}
+
+
+class _FakeClient:
+    """Stands in for ReplicatedTierClient: the autoscaler only reads
+    replica_count/load_snapshot/clients[].admission and calls
+    scale_to."""
+
+    def __init__(self, n=1):
+        self.n = n
+        self.admission = _FakeAdmission()
+        self.queue_depth = 0
+        self.active_slots = 0
+        self.refuse = False
+        self.scale_calls = []
+
+    @property
+    def clients(self):
+        return [self]
+
+    def replica_count(self):
+        return self.n
+
+    def load_snapshot(self):
+        return {"queue_depth": self.queue_depth,
+                "active_slots": self.active_slots, "max_slots": 2}
+
+    def scale_to(self, target, reason="manual", timeout_s=None):
+        self.scale_calls.append((target, reason))
+        if self.refuse:
+            return {"target": target, "added": [], "removed": [],
+                    "errors": ["refused"], "replicas": self.n}
+        added = list(range(self.n, target)) if target > self.n else []
+        removed = ([{"replica": "r?"}] * (self.n - target)
+                   if target < self.n else [])
+        self.n = target
+        return {"target": target, "added": added, "removed": removed,
+                "errors": [], "replicas": self.n}
+
+
+class _FakeSLO:
+    def __init__(self, value=None):
+        self.value = value
+
+    def goodput(self, strategy=None, tier=None):
+        return self.value
+
+
+def _tier_cfg(**kw):
+    base = dict(autoscale=True, autoscale_min_replicas=1,
+                autoscale_max_replicas=3, autoscale_interval_s=0.1,
+                autoscale_goodput_floor=0.5, autoscale_queue_high=2.0,
+                autoscale_breach_window_s=1.0, autoscale_idle_window_s=2.0,
+                autoscale_up_cooldown_s=2.0, autoscale_down_cooldown_s=4.0)
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+def _scaler(client=None, slo=None, metrics=None, **cfg_kw):
+    clk = [0.0]
+    client = client or _FakeClient()
+    scaler = ReplicaAutoscaler("nano", _tier_cfg(**cfg_kw), client,
+                               slo or _FakeSLO(), metrics=metrics,
+                               clock=lambda: clk[0])
+    return scaler, client, clk
+
+
+# -- breach → scale up --------------------------------------------------------
+
+def test_sustained_queue_breach_scales_up():
+    scaler, client, clk = _scaler()
+    client.queue_depth = 10               # > queue_high x replicas
+    assert scaler.tick() is None          # streak starts, window unmet
+    clk[0] = 0.5
+    assert scaler.tick() is None
+    clk[0] = 1.0                          # breach_window_s reached
+    assert scaler.tick() == "up"
+    assert client.n == 2
+    assert client.scale_calls == [(2, "queue_growth")]
+
+
+def test_one_sample_spike_does_not_actuate():
+    scaler, client, clk = _scaler()
+    client.queue_depth = 10
+    scaler.tick()                         # breach streak starts at 0
+    clk[0] = 0.5
+    client.queue_depth = 0
+    client.active_slots = 1               # busy, not idle, not breaching
+    scaler.tick()                         # streak broken
+    clk[0] = 1.0
+    client.queue_depth = 10
+    scaler.tick()                         # streak restarts at 1.0
+    clk[0] = 1.5
+    assert scaler.tick() is None          # 0.5s < breach_window_s
+    assert client.scale_calls == []
+
+
+def test_goodput_floor_breach_reason():
+    scaler, client, clk = _scaler(slo=_FakeSLO(0.3))
+    scaler.tick()
+    clk[0] = 1.0
+    assert scaler.tick() == "up"
+    assert client.scale_calls == [(2, "goodput_floor")]
+
+
+def test_shed_delta_breach_reason():
+    scaler, client, clk = _scaler()
+    scaler.tick()                         # primes the shed baseline
+    client.admission.rejected = 5
+    clk[0] = 0.2
+    scaler.tick()                         # shed streak starts
+    clk[0] = 1.2
+    client.admission.rejected = 9         # still shedding
+    assert scaler.tick() == "up"
+    assert client.scale_calls == [(2, "shed")]
+
+
+def test_max_replicas_bound():
+    scaler, client, clk = _scaler()
+    client.n = 3                          # at max
+    client.queue_depth = 50
+    scaler.tick()
+    clk[0] = 5.0
+    assert scaler.tick() is None
+    assert client.scale_calls == []
+
+
+def test_up_cooldown_blocks_consecutive_ups():
+    scaler, client, clk = _scaler()
+    client.queue_depth = 50
+    scaler.tick()
+    clk[0] = 1.0
+    assert scaler.tick() == "up"          # event at t=1.0
+    clk[0] = 1.2
+    scaler.tick()                         # breach streak restarts
+    clk[0] = 2.5                          # streak >= window, cooldown NOT
+    assert scaler.tick() is None          # (1.5s < up_cooldown_s=2.0)
+    clk[0] = 3.1                          # cooldown met (>= 3.0)
+    assert scaler.tick() == "up"
+    assert client.n == 3
+
+
+# -- idle → scale down --------------------------------------------------------
+
+def test_sustained_idle_scales_down():
+    scaler, client, clk = _scaler()
+    client.n = 2
+    scaler.tick()                         # idle streak starts (all zero)
+    clk[0] = 1.0
+    assert scaler.tick() is None          # 1s < idle_window_s=2
+    clk[0] = 2.0
+    assert scaler.tick() == "down"
+    assert client.n == 1
+    assert client.scale_calls == [(1, "idle")]
+
+
+def test_min_replicas_bound():
+    scaler, client, clk = _scaler()       # n=1 = min
+    scaler.tick()
+    clk[0] = 10.0
+    assert scaler.tick() is None
+    assert client.scale_calls == []
+
+
+def test_goodput_near_floor_is_not_idle():
+    """Hysteresis: scale-down demands goodput at floor + margin — a
+    tier serving JUST at the floor keeps its capacity."""
+    slo = _FakeSLO(0.5 + IDLE_GOODPUT_MARGIN / 2)
+    scaler, client, clk = _scaler(slo=slo)
+    client.n = 2
+    scaler.tick()
+    clk[0] = 10.0
+    assert scaler.tick() is None
+    assert client.scale_calls == []
+    slo.value = 0.95                      # comfortably above floor+margin
+    scaler.tick()                         # idle streak starts
+    clk[0] = 12.0
+    assert scaler.tick() == "down"
+
+
+def test_active_slots_block_idle():
+    scaler, client, clk = _scaler()
+    client.n = 2
+    client.active_slots = 1
+    scaler.tick()
+    clk[0] = 10.0
+    assert scaler.tick() is None
+    assert client.scale_calls == []
+
+
+# -- flap protection ----------------------------------------------------------
+
+def test_no_up_down_up_inside_cooldown_window():
+    """The bench leg's flap bound, at the decision layer: after an up,
+    a down waits out down_cooldown_s; after that down, another up waits
+    out up_cooldown_s — a full reversal pair can never land inside one
+    combined cooldown window."""
+    scaler, client, clk = _scaler()
+    client.queue_depth = 50
+    scaler.tick()
+    clk[0] = 1.0
+    assert scaler.tick() == "up"          # up at t=1.0
+    client.queue_depth = 0                # traffic vanishes instantly
+    times = {"down": None, "up2": None}
+    t = 1.0
+    while t < 20.0 and times["up2"] is None:
+        t = round(t + 0.1, 1)
+        clk[0] = t
+        if times["down"] is not None and times["up2"] is None:
+            client.queue_depth = 50       # and spikes again post-down
+        verdict = scaler.tick()
+        if verdict == "down" and times["down"] is None:
+            times["down"] = t
+        elif verdict == "up" and times["down"] is not None:
+            times["up2"] = t
+    # Down respects down_cooldown_s from the up event...
+    assert times["down"] is not None and times["down"] >= 1.0 + 4.0
+    # ...and the second up respects up_cooldown_s from the down.
+    assert times["up2"] is not None
+    assert times["up2"] >= times["down"] + 2.0
+
+
+def test_refused_actuation_retries_without_rearming_cooldown():
+    scaler, client, clk = _scaler()
+    client.queue_depth = 50
+    client.refuse = True
+    scaler.tick()
+    clk[0] = 1.0
+    assert scaler.tick() is None          # actuated but refused
+    clk[0] = 1.1
+    scaler.tick()                         # refused again NEXT tick —
+    assert len(client.scale_calls) == 2   # no cooldown was armed
+    assert all(not e["ok"] for e in scaler.ledger)
+    client.refuse = False
+    clk[0] = 1.2
+    assert scaler.tick() == "up"
+
+
+# -- ledger / snapshot / metrics ---------------------------------------------
+
+def test_ledger_bounded_and_shaped():
+    scaler, client, clk = _scaler()
+    client.queue_depth = 50
+    client.refuse = True                  # every actuation ledgers
+    scaler.tick()
+    for i in range(LEDGER_CAP + 10):
+        clk[0] = 1.0 + i * 0.1
+        scaler.tick()
+    assert len(scaler.ledger) == LEDGER_CAP
+    entry = scaler.ledger[-1]
+    assert {"ts", "direction", "reason", "from_replicas",
+            "to_replicas", "ok", "signals"} <= set(entry)
+    assert entry["direction"] == "up"
+    assert entry["signals"]["queue_depth"] == 50
+
+
+def test_snapshot_shape_and_counters():
+    scaler, client, clk = _scaler()
+    client.queue_depth = 50
+    scaler.tick()
+    clk[0] = 1.0
+    scaler.tick()
+    snap = scaler.snapshot()
+    assert snap["enabled"] is True
+    assert snap["replicas"] == 2
+    assert snap["min_replicas"] == 1 and snap["max_replicas"] == 3
+    assert snap["events_total"] == {"up": 1, "down": 0}
+    assert snap["last_signals"]["queue_depth"] == 50
+    assert isinstance(snap["ledger"], list) and len(snap["ledger"]) == 1
+
+
+def test_metrics_fired_on_transition():
+    class _Label:
+        def __init__(self, rec, key):
+            self.rec, self.key = rec, key
+
+        def inc(self, v=1.0):
+            self.rec.append(("inc", self.key))
+
+        def set(self, v):
+            self.rec.append(("set", self.key, v))
+
+    class _Family:
+        def __init__(self, rec):
+            self.rec = rec
+
+        def labels(self, *key):
+            return _Label(self.rec, key)
+
+    rec = []
+    metrics = types.SimpleNamespace(autoscale_events=_Family(rec),
+                                    replica_count_g=_Family(rec))
+    scaler, client, clk = _scaler(metrics=metrics)
+    client.queue_depth = 50
+    scaler.tick()
+    clk[0] = 1.0
+    scaler.tick()
+    assert ("inc", ("nano", "up", "queue_growth")) in rec
+    assert ("set", ("nano",), 2) in rec
+
+
+def test_stop_joins_controller_thread():
+    scaler, client, clk = _scaler()
+    scaler.start()
+    assert scaler._thread is not None and scaler._thread.is_alive()
+    scaler.stop()
+    assert not scaler._thread.is_alive()
+
+
+# -- membership actuation (real tiny engines) ---------------------------------
+
+def _cluster(**tier_kw):
+    cl = tiny_batched_cluster(nano_slots=2)
+    nano = dataclasses.replace(cl.nano, max_new_tokens=8, **tier_kw)
+    return dataclasses.replace(cl, nano=nano)
+
+
+def test_scale_to_membership_and_monotonic_rids():
+    """Cold-path contract (warm pool off): engines are built at
+    actuation time and destroyed on scale-down, and rids are NEVER
+    reused — the replacement replica after an up-down-up is a fresh
+    r2, no name from a retired replica comes back."""
+    cl = _cluster(autoscale=True, autoscale_min_replicas=1,
+                  autoscale_max_replicas=3, autoscale_warm_pool=False)
+    client = ReplicatedTierClient(cl.nano, cl, warmup_on_start=False)
+    try:
+        client.server_manager.start_server()
+        assert client.replica_count() == 1
+        up = client.scale_to(2, reason="test")
+        assert up["added"] and client.replica_count() == 2
+        names = {r.name for r in client._members}
+        assert names == {"r0", "r1"}
+        down = client.scale_to(1, reason="test")
+        assert len(down["removed"]) == 1 and client.replica_count() == 1
+        assert not down["removed"][0]["parked"]
+        up2 = client.scale_to(2, reason="test")
+        assert up2["added"] == ["r2"]
+        out = client.process("q rivers?")
+        assert isinstance(out, dict) and "response" in out
+    finally:
+        client.server_manager.stop_server()
+
+
+def test_warm_pool_prebuilds_and_scale_up_publishes_standby():
+    """Warm-pool contract (the autoscale default): the replicas between
+    min and max are built at construction and warmed by start_server,
+    and scale-up PUBLISHES one — no engine build at actuation time, so
+    the actuation is bounded by a breaker key + list append."""
+    cl = _cluster(autoscale=True, autoscale_min_replicas=1,
+                  autoscale_max_replicas=3)
+    assert cl.nano.autoscale_warm_pool
+    client = ReplicatedTierClient(cl.nano, cl, warmup_on_start=False)
+    try:
+        assert client.replica_count() == 1
+        assert [r.name for r in client._standby] == ["r1", "r2"]
+        client.server_manager.start_server()
+        # start_server warmed the STANDBYS too — publish is instant.
+        assert all(r.mgr.is_server_running() for r in client._standby)
+        up = client.scale_to(2, reason="test")
+        assert up["added"] == ["r1"] and client.replica_count() == 2
+        assert [r.name for r in client._standby] == ["r2"]
+        out = client.process("q rivers?")
+        assert isinstance(out, dict) and "response" in out
+    finally:
+        client.server_manager.stop_server()
+
+
+def test_warm_pool_scale_down_parks_and_revives_same_engine():
+    """Scale-down parks the drained replica (same rid, same engine —
+    ``r1`` keeps meaning the same engine across scale events) and the
+    next scale-up republishes it; the spill handoff to the survivor
+    still runs before parking."""
+    cl = _cluster(autoscale=True, autoscale_min_replicas=1,
+                  autoscale_max_replicas=2)
+    client = ReplicatedTierClient(cl.nano, cl, warmup_on_start=False)
+    try:
+        client.server_manager.start_server()
+        client.scale_to(2, reason="test")
+        engine_before = client._members[1].mgr._engine
+        down = client.scale_to(1, reason="test")
+        info = down["removed"][0]
+        assert info["parked"] and info["replica"] == "r1"
+        assert [r.name for r in client._standby] == ["r1"]
+        up = client.scale_to(2, reason="test")
+        assert up["added"] == ["r1"]
+        # The SAME warm engine came back — no rebuild, no re-warm.
+        assert client._members[1].mgr._engine is engine_before
+        out = client.process("q rivers?")
+        assert isinstance(out, dict) and "response" in out
+    finally:
+        client.server_manager.stop_server()
+
+
+def test_scale_down_byte_identity_and_handoff():
+    """The bench leg's HARD sub-check, as a pinned test: answers before
+    and after the 2->1 transition are byte-identical, and the victim's
+    parked prefixes demote through the spill tier."""
+    from distributed_llm_tpu.engine.paged_kv import pool_block_bytes
+
+    cl = _cluster(enable_prefix_cache=True, prefix_cache_entries=8,
+                  prefill_chunk_tokens=16)
+    blk = pool_block_bytes(cl.nano.model(), cl.nano.kv_block_size,
+                           cl.nano.kv_quantize)
+    cl = dataclasses.replace(
+        cl, nano=dataclasses.replace(cl.nano, host_kv_bytes=blk * 64))
+    client = ReplicatedTierClient(cl.nano, cl, warmup_on_start=False)
+    prompts = [f"session {n} tell me about rivers in one short sentence"
+               for n in ("alpha", "bravo", "charlie", "delta")]
+    try:
+        client.server_manager.start_server()
+        client.scale_to(2, reason="test")
+        pre = [client.process(p) for p in prompts]
+        down = client.scale_to(1, reason="test")
+        info = down["removed"][0]
+        assert {"replica", "demoted_entries", "handed_off",
+                "drained"} <= set(info)
+        post = [client.process(p) for p in prompts]
+        pre_txt = [r["response"] for r in pre]
+        post_txt = [r["response"] for r in post]
+        assert pre_txt == post_txt
+    finally:
+        client.server_manager.stop_server()
+
+
+def test_scaled_up_replica_one_decode_program():
+    """Per-replica one-decode-program invariant survives elasticity: a
+    replica minted by scale_to warms against the process compile cache
+    and serves with exactly ONE compiled decode program."""
+    cl = _cluster()
+    if not getattr(cl.nano, "attention_ragged", False):
+        pytest.skip("one-decode-program bound is the ragged mode's")
+    client = ReplicatedTierClient(cl.nano, cl, warmup_on_start=False)
+    try:
+        client.server_manager.start_server()
+        client.process("q rivers?")
+        client.scale_to(2, reason="test")
+        for _ in range(4):                # touch both replicas
+            client.process("q rivers?")
+        for key, eng in client.server_manager.live_engines():
+            compiled = getattr(eng, "_compiled", {}).get("decode", ())
+            assert len(compiled) <= 1, (
+                f"{key} minted {len(compiled)} decode programs")
+    finally:
+        client.server_manager.stop_server()
+
+
+# -- static-path pins ---------------------------------------------------------
+
+def test_autoscale_off_keeps_plain_tier_client():
+    """autoscale=False + replicas=1 (the default everywhere) must never
+    build the replica machinery — the PR 12 static path, byte-identical
+    to pre-elastic behavior."""
+    cl = tiny_batched_cluster()
+    assert not cl.nano.autoscale
+    tiers = build_tiers(cl, warmup_on_start=False)
+    assert not hasattr(tiers["nano"].server_manager, "replica_managers")
+    assert not hasattr(tiers["nano"], "scale_to")
+
+
+def test_autoscale_armed_tier_builds_replica_layer_at_min():
+    cl = _cluster(autoscale=True, autoscale_min_replicas=1,
+                  autoscale_max_replicas=2)
+    tiers = build_tiers(cl, warmup_on_start=False)
+    nano = tiers["nano"]
+    assert callable(getattr(nano, "scale_to", None))
+    assert nano.replica_count() == 1
+
+
+def test_dllm_autoscale_0_disarms_router(monkeypatch):
+    monkeypatch.setenv("DLLM_AUTOSCALE", "0")
+    from distributed_llm_tpu.obs import Observability
+    from distributed_llm_tpu.serving.router import Router
+
+    cl = _cluster(autoscale=True, autoscale_min_replicas=1,
+                  autoscale_max_replicas=2)
+    router = Router(strategy="heuristic", benchmark_mode=True,
+                    cluster=cl, observability=Observability(slow_ms=None))
+    try:
+        assert router.autoscalers == {}
+        assert router.autoscaler_snapshot() is None
+    finally:
+        router.drain(timeout_s=5.0)
+
+
+def test_router_arms_autoscaler_for_elastic_tier(monkeypatch):
+    monkeypatch.delenv("DLLM_AUTOSCALE", raising=False)
+    from distributed_llm_tpu.obs import Observability
+    from distributed_llm_tpu.serving.router import Router
+
+    cl = _cluster(autoscale=True, autoscale_min_replicas=1,
+                  autoscale_max_replicas=2, autoscale_interval_s=0.1)
+    router = Router(strategy="heuristic", benchmark_mode=True,
+                    cluster=cl, observability=Observability(slow_ms=None))
+    try:
+        assert set(router.autoscalers) == {"nano"}
+        scaler = router.autoscalers["nano"]
+        assert scaler._thread is not None and scaler._thread.is_alive()
+        snap = router.autoscaler_snapshot()
+        assert snap["nano"]["enabled"] is True
+    finally:
+        router.drain(timeout_s=5.0)
+    assert not scaler._thread.is_alive()   # drain stops the controller
+
+
+def test_static_path_output_identical_to_elastic_min():
+    """An autoscale-armed tier at min=1 answers byte-identically to the
+    plain static TierClient — arming elasticity changes WHO can resize
+    the tier, never WHAT it answers."""
+    prompt = "q rivers?"
+    static = build_tiers(tiny_batched_cluster(nano_slots=2),
+                         warmup_on_start=False)
+    try:
+        static["nano"].server_manager.start_server()
+        ref = static["nano"].process(prompt)
+    finally:
+        static["nano"].server_manager.stop_server()
+        static["orin"].server_manager.stop_server()
+
+    base = tiny_batched_cluster(nano_slots=2)
+    cl = dataclasses.replace(
+        base, nano=dataclasses.replace(base.nano, autoscale=True,
+                                       autoscale_min_replicas=1,
+                                       autoscale_max_replicas=2))
+    elastic = build_tiers(cl, warmup_on_start=False)
+    try:
+        elastic["nano"].server_manager.start_server()
+        got = elastic["nano"].process(prompt)
+    finally:
+        elastic["nano"].server_manager.stop_server()
+        elastic["orin"].server_manager.stop_server()
+    assert ref["response"] == got["response"]

@@ -2012,6 +2012,43 @@ def test_thread_worker_pool_direct_append_reclaims():
     assert _rules(result) == ["thread-no-reclaim"], result.findings
 
 
+def test_thread_autoscaler_controller_reclaim_and_leak():
+    """ISSUE 18 fixture pair: the elastic-capacity controller shape
+    (serving/autoscaler.py) — a periodic control-loop thread spawned in
+    start().  The shipped lifecycle (stop() sets the event and joins
+    bounded) must stay clean; the near-miss where the join is parked in
+    a non-stop-family method (``rebalance``) leaks the controller on
+    router drain and must be flagged."""
+    from distributed_llm_tpu.lint.checkers.thread_lifecycle import \
+        ThreadLifecycleChecker
+    good = """
+        import threading
+
+        class ReplicaAutoscaler:
+            def __init__(self):
+                self._stop = threading.Event()
+
+            def start(self):
+                self._thread = threading.Thread(target=self._loop)
+                self._thread.start()
+
+            def _loop(self):
+                while not self._stop.wait(0.5):
+                    pass
+
+            def stop(self):
+                self._stop.set()
+                self._thread.join(timeout=5)
+    """
+    assert _lint(ThreadLifecycleChecker(), {SERVING: good}).findings == []
+
+    # Near-miss: the SAME join exists, but only reachable through a
+    # method outside the stop family — drain never runs it.
+    bad = good.replace("def stop(self):", "def rebalance(self):")
+    result = _lint(ThreadLifecycleChecker(), {SERVING: bad})
+    assert _rules(result) == ["thread-no-reclaim"], result.findings
+
+
 def test_thread_acquire_leak_flagged_and_finally_clean():
     from distributed_llm_tpu.lint.checkers.thread_lifecycle import \
         ThreadLifecycleChecker

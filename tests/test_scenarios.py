@@ -1,0 +1,188 @@
+"""Scenario traffic suite (ISSUE 18, bench/scenarios.py): seeded
+deterministic schedules, shape invariants per generator, and the
+absolute-schedule catch-up semantics ported from openloop."""
+
+import time
+
+from distributed_llm_tpu.bench.scenarios import (
+    KIND_CHAT,
+    KIND_LONG,
+    KIND_ONESHOT,
+    SESSION_POOL,
+    Arrival,
+    Segment,
+    diurnal_ramp,
+    flash_crowd,
+    long_context_wave,
+    peak_rate,
+    run_schedule,
+    schedule,
+    session_mix,
+    total_duration_s,
+)
+
+
+# -- determinism --------------------------------------------------------------
+
+def test_same_seed_identical_schedule():
+    """The cross-round pin: same (segments, label, seed) must expand to
+    BYTE-identical arrival times, kinds, and session ids — the elastic
+    leg replays one schedule across three capacity policies and
+    compares their goodput, which is meaningless on different traffic."""
+    segs = diurnal_ramp(1.0, 8.0, period_s=30.0, steps=6)
+    a = schedule(segs, label="pin", seed=7)
+    b = schedule(segs, label="pin", seed=7)
+    assert a == b
+    assert len(a) > 0
+    assert all(isinstance(x, Arrival) for x in a)
+
+
+def test_seed_and_label_change_schedule():
+    segs = [Segment(10.0, 5.0)]
+    base = schedule(segs, label="x", seed=1)
+    assert schedule(segs, label="x", seed=2) != base
+    assert schedule(segs, label="y", seed=1) != base
+
+
+def test_schedule_survives_hash_randomization_style_labels():
+    """Seeding is zlib.crc32, not str hash — two distinct labels give
+    distinct streams even when PYTHONHASHSEED would collide them."""
+    segs = [Segment(10.0, 5.0)]
+    assert schedule(segs, label="ab", seed=0) != schedule(
+        segs, label="ba", seed=0)
+
+
+# -- shape invariants ---------------------------------------------------------
+
+def test_diurnal_ramp_triangular():
+    segs = diurnal_ramp(2.0, 10.0, period_s=24.0, steps=8)
+    rates = [s.rate_req_per_s for s in segs]
+    assert len(segs) == 8
+    assert abs(total_duration_s(segs) - 24.0) < 1e-9
+    # Endpoints at base, peak reached, monotone up then down.
+    assert rates[0] == 2.0 and rates[-1] == 2.0
+    assert max(rates) == 10.0
+    mid = rates.index(max(rates))
+    assert all(x <= y for x, y in zip(rates[:mid], rates[1:mid + 1]))
+    assert all(x >= y for x, y in zip(rates[mid:], rates[mid + 1:]))
+
+
+def test_flash_crowd_shape():
+    segs = flash_crowd(2.0, 40.0, total_s=20.0, spike_start_s=8.0,
+                       spike_s=4.0)
+    assert [s.rate_req_per_s for s in segs] == [2.0, 40.0, 2.0]
+    assert [s.duration_s for s in segs] == [8.0, 4.0, 8.0]
+    assert peak_rate(segs) == 40.0
+
+
+def test_session_mix_fractions():
+    heavy = session_mix(5.0, 10.0, one_shot_fraction=0.0)
+    spray = session_mix(5.0, 10.0, one_shot_fraction=1.0)
+    arr_h = schedule(heavy, label="h", seed=3)
+    arr_s = schedule(spray, label="s", seed=3)
+    # Session-heavy: every arrival draws from the bounded pool.
+    assert len({a.session for a in arr_h}) <= SESSION_POOL
+    assert all(a.kind == KIND_CHAT for a in arr_h)
+    # One-shot spray: every arrival mints a UNIQUE session.
+    assert len({a.session for a in arr_s}) == len(arr_s)
+    assert all(a.kind == KIND_ONESHOT for a in arr_s)
+
+
+def test_long_context_wave_kinds_only_in_waves():
+    segs = long_context_wave(chat_rate=4.0, wave_rate=4.0, total_s=30.0,
+                             wave_every_s=10.0, wave_s=3.0)
+    assert abs(total_duration_s(segs) - 30.0) < 1e-9
+    wave_segs = [s for s in segs
+                 if any(k == KIND_LONG for k, _ in s.mix)]
+    calm_segs = [s for s in segs
+                 if all(k != KIND_LONG for k, _ in s.mix)]
+    assert wave_segs and calm_segs
+    # Waves ADD long traffic on top of chat.
+    assert all(s.rate_req_per_s == 8.0 for s in wave_segs)
+    assert all(s.rate_req_per_s == 4.0 for s in calm_segs)
+    arr = schedule(segs, label="wave", seed=5)
+    assert any(a.kind == KIND_LONG for a in arr)
+    assert any(a.kind == KIND_CHAT for a in arr)
+
+
+def test_schedule_times_monotone_and_bounded():
+    segs = diurnal_ramp(1.0, 12.0, period_s=20.0, steps=6)
+    arr = schedule(segs, label="mono", seed=11)
+    times = [a.t_s for a in arr]
+    assert times == sorted(times)
+    assert all(0.0 < t < total_duration_s(segs) for t in times)
+    assert [a.index for a in arr] == list(range(len(arr)))
+
+
+def test_schedule_respects_max_arrivals_cap():
+    arr = schedule([Segment(100.0, 50.0)], label="cap", seed=1,
+                   max_arrivals=25)
+    assert len(arr) == 25
+
+
+def test_zero_rate_segment_produces_nothing():
+    arr = schedule([Segment(5.0, 0.0), Segment(5.0, 2.0)],
+                   label="gap", seed=2)
+    # Arrivals only in the second segment's window.
+    assert arr and all(a.t_s >= 5.0 for a in arr)
+
+
+# -- replay: absolute-schedule catch-up semantics -----------------------------
+
+def _arrival(t, i):
+    return Arrival(t_s=t, kind=KIND_CHAT, session="s0", index=i)
+
+
+def test_run_schedule_catch_up_burst_not_deflation():
+    """Openloop's core open-loop property: when the spawn loop falls
+    behind (here: a slow beat hook), late arrivals fire back-to-back as
+    a catch-up burst instead of each re-sleeping its full gap — the
+    offered rate is preserved against spawn overhead."""
+    fired = []
+    beats = [0]
+
+    def beat():
+        beats[0] += 1
+        if beats[0] == 1:
+            time.sleep(0.30)          # fall behind after the first fire
+
+    arrivals = [_arrival(0.0, 0), _arrival(0.10, 1), _arrival(0.20, 2)]
+    t0 = time.perf_counter()
+    res = run_schedule(lambda a: fired.append(
+        (a.index, time.perf_counter() - t0)), arrivals, beat=beat,
+        join_grace_s=5.0)
+    assert res["arrivals"] == 3 and res["hung_clients"] == 0
+    by_ix = dict(fired)
+    # Arrivals 1 and 2 were both already due when the loop woke up:
+    # they fire immediately (catch-up), not 0.10 s apart.
+    assert by_ix[2] - by_ix[1] < 0.08
+    # And nothing fires EARLY: arrival 1's target was 0.10 s.
+    assert by_ix[1] >= 0.10
+
+
+def test_run_schedule_sleeps_to_absolute_target():
+    fired = []
+    t0 = time.perf_counter()
+    run_schedule(lambda a: fired.append(time.perf_counter() - t0),
+                 [_arrival(0.0, 0), _arrival(0.25, 1)],
+                 join_grace_s=5.0)
+    assert fired[0] < 0.15
+    assert fired[1] >= 0.25
+
+
+def test_run_schedule_time_scale_compresses():
+    t0 = time.perf_counter()
+    res = run_schedule(lambda a: None,
+                       [_arrival(0.0, 0), _arrival(1.0, 1)],
+                       time_scale=0.1, join_grace_s=5.0)
+    assert res["arrivals"] == 2
+    assert time.perf_counter() - t0 < 0.8
+
+
+def test_run_schedule_deadline_truncates():
+    res = run_schedule(lambda a: None,
+                       [_arrival(0.0, 0), _arrival(30.0, 1)],
+                       deadline=time.monotonic() + 0.2,
+                       join_grace_s=5.0)
+    assert res["truncated"] is True
+    assert res["arrivals"] == 1
