@@ -151,6 +151,13 @@ ENV_VARS: Dict[str, EnvVar] = {v.name: v for v in (
        "budget of the hierarchical KV spill tier in bytes ('0' disables "
        "it everywhere); unset = each tier's config decides.  The bench "
        "spill leg A/Bs through this."),
+    _e("DLLM_KV_LEAK_CHECK", None, "engine/batching.py",
+       "'1' arms the dynamic twin of the lint's ownership rules: engine "
+       "stop() asserts zero allocated pool blocks and zero live spill "
+       "pins once every slot, parked prefix, in-flight prefill and "
+       "queued request has unwound.  Debug/test-only (the assert costs "
+       "one ref_stats() sweep per stop); tests/conftest.py arms it for "
+       "the whole suite."),
     _e("DLLM_TENANT_MAX_INFLIGHT", None, "serving/tenants.py",
        "Default per-tenant in-flight request cap for tenants absent "
        "from TierConfig.tenant_quotas (int); unset = unlimited.  Only "

@@ -1142,12 +1142,18 @@ class ContinuousBatchingEngine:
         # single-writer); evictions driven from another thread (tests
         # poking pop_oldest, warmup on the builder thread) still demote,
         # just unstamped.
-        if (self._thread is not None
-                and threading.get_ident() == self._thread.ident):
-            with self.profiler.phase("demote"):
+        try:
+            if (self._thread is not None
+                    and threading.get_ident() == self._thread.ident):
+                with self.profiler.phase("demote"):
+                    tiles = gather()
+            else:
                 tiles = gather()
-        else:
-            tiles = gather()
+        except Exception:
+            # A failed gather must report "not handled" so the caller
+            # falls back to freeing the blocks — raising past it would
+            # leak them (nothing downstream knows they exist).
+            return False
         # The snapshot owns its data: the blocks can go back to the
         # free list NOW — later pool writes build new pool arrays and
         # never reach it (see paged_kv.gather_blocks).
@@ -1395,16 +1401,23 @@ class ContinuousBatchingEngine:
                     return False
                 claimed = self.kv_spill.claim(ids, max_len=n - 1)
                 if claimed is not None and claimed[1] > dev_m:
-                    if reused is not None:
-                        entry, m, _suffix, _sb = reused
-                        if self.share_prefix:
-                            self.prefix_cache.unshare(entry, m)
-                        else:
-                            self.prefix_cache.untake(entry, m)
-                        reused = None
-                    self._note_prefix_hit("host")
-                    self._start_prefill(req, slot_ix, ids, n, bucket,
-                                        budget, promote=claimed)
+                    try:
+                        if reused is not None:
+                            entry, m, _suffix, _sb = reused
+                            if self.share_prefix:
+                                self.prefix_cache.unshare(entry, m)
+                            else:
+                                self.prefix_cache.untake(entry, m)
+                            reused = None
+                        self._note_prefix_hit("host")
+                        self._start_prefill(req, slot_ix, ids, n, bucket,
+                                            budget, promote=claimed)
+                    except BaseException:
+                        # The claim pinned the spill entry; until
+                        # _start_prefill publishes the promotion the
+                        # pin is ours to drop, or it never unpins.
+                        self.kv_spill.release(claimed[0], promoted=False)
+                        raise
                     return True
                 if claimed is not None:
                     # The peeked entry shrank/died before the claim:
@@ -1451,7 +1464,15 @@ class ContinuousBatchingEngine:
                 if (m % bs) != 0:
                     boundary_src = entry.cache["blocks"][n_full]
                 self.allocator.share(shared)
-                priv = self._alloc_evicting(need - n_full)
+                try:
+                    priv = self._alloc_evicting(need - n_full)
+                except BaseException:
+                    # _alloc_evicting can raise out of the eviction
+                    # walk; the share incref and the cache hit must
+                    # both unwind or the parked entry leaks a sharer.
+                    self.allocator.free(shared)
+                    self.prefix_cache.unshare(entry, m)
+                    raise
                 if priv is None:
                     self.allocator.free(shared)       # decref only
                     # unshare() reverses the cache's hit into a miss;
@@ -1583,11 +1604,21 @@ class ContinuousBatchingEngine:
             except BaseException:
                 self.allocator.free(blocks)  # don't leak pool blocks
                 raise
-        ttft_ms = (time.perf_counter() - req.t_submit) * 1000.0
-        # The other half of the TTFT split (see the stamp at the top):
-        # for a monolithic prefill it is the one compiled call's wall.
-        obs_spans.annotate(req.trace, prefill_wait_ms=round(
-            max(0.0, ttft_ms - wait_ms), 3))
+        try:
+            ttft_ms = (time.perf_counter() - req.t_submit) * 1000.0
+            # The other half of the TTFT split (see the stamp at the
+            # top): for a monolithic prefill it is the one compiled
+            # call's wall.
+            obs_spans.annotate(req.trace, prefill_wait_ms=round(
+                max(0.0, ttft_ms - wait_ms), 3))
+        except BaseException:
+            # Blocks aren't owned by a slot until _slot_go_live below
+            # publishes them; an annotate failure here would otherwise
+            # strand them (refcounted: shared blocks just decref).
+            self.allocator.free(blocks)
+            if pinned_entry is not None:
+                self.prefix_cache.unpin(pinned_entry)
+            raise
 
         self._slot_go_live(req, slot_ix, blocks, prompt_len=n,
                            prompt_ids=tuple(ids), budget=budget, temp=temp,
@@ -1653,10 +1684,14 @@ class ContinuousBatchingEngine:
         blocks = self._alloc_evicting(need)
         if blocks is None:
             return False                     # still starved: stay at head
-        self._rng, rng = jax.random.split(self._rng)
-        temp = (self.tier.temperature if req.temperature is None
-                else req.temperature)
         try:
+            # The rng split stays under this handler (and after the
+            # starvation check above): a raise from here on must free
+            # the replay's blocks, and a starved retry must not burn a
+            # stream position.
+            self._rng, rng = jax.random.split(self._rng)
+            temp = (self.tier.temperature if req.temperature is None
+                    else req.temperature)
             tokens = np.full((1, bucket), self.tokenizer.pad_id, np.int32)
             tokens[0, :len(seq)] = seq
             with obs_spans.span(req.trace, "prefill", bucket=bucket,
@@ -1692,11 +1727,11 @@ class ContinuousBatchingEngine:
             from ..utils import roofline
             self.phases.add_work("prefill", **roofline.prefill_work(
                 self.cfg, bucket, 0, wbytes=self._wbytes))
+            obs_spans.event(req.trace, "replay", replayed_tokens=len(seq),
+                            generated=len(gen))
         except BaseException:
             self.allocator.free(blocks)      # don't leak pool blocks
             raise
-        obs_spans.event(req.trace, "replay", replayed_tokens=len(seq),
-                        generated=len(gen))
         self._slot_go_live(req, slot_ix, blocks, prompt_len=n,
                            prompt_ids=tuple(ids), budget=budget, temp=temp,
                            max_blocks=max_blocks, pos=len(seq), gen=gen,
@@ -1752,10 +1787,13 @@ class ContinuousBatchingEngine:
             pf.promote_nb = -(-m // bs)
             obs_spans.event(req.trace, "kv_promote_start",
                             matched_tokens=m, blocks=pf.promote_nb)
-        self._prefill = pf
         obs_spans.event(req.trace, "prefill_chunked", tokens=len(seq),
                         chunk_tokens=self.chunk_tokens,
                         replayed=bool(gen))
+        # Publication is the LAST statement: once self._prefill is set,
+        # the promotion pin belongs to the prefill machinery, and the
+        # caller's exception handler must not also release it.
+        self._prefill = pf
 
     def _advance_prefill(self) -> bool:
         """Spend up to ``chunk_budget`` tokens advancing the in-flight
@@ -2071,13 +2109,19 @@ class ContinuousBatchingEngine:
                 if fresh is None:
                     self._preempt(ix)
                     break
-                with self.profiler.phase("cow_copy"):
-                    self.pool = self._cow_copy_fn()(
-                        self.pool, jnp.asarray(slot.blocks[i], jnp.int32),
-                        jnp.asarray(fresh[0], jnp.int32))
-                    self.pool_d = self._cow_copy_fn_d()(
-                        self.pool_d, jnp.asarray(slot.blocks[i], jnp.int32),
-                        jnp.asarray(fresh[0], jnp.int32))
+                try:
+                    with self.profiler.phase("cow_copy"):
+                        self.pool = self._cow_copy_fn()(
+                            self.pool, jnp.asarray(slot.blocks[i], jnp.int32),
+                            jnp.asarray(fresh[0], jnp.int32))
+                        self.pool_d = self._cow_copy_fn_d()(
+                            self.pool_d, jnp.asarray(slot.blocks[i], jnp.int32),
+                            jnp.asarray(fresh[0], jnp.int32))
+                except BaseException:
+                    # The copy never landed: the slot still maps the
+                    # shared block, so only the private copy unwinds.
+                    self.allocator.free(fresh)
+                    raise
                 shared = slot.blocks[i]
                 slot.blocks[i] = fresh[0]
                 self.allocator.free([shared])    # decref: sharers keep it
@@ -2778,6 +2822,11 @@ class ContinuousBatchingEngine:
             shutdown = EngineStoppedError(error_dict(
                 f"Request failed: tier {self.tier.name} engine stopped "
                 f"mid-flight"))
+            # The in-flight chunked prefill holds blocks and possibly a
+            # spill-promotion pin; cancel BEFORE the cache clear and the
+            # spill stop so both unwind into live stores.  The requeued
+            # request drains through the shutdown loop below.
+            self._cancel_prefill("stop")
             if self.prefix_cache is not None:
                 self.prefix_cache.clear()    # parked blocks → free list
                 # (_try_demote stands down once _stop is set, so clear
@@ -2799,6 +2848,23 @@ class ContinuousBatchingEngine:
                 if req.token_queue is not None:
                     req.token_queue.put(None)
                 req.done.set()
+            from ..config_registry import env_flag
+            if env_flag("DLLM_KV_LEAK_CHECK"):
+                # Dynamic twin of the lint's own-leak-on-path rule: with
+                # every slot failed, the cache cleared, the prefill
+                # cancelled and the spill drained, any surviving
+                # refcount or pin is a leaked acquire on some path the
+                # static pass was talked out of (or suppressed).
+                stats = self.allocator.ref_stats()
+                assert stats["allocated_blocks"] == 0, (
+                    f"DLLM_KV_LEAK_CHECK: {stats['allocated_blocks']} "
+                    f"block(s) still allocated after engine stop() "
+                    f"(total_refs={stats['total_refs']})")
+                if self.kv_spill is not None:
+                    pinned = self.kv_spill.stats()["pinned_entries"]
+                    assert pinned == 0, (
+                        f"DLLM_KV_LEAK_CHECK: {pinned} spill entry "
+                        f"pin(s) still held after engine stop()")
 
     def submit(self, history: History,
                max_new_tokens: Optional[int] = None,
@@ -3222,20 +3288,26 @@ class ContinuousBatchingEngine:
             # parked KV.
             blks = self.allocator.alloc(2)
             if blks is not None:
-                self.pool = self._cow_copy_fn()(
-                    self.pool, jnp.asarray(blks[0], jnp.int32),
-                    jnp.asarray(blks[1], jnp.int32))
-                jax.block_until_ready(self.pool["k"])
-                self.allocator.free(blks)
+                try:
+                    self.pool = self._cow_copy_fn()(
+                        self.pool, jnp.asarray(blks[0], jnp.int32),
+                        jnp.asarray(blks[1], jnp.int32))
+                    jax.block_until_ready(self.pool["k"])
+                finally:
+                    # A warmup compile failure must not strand the pair
+                    # for the engine's whole lifetime.
+                    self.allocator.free(blks)
                 beat()
                 if self.spec:
                     blks = self.allocator.alloc(2)
                     if blks is not None:
-                        self.pool_d = self._cow_copy_fn_d()(
-                            self.pool_d, jnp.asarray(blks[0], jnp.int32),
-                            jnp.asarray(blks[1], jnp.int32))
-                        jax.block_until_ready(self.pool_d["k"])
-                        self.allocator.free(blks)
+                        try:
+                            self.pool_d = self._cow_copy_fn_d()(
+                                self.pool_d, jnp.asarray(blks[0], jnp.int32),
+                                jnp.asarray(blks[1], jnp.int32))
+                            jax.block_until_ready(self.pool_d["k"])
+                        finally:
+                            self.allocator.free(blks)
                         beat()
         if self.prefix_cache is not None and self._buckets:
             row = self._table_row([])

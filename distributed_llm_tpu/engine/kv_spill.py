@@ -124,6 +124,51 @@ class HostKVSpill:
                               if e.pins == 0)
             return self._bytes - reclaimable + nbytes <= self.budget_bytes
 
+    def _reserve(self, entry: "HostEntry", nbytes: int) -> bool:
+        """Make room for ``entry`` and register it — all or nothing.
+
+        Two kill sets, PLANNED before anything is touched: entries the
+        new one extends (or duplicates) — the device cache's put() rule,
+        without which the promote → re-park → evict → demote cycle would
+        accumulate a stale shorter copy per session and halve the
+        budget's reach — and unpinned LRU victims evicted to fit.
+        Entries with a promotion in flight stay (the promotion reads
+        their buffers).  When even evicting every unpinned entry cannot
+        fit the newcomer, NOTHING is destroyed: returning False with a
+        dead twin would trade a promotable resident entry for nothing
+        (the destroy-then-fail bug this helper exists to prevent)."""
+        with self._lock:
+            ids_t = entry.ids
+            twins = [e for e in self._entries
+                     if (e.pins == 0 and e.state is not DEAD
+                         and ids_t[:len(e.ids)] == e.ids)]
+            avail = self._bytes - sum(e.nbytes for e in twins)
+            victims = []
+            if avail + nbytes > self.budget_bytes:
+                for e in self._entries:
+                    if e.pins != 0 or e in twins:
+                        continue
+                    victims.append(e)
+                    avail -= e.nbytes
+                    if avail + nbytes <= self.budget_bytes:
+                        break
+                if avail + nbytes > self.budget_bytes:
+                    return False          # everything pinned: no room
+            for e in twins:
+                e.state = DEAD
+                e.tiles = None
+                self._entries.remove(e)
+                self._bytes -= e.nbytes
+            for e in victims:
+                e.state = DEAD
+                e.tiles = None
+                self._entries.remove(e)
+                self._bytes -= e.nbytes
+                self.evictions_total += 1
+            self._bytes += nbytes
+            self._entries.append(entry)
+            return True
+
     def offer(self, ids: Sequence[int], dev_tiles: Any, nbytes: int,
               nb: int) -> bool:
         """Register a demotion: reserve budget (evicting unpinned LRU
@@ -134,35 +179,10 @@ class HostKVSpill:
         if self._stopping.is_set() or nbytes > self.budget_bytes:
             return False
         entry = HostEntry(tuple(ids), nb, int(nbytes))
-        with self._lock:
-            # Replace any entry this one extends (or duplicates) — the
-            # device cache's put() rule, without which the promote →
-            # re-park → evict → demote cycle would accumulate a stale
-            # shorter copy per session and halve the budget's reach.
-            # Entries with a promotion in flight stay (the promotion
-            # reads their buffers); the longer twin still lands.
-            ids_t = entry.ids
-            for e in list(self._entries):
-                if (e.pins == 0 and e.state is not DEAD
-                        and ids_t[:len(e.ids)] == e.ids):
-                    e.state = DEAD
-                    e.tiles = None
-                    self._entries.remove(e)
-                    self._bytes -= e.nbytes
-            while self._bytes + nbytes > self.budget_bytes:
-                victim_ix = next(
-                    (i for i, e in enumerate(self._entries)
-                     if e.pins == 0), None)
-                if victim_ix is None:
-                    self.demotions_dropped += 1
-                    return False          # everything pinned: no room
-                victim = self._entries.pop(victim_ix)
-                victim.state = DEAD
-                victim.tiles = None
-                self._bytes -= victim.nbytes
-                self.evictions_total += 1
-            self._bytes += nbytes
-            self._entries.append(entry)
+        if not self._reserve(entry, int(nbytes)):
+            with self._lock:
+                self.demotions_dropped += 1
+            return False                  # everything pinned: no room
         try:
             self._jobs.put_nowait((entry, dev_tiles))
         except queue.Full:
@@ -207,30 +227,14 @@ class HostKVSpill:
                 or nbytes > self.budget_bytes):
             return False
         entry = HostEntry(tuple(ids), int(nb), nbytes)
+        # RESIDENT before publication: _reserve appends under the lock,
+        # and the entry must never be observable in a COPYING limbo a
+        # concurrent offer()'s twin-kill could reap.
+        entry.tiles = dict(tiles)
+        entry.state = RESIDENT
+        if not self._reserve(entry, nbytes):
+            return False
         with self._lock:
-            ids_t = entry.ids
-            for e in list(self._entries):
-                if (e.pins == 0 and e.state is not DEAD
-                        and ids_t[:len(e.ids)] == e.ids):
-                    e.state = DEAD
-                    e.tiles = None
-                    self._entries.remove(e)
-                    self._bytes -= e.nbytes
-            while self._bytes + nbytes > self.budget_bytes:
-                victim_ix = next(
-                    (i for i, e in enumerate(self._entries)
-                     if e.pins == 0), None)
-                if victim_ix is None:
-                    return False
-                victim = self._entries.pop(victim_ix)
-                victim.state = DEAD
-                victim.tiles = None
-                self._bytes -= victim.nbytes
-                self.evictions_total += 1
-            entry.tiles = dict(tiles)
-            entry.state = RESIDENT
-            self._bytes += nbytes
-            self._entries.append(entry)
             self.demotions_total += 1
         self._mirror_counter("kv_demotions")
         return True

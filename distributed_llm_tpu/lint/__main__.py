@@ -17,6 +17,7 @@ file can create a finding in another.
 from __future__ import annotations
 
 import argparse
+import json
 import subprocess
 import sys
 
@@ -67,6 +68,12 @@ def main(argv=None) -> int:
                         help="list checkers and rule ids, then exit")
     parser.add_argument("--show-suppressed", action="store_true",
                         help="also print suppressed findings")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output: a JSON object "
+                             "with a stable per-finding schema (rule, "
+                             "path, line, message, suppressed) so CI "
+                             "and bench tooling can diff finding sets "
+                             "across rounds; exit codes unchanged")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -102,6 +109,28 @@ def main(argv=None) -> int:
         return 2
     if changed:
         result = filter_changed(result, changed, all_checkers())
+    if args.as_json:
+        # Stable schema — additions only, never renames: tooling diffs
+        # finding sets across lint versions.  Suppressed findings are
+        # ALWAYS included (flagged), so a suppression shows up in the
+        # diff the same round it lands.
+        payload = {
+            "findings": [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "message": f.message, "suppressed": False}
+                for f in result.findings
+            ] + [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "message": f.message, "suppressed": True}
+                for f, _kind in result.suppressed
+            ],
+            "counts": {"findings": len(result.findings),
+                       "suppressed": len(result.suppressed)},
+            "ok": result.ok,
+        }
+        json.dump(payload, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0 if result.ok else 1
     for finding in result.findings:
         print(finding.render())
     if args.show_suppressed:

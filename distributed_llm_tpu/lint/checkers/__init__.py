@@ -15,8 +15,10 @@ from .config_drift import ConfigDriftChecker
 from .error_shape import ErrorShapeChecker
 from .jit_purity import JitPurityChecker
 from .locks import LockChecker
+from .metrics_discipline import MetricsDisciplineChecker
 from .obs_discipline import (ObsDisciplineChecker,
                              ProfilerDisciplineChecker)
+from .ownership import OwnershipChecker
 from .retrace import RetraceChecker
 from .span_discipline import SpanDisciplineChecker
 from .thread_lifecycle import ThreadLifecycleChecker
@@ -35,4 +37,6 @@ def all_checkers() -> List[Checker]:
         RetraceChecker(),
         TransferChecker(),
         ThreadLifecycleChecker(),
+        OwnershipChecker(),
+        MetricsDisciplineChecker(),
     ]

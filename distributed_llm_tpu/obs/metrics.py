@@ -286,315 +286,349 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+# -- the metric registry ------------------------------------------------------
+#
+# Every dllm_* family the serving stack emits, declared ONCE as data:
+# (attribute, kind, name, label names, help).  ServingMetrics
+# materializes the rows; METRICS.md is generated from them
+# (``python -m distributed_llm_tpu.obs.metrics > METRICS.md``); the
+# ``metrics_discipline`` lint checker fails tier-1 when an emission
+# site and this table disagree in either direction, and every label
+# name must carry a cardinality bound in BOUNDED_LABELS below.  Rows
+# are PURE LITERALS (the checker reads them from the AST).
+
+METRIC_REGISTRY: Tuple[Tuple[str, str, str, Tuple[str, ...], str], ...] = (
+    ("requests", "counter", "dllm_requests_total",
+     ("strategy", "tier", "outcome"),
+     "Requests completed, by strategy/tier/outcome (outcome: "
+     "ok|error|degraded)"),
+    ("ttft_ms", "histogram", "dllm_ttft_ms", ("strategy",),
+     "Time to first token per request (engine-true when reported, else "
+     "first observed token)"),
+    ("tbt_ms", "histogram", "dllm_tbt_ms", ("strategy",),
+     "Mean time between tokens per request"),
+    ("queue_wait_ms", "histogram", "dllm_queue_wait_ms", ("tier",),
+     "Submit-to-batch-slot-admission wait in the tier's engine"),
+    ("request_ms", "histogram", "dllm_request_ms", ("strategy",),
+     "End-to-end routed request wall time"),
+    ("admission_rejected", "counter", "dllm_admission_rejected_total",
+     ("tier",),
+     "Requests shed by tier admission control"),
+    ("retries", "counter", "dllm_retries_total", ("tier",),
+     "Same-tier transient-error retries"),
+    ("failovers", "counter", "dllm_failovers_total", ("tier", "kind"),
+     "Tier failovers, by failed tier and kind (sync|stream_setup|"
+     "mid_stream)"),
+    ("breaker_transitions", "counter", "dllm_breaker_transitions_total",
+     ("tier", "to"),
+     "Circuit-breaker state transitions, by tier and target state"),
+    ("breaker_state", "gauge", "dllm_breaker_state", ("tier",),
+     "Circuit state per tier (0=closed, 1=half_open, 2=open)"),
+    ("watchdog_wedged", "counter", "dllm_watchdog_wedged_total", ("tier",),
+     "Decode-watchdog wedge declarations (health flips ok=False)"),
+    ("cache_hits", "counter", "dllm_cache_hits_total", ("cache",),
+     "Cache hits by tier of cache (response|response_degraded|"
+     "routing|prefix_affinity)"),
+    ("degraded", "counter", "dllm_degraded_total", (),
+     "Requests served by the degraded path (all circuits open)"),
+    ("flight_records", "counter", "dllm_flight_records_total", ("reason",),
+     "Flight-recorder captures by reason (error|degraded|slow)"),
+    # Resource-pressure family (PR 5): KV-aware admission, mid-decode
+    # preemption with replay, context-overflow policy, graceful drain.
+    ("preemptions", "counter", "dllm_preemptions_total", ("tier",),
+     "Mid-decode slot preemptions under KV block starvation "
+     "(victim replays byte-identically on re-admission)"),
+    ("kv_admission_rejected", "counter", "dllm_kv_admission_rejected_total",
+     ("tier",),
+     "Requests shed because projected KV block demand exceeded "
+     "free + reclaimable pool blocks"),
+    ("overflow", "counter", "dllm_overflow_total", ("tier", "action"),
+     "Context-overflow policy applications at the router, by tier "
+     "and action (rejected|truncated)"),
+    ("drained_requests", "counter", "dllm_drained_requests_total", ("tier",),
+     "In-flight requests completed during a graceful drain"),
+    # Ragged-decode family (PR 6): the serving path must SHOW which
+    # attention kernel is actually running a tier's decode ticks and
+    # what each tick costs — cross-round perf deltas get attributed
+    # to a kernel, not guessed.
+    ("decode_tick_ms", "histogram", "dllm_decode_tick_ms", ("tier",),
+     "Batched decode tick device time (decode_steps_per_tick "
+     "fused steps per observation)"),
+    ("decode_ticks", "counter", "dllm_decode_ticks_total",
+     ("tier", "kind", "impl"),
+     "Batched decode ticks, by attention dispatch kind "
+     "(ragged_decode|paged_decode[+_q8]) and the impl the "
+     "measured table chose (xla|pallas)"),
+    ("compiled_programs", "gauge", "dllm_compiled_programs",
+     ("tier", "stage"),
+     "Distinct compiled XLA programs the batched engine has "
+     "minted, by stage (prefill|chunk_prefill|writer|decode) — "
+     "decode pins at 1 under ragged attention; growth is logged"),
+    # Chunked-prefill family (PR 9): long prompts are absorbed one
+    # chunk per tick between decode ticks — the chunk histogram IS
+    # the TBT bound the design promises (an active stream stalls at
+    # most one chunk grant), and the backlog gauge shows a long
+    # prompt mid-absorption behind a TTFT spike.
+    ("prefill_chunk_ms", "histogram", "dllm_prefill_chunk_ms", ("tier",),
+     "Device time of one interleaved prefill chunk — the upper "
+     "bound a chunked admission adds to active streams' "
+     "time-between-tokens per tick"),
+    # Batched-speculation family (ISSUE 15): drafted vs accepted
+    # draft tokens per tier (the counter pair whose ratio IS the
+    # realized acceptance rate) and the engine's running acceptance
+    # ratio mirrored by the system-state sampler — an operator reads
+    # whether speculation is paying for its draft FLOPs without
+    # diffing counters.
+    ("spec_drafted", "counter", "dllm_spec_drafted_total", ("tier",),
+     "Draft tokens proposed by batched speculative decoding "
+     "(per-slot γ summed over rounds)"),
+    ("spec_accepted", "counter", "dllm_spec_accepted_total", ("tier",),
+     "Draft tokens accepted by the fused verify's greedy "
+     "acceptance rule"),
+    ("spec_accept_ratio_g", "gauge", "dllm_spec_accept_ratio", ("tier",),
+     "Engine-lifetime accepted/drafted ratio for batched "
+     "speculation (sampled; absent until the first draft)"),
+    ("prefill_backlog_g", "gauge", "dllm_prefill_backlog", ("tier",),
+     "Prompt tokens of the in-flight chunked prefill not yet "
+     "absorbed (sampled by the system-state sampler; 0 = no "
+     "prefill in flight)"),
+    # System-state timeline family (PR 7, obs/sampler.py): the
+    # background sampler mirrors its latest per-tier sample to these
+    # gauges so dashboards plot the same series the timeline ring
+    # stores.  The *_g attribute suffix keeps them apart from the
+    # identically-themed request-path counters above.
+    ("queue_depth_g", "gauge", "dllm_queue_depth", ("tier",),
+     "Requests waiting beyond the tier's batch slots (sampled)"),
+    ("active_slots_g", "gauge", "dllm_active_slots", ("tier",),
+     "Busy batch slots per tier (sampled)"),
+    ("max_slots_g", "gauge", "dllm_max_slots", ("tier",),
+     "Configured batch slots per tier (sampled)"),
+    ("kv_free_blocks_g", "gauge", "dllm_kv_free_blocks", ("tier",),
+     "Free paged-KV pool blocks per tier (sampled)"),
+    ("kv_reclaimable_blocks_g", "gauge", "dllm_kv_reclaimable_blocks",
+     ("tier",),
+     "Pool blocks reclaimable by evicting parked prefixes "
+     "(sampled; under shared-prefix KV only refcount-1 blocks of "
+     "unpinned entries count — what an eviction sweep could "
+     "actually free)"),
+    # Shared-prefix KV family (ISSUE 10): how much physical pool the
+    # refcounted copy-on-write sharing is saving, and what kind of
+    # prefix-cache hits admissions are taking.
+    ("kv_shared_blocks_g", "gauge", "dllm_kv_shared_blocks", ("tier",),
+     "Physical pool blocks with >= 2 holders (live slots mapping "
+     "a shared prefix read-only and/or parked entries; sampled)"),
+    ("kv_dedup_ratio_g", "gauge", "dllm_kv_dedup_ratio", ("tier",),
+     "Logical block references / physical allocated blocks — the "
+     "factor shared-prefix KV multiplies the effective pool by "
+     "(1.0 = nothing shared; sampled)"),
+    ("prefix_hits", "counter", "dllm_prefix_hits_total", ("tier", "kind"),
+     "Prefix-cache lookup outcomes on the batched admit path, "
+     "per admission attempt (shared = pinned read-only mapping, "
+     "exclusive = take-ownership reuse, host = spill-tier "
+     "promotion claim, miss = cold prefill)"),
+    # Hierarchical-KV spill family (ISSUE 14, engine/kv_spill.py):
+    # the host tier's occupancy and the demote/promote lifecycle —
+    # warm TTFT as a function of host-RAM size must be observable,
+    # and a promotion losing its race must be countable.
+    ("kv_host_blocks_g", "gauge", "dllm_kv_host_blocks", ("tier",),
+     "Pool-block equivalents of demoted prefix KV resident in "
+     "the host spill tier (sampled)"),
+    ("kv_host_bytes_g", "gauge", "dllm_kv_host_bytes", ("tier",),
+     "Host bytes held by the KV spill tier against "
+     "TierConfig.host_kv_bytes (sampled)"),
+    ("kv_promote_backlog_g", "gauge", "dllm_kv_promote_backlog", ("tier",),
+     "Blocks the in-flight promotion still has to land "
+     "host→device (sampled; 0 = no promotion in flight)"),
+    ("kv_demotions", "counter", "dllm_kv_demotions_total", ("tier",),
+     "Prefix-cache evictions demoted to the host spill tier "
+     "(copy landed; the async device→host copy drains on the "
+     "spill copier, never the tick)"),
+    ("kv_promotions", "counter", "dllm_kv_promotions_total", ("tier",),
+     "Demoted prefixes promoted back to the device pool "
+     "(budgeted host→device grants riding the chunked-prefill "
+     "lane)"),
+    ("kv_promotion_races", "counter", "dllm_kv_promotion_races_total",
+     ("tier",),
+     "Promotions that lost the race (entry invalidated / copier "
+     "stalled) and fell back to a byte-identical cold prefill"),
+    ("tier_draining_g", "gauge", "dllm_tier_draining", ("tier",),
+     "1 while the tier is gracefully draining, else 0 (sampled)"),
+    ("decode_tick_p50_g", "gauge", "dllm_decode_tick_p50_ms", ("tier",),
+     "p50 decode-tick device time over the engine's recent-tick "
+     "ring (sampled)"),
+    # SLO / goodput family (PR 7, obs/slo.py): fed from the router's
+    # exactly-once _finish_request exit (obs_discipline lint pins the
+    # single feed site).
+    ("slo_goodput", "gauge", "dllm_slo_goodput", ("strategy", "tier"),
+     "Sliding-window fraction of requests meeting the tier's SLO "
+     "(TTFT and p95 TBT targets)"),
+    ("slo_violations", "counter", "dllm_slo_violations_total", ("kind",),
+     "Requests missing their SLO, by kind (error|ttft|tbt)"),
+    ("overload_incidents", "counter", "dllm_overload_incidents_total",
+     ("tier",),
+     "Rising-edge overload incidents (tier goodput under the "
+     "floor); each lands in the flight recorder with a timeline "
+     "slice"),
+    # Tick-forensics family (ISSUE 11, obs/profiler.py): per-request
+    # device-time / KV-residency attribution aggregated at the
+    # router's exactly-once completion exit, plus sampled per-phase
+    # tick breakdown gauges — the accounting substrate per-tenant
+    # quotas and goodput-per-replica-second economics bill against.
+    ("device_time", "counter", "dllm_device_time_ms_total",
+     ("tier", "strategy", "session"),
+     "Attributed decode device time (each tick's device ms "
+     "divided across the slots it served), per serving tier, "
+     "strategy and session ('-' = sessionless)"),
+    ("kv_block_ticks", "counter", "dllm_kv_block_ticks_total",
+     ("tier", "strategy", "session"),
+     "Attributed KV residency: pool blocks held x decode ticks, "
+     "shared prefix blocks charged 1/refcount to each holder"),
+    ("tick_phase_p50_g", "gauge", "dllm_tick_phase_p50_ms",
+     ("tier", "phase"),
+     "p50 per-tick SELF time of one scheduler phase (admit|"
+     "prefill|cow_copy|table_upload|decode|emit|chunk_prefill) "
+     "over the profiler ring's recent tail (sampled)"),
+    ("profile_coverage_g", "gauge", "dllm_profile_coverage", ("tier",),
+     "Fraction of tick wall time covered by stamped phase self-"
+     "times (sampled; the bench profile leg pins >= 0.95)"),
+    # Replicated-tier family (ISSUE 12, serving/replicas.py): how
+    # dispatch chose among a tier's engine replicas, and how much of
+    # the tier's replica capacity is currently healthy.
+    ("replica_routed", "counter", "dllm_replica_routed_total",
+     ("tier", "policy"),
+     "Requests dispatched to a tier replica, by how the replica "
+     "was chosen (affinity|affinity_overridden|least_loaded|"
+     "random|single|breaker_fallback)"),
+    ("replica_healthy_g", "gauge", "dllm_replica_healthy", ("tier",),
+     "Replicas of the tier currently serving (running, not "
+     "wedged, breaker not open) out of TierConfig.replicas "
+     "(sampled)"),
+    # Elastic-capacity family (ISSUE 18, serving/autoscaler.py):
+    # live membership and the autoscaler's actuation decisions.
+    ("replica_count_g", "gauge", "dllm_replica_count", ("tier",),
+     "Live replica membership of the tier — static it equals "
+     "TierConfig.replicas; under the autoscaler it moves between "
+     "autoscale_min_replicas and autoscale_max_replicas "
+     "(sampled)"),
+    ("autoscale_events", "counter", "dllm_autoscale_events_total",
+     ("tier", "direction", "reason"),
+     "Autoscaler membership transitions, by direction (up|down) "
+     "and the signal that fired them (goodput_floor|queue_growth"
+     "|shed|idle|manual)"),
+    # Per-tenant isolation family (ISSUE 17, serving/tenants.py):
+    # the measured bill and enforcement decisions per tenant.  Every
+    # ``tenant`` label value MUST pass through a BoundedLabels set
+    # (64-char truncation, 256 distinct then '~overflow') — metric
+    # children are permanent, so an unbounded tenant flood would
+    # otherwise grow /metrics without bound.
+    ("tenant_device_time", "counter", "dllm_tenant_device_time_ms_total",
+     ("tier", "tenant"),
+     "Attributed decode device time billed to the tenant "
+     "(PR 11 per-request attribution, '-' = tenantless direct "
+     "engine use)"),
+    ("tenant_kv_block_ticks", "counter",
+     "dllm_tenant_kv_block_ticks_total", ("tier", "tenant"),
+     "Attributed KV residency billed to the tenant (blocks held "
+     "x decode ticks at 1/refcount)"),
+    ("tenant_rejected", "counter", "dllm_tenant_rejected_total",
+     ("tier", "tenant"),
+     "Requests shed by per-tenant quota enforcement (in-flight/"
+     "queue caps, device-time token bucket, or KV budget)"),
+    ("tenant_inflight_g", "gauge", "dllm_tenant_inflight",
+     ("tier", "tenant"),
+     "Requests a tenant currently has admitted against its "
+     "quota (in flight or waiting)"),
+    ("tenant_goodput_g", "gauge", "dllm_tenant_goodput", ("tenant",),
+     "Sliding-window fraction of the tenant's requests meeting "
+     "their SLO (obs/slo.py per-tenant windows)"),
+)
+
+# Every label name in METRIC_REGISTRY carries its cardinality bound
+# here — metric children are permanent, so a label without a bound is
+# a /metrics memory leak waiting for a hostile client.  The
+# ``metrics_discipline`` checker fails tier-1 on a registry label
+# missing from this table.  Closed sets are enforced by the emitting
+# call sites; open (caller-supplied) sets MUST ride a BoundedLabels.
+
+BOUNDED_LABELS: Dict[str, str] = {
+    "strategy": "closed set: the router's routing strategies "
+                "(serving/router.py STRATEGIES)",
+    "tier": "closed set: config-enumerated tier names (TierConfig)",
+    "outcome": "closed set: ok|error|degraded",
+    "kind": "closed per-family enums (failover / dispatch / SLO-violation"
+            " / prefix-hit kinds; see each family's help)",
+    "to": "closed set: breaker states closed|half_open|open",
+    "cache": "closed set: response|response_degraded|routing|"
+             "prefix_affinity",
+    "reason": "closed per-family enums (flight-record triggers, "
+              "autoscale signals)",
+    "action": "closed set: rejected|truncated",
+    "impl": "closed set: xla|pallas",
+    "stage": "closed set: prefill|chunk_prefill|writer|decode",
+    "phase": "closed set: admit|prefill|cow_copy|table_upload|decode|"
+             "emit|chunk_prefill",
+    "session": "open set: BoundedLabels(cap=256) — 64-char truncation, "
+               "257th distinct value collapses to '~overflow'",
+    "tenant": "open set: BoundedLabels(cap=256) — 64-char truncation, "
+              "257th distinct value collapses to '~overflow'",
+    "policy": "closed set: affinity|affinity_overridden|least_loaded|"
+              "random|single|breaker_fallback",
+    "direction": "closed set: up|down",
+}
+
+
 class ServingMetrics:
-    """The serving stack's standard metric set, declared once so the
-    router, breaker hooks, engine managers, /metrics, and bench.py all
-    read/write the same families (one assembler, no name drift)."""
+    """The serving stack's standard metric set, materialized from
+    METRIC_REGISTRY so the router, breaker hooks, engine managers,
+    /metrics, and bench.py all read/write the same families (one
+    assembler, no name drift — the table above is the only place a
+    family is declared)."""
 
     def __init__(self, registry: MetricsRegistry):
         self.registry = registry
-        self.requests = registry.counter(
-            "dllm_requests_total",
-            "Requests completed, by strategy/tier/outcome (outcome: "
-            "ok|error|degraded)", ("strategy", "tier", "outcome"))
-        self.ttft_ms = registry.histogram(
-            "dllm_ttft_ms", "Time to first token per request (engine-true "
-            "when reported, else first observed token)", ("strategy",))
-        self.tbt_ms = registry.histogram(
-            "dllm_tbt_ms", "Mean time between tokens per request",
-            ("strategy",))
-        self.queue_wait_ms = registry.histogram(
-            "dllm_queue_wait_ms", "Submit-to-batch-slot-admission wait in "
-            "the tier's engine", ("tier",))
-        self.request_ms = registry.histogram(
-            "dllm_request_ms", "End-to-end routed request wall time",
-            ("strategy",))
-        self.admission_rejected = registry.counter(
-            "dllm_admission_rejected_total",
-            "Requests shed by tier admission control", ("tier",))
-        self.retries = registry.counter(
-            "dllm_retries_total", "Same-tier transient-error retries",
-            ("tier",))
-        self.failovers = registry.counter(
-            "dllm_failovers_total",
-            "Tier failovers, by failed tier and kind (sync|stream_setup|"
-            "mid_stream)", ("tier", "kind"))
-        self.breaker_transitions = registry.counter(
-            "dllm_breaker_transitions_total",
-            "Circuit-breaker state transitions, by tier and target state",
-            ("tier", "to"))
-        self.breaker_state = registry.gauge(
-            "dllm_breaker_state",
-            "Circuit state per tier (0=closed, 1=half_open, 2=open)",
-            ("tier",))
-        self.watchdog_wedged = registry.counter(
-            "dllm_watchdog_wedged_total",
-            "Decode-watchdog wedge declarations (health flips ok=False)",
-            ("tier",))
-        self.cache_hits = registry.counter(
-            "dllm_cache_hits_total",
-            "Cache hits by tier of cache (response|response_degraded|"
-            "routing|prefix_affinity)", ("cache",))
-        self.degraded = registry.counter(
-            "dllm_degraded_total",
-            "Requests served by the degraded path (all circuits open)")
-        self.flight_records = registry.counter(
-            "dllm_flight_records_total",
-            "Flight-recorder captures by reason (error|degraded|slow)",
-            ("reason",))
-        # Resource-pressure family (PR 5): KV-aware admission, mid-decode
-        # preemption with replay, context-overflow policy, graceful drain.
-        self.preemptions = registry.counter(
-            "dllm_preemptions_total",
-            "Mid-decode slot preemptions under KV block starvation "
-            "(victim replays byte-identically on re-admission)", ("tier",))
-        self.kv_admission_rejected = registry.counter(
-            "dllm_kv_admission_rejected_total",
-            "Requests shed because projected KV block demand exceeded "
-            "free + reclaimable pool blocks", ("tier",))
-        self.overflow = registry.counter(
-            "dllm_overflow_total",
-            "Context-overflow policy applications at the router, by tier "
-            "and action (rejected|truncated)", ("tier", "action"))
-        self.drained_requests = registry.counter(
-            "dllm_drained_requests_total",
-            "In-flight requests completed during a graceful drain",
-            ("tier",))
-        # Ragged-decode family (PR 6): the serving path must SHOW which
-        # attention kernel is actually running a tier's decode ticks and
-        # what each tick costs — cross-round perf deltas get attributed
-        # to a kernel, not guessed.
-        self.decode_tick_ms = registry.histogram(
-            "dllm_decode_tick_ms",
-            "Batched decode tick device time (decode_steps_per_tick "
-            "fused steps per observation)", ("tier",))
-        self.decode_ticks = registry.counter(
-            "dllm_decode_ticks_total",
-            "Batched decode ticks, by attention dispatch kind "
-            "(ragged_decode|paged_decode[+_q8]) and the impl the "
-            "measured table chose (xla|pallas)", ("tier", "kind", "impl"))
-        self.compiled_programs = registry.gauge(
-            "dllm_compiled_programs",
-            "Distinct compiled XLA programs the batched engine has "
-            "minted, by stage (prefill|chunk_prefill|writer|decode) — "
-            "decode pins at 1 under ragged attention; growth is logged",
-            ("tier", "stage"))
-        # Chunked-prefill family (PR 9): long prompts are absorbed one
-        # chunk per tick between decode ticks — the chunk histogram IS
-        # the TBT bound the design promises (an active stream stalls at
-        # most one chunk grant), and the backlog gauge shows a long
-        # prompt mid-absorption behind a TTFT spike.
-        self.prefill_chunk_ms = registry.histogram(
-            "dllm_prefill_chunk_ms",
-            "Device time of one interleaved prefill chunk — the upper "
-            "bound a chunked admission adds to active streams' "
-            "time-between-tokens per tick", ("tier",))
-        # Batched-speculation family (ISSUE 15): drafted vs accepted
-        # draft tokens per tier (the counter pair whose ratio IS the
-        # realized acceptance rate) and the engine's running acceptance
-        # ratio mirrored by the system-state sampler — an operator reads
-        # whether speculation is paying for its draft FLOPs without
-        # diffing counters.
-        self.spec_drafted = registry.counter(
-            "dllm_spec_drafted_total",
-            "Draft tokens proposed by batched speculative decoding "
-            "(per-slot γ summed over rounds)", ("tier",))
-        self.spec_accepted = registry.counter(
-            "dllm_spec_accepted_total",
-            "Draft tokens accepted by the fused verify's greedy "
-            "acceptance rule", ("tier",))
-        self.spec_accept_ratio_g = registry.gauge(
-            "dllm_spec_accept_ratio",
-            "Engine-lifetime accepted/drafted ratio for batched "
-            "speculation (sampled; absent until the first draft)",
-            ("tier",))
-        self.prefill_backlog_g = registry.gauge(
-            "dllm_prefill_backlog",
-            "Prompt tokens of the in-flight chunked prefill not yet "
-            "absorbed (sampled by the system-state sampler; 0 = no "
-            "prefill in flight)", ("tier",))
-        # System-state timeline family (PR 7, obs/sampler.py): the
-        # background sampler mirrors its latest per-tier sample to these
-        # gauges so dashboards plot the same series the timeline ring
-        # stores.  The *_g attribute suffix keeps them apart from the
-        # identically-themed request-path counters above.
-        self.queue_depth_g = registry.gauge(
-            "dllm_queue_depth",
-            "Requests waiting beyond the tier's batch slots (sampled)",
-            ("tier",))
-        self.active_slots_g = registry.gauge(
-            "dllm_active_slots",
-            "Busy batch slots per tier (sampled)", ("tier",))
-        self.max_slots_g = registry.gauge(
-            "dllm_max_slots",
-            "Configured batch slots per tier (sampled)", ("tier",))
-        self.kv_free_blocks_g = registry.gauge(
-            "dllm_kv_free_blocks",
-            "Free paged-KV pool blocks per tier (sampled)", ("tier",))
-        self.kv_reclaimable_blocks_g = registry.gauge(
-            "dllm_kv_reclaimable_blocks",
-            "Pool blocks reclaimable by evicting parked prefixes "
-            "(sampled; under shared-prefix KV only refcount-1 blocks of "
-            "unpinned entries count — what an eviction sweep could "
-            "actually free)", ("tier",))
-        # Shared-prefix KV family (ISSUE 10): how much physical pool the
-        # refcounted copy-on-write sharing is saving, and what kind of
-        # prefix-cache hits admissions are taking.
-        self.kv_shared_blocks_g = registry.gauge(
-            "dllm_kv_shared_blocks",
-            "Physical pool blocks with >= 2 holders (live slots mapping "
-            "a shared prefix read-only and/or parked entries; sampled)",
-            ("tier",))
-        self.kv_dedup_ratio_g = registry.gauge(
-            "dllm_kv_dedup_ratio",
-            "Logical block references / physical allocated blocks — the "
-            "factor shared-prefix KV multiplies the effective pool by "
-            "(1.0 = nothing shared; sampled)", ("tier",))
-        self.prefix_hits = registry.counter(
-            "dllm_prefix_hits_total",
-            "Prefix-cache lookup outcomes on the batched admit path, "
-            "per admission attempt (shared = pinned read-only mapping, "
-            "exclusive = take-ownership reuse, host = spill-tier "
-            "promotion claim, miss = cold prefill)",
-            ("tier", "kind"))
-        # Hierarchical-KV spill family (ISSUE 14, engine/kv_spill.py):
-        # the host tier's occupancy and the demote/promote lifecycle —
-        # warm TTFT as a function of host-RAM size must be observable,
-        # and a promotion losing its race must be countable.
-        self.kv_host_blocks_g = registry.gauge(
-            "dllm_kv_host_blocks",
-            "Pool-block equivalents of demoted prefix KV resident in "
-            "the host spill tier (sampled)", ("tier",))
-        self.kv_host_bytes_g = registry.gauge(
-            "dllm_kv_host_bytes",
-            "Host bytes held by the KV spill tier against "
-            "TierConfig.host_kv_bytes (sampled)", ("tier",))
-        self.kv_promote_backlog_g = registry.gauge(
-            "dllm_kv_promote_backlog",
-            "Blocks the in-flight promotion still has to land "
-            "host→device (sampled; 0 = no promotion in flight)",
-            ("tier",))
-        self.kv_demotions = registry.counter(
-            "dllm_kv_demotions_total",
-            "Prefix-cache evictions demoted to the host spill tier "
-            "(copy landed; the async device→host copy drains on the "
-            "spill copier, never the tick)", ("tier",))
-        self.kv_promotions = registry.counter(
-            "dllm_kv_promotions_total",
-            "Demoted prefixes promoted back to the device pool "
-            "(budgeted host→device grants riding the chunked-prefill "
-            "lane)", ("tier",))
-        self.kv_promotion_races = registry.counter(
-            "dllm_kv_promotion_races_total",
-            "Promotions that lost the race (entry invalidated / copier "
-            "stalled) and fell back to a byte-identical cold prefill",
-            ("tier",))
-        self.tier_draining_g = registry.gauge(
-            "dllm_tier_draining",
-            "1 while the tier is gracefully draining, else 0 (sampled)",
-            ("tier",))
-        self.decode_tick_p50_g = registry.gauge(
-            "dllm_decode_tick_p50_ms",
-            "p50 decode-tick device time over the engine's recent-tick "
-            "ring (sampled)", ("tier",))
-        # SLO / goodput family (PR 7, obs/slo.py): fed from the router's
-        # exactly-once _finish_request exit (obs_discipline lint pins the
-        # single feed site).
-        self.slo_goodput = registry.gauge(
-            "dllm_slo_goodput",
-            "Sliding-window fraction of requests meeting the tier's SLO "
-            "(TTFT and p95 TBT targets)", ("strategy", "tier"))
-        self.slo_violations = registry.counter(
-            "dllm_slo_violations_total",
-            "Requests missing their SLO, by kind (error|ttft|tbt)",
-            ("kind",))
-        self.overload_incidents = registry.counter(
-            "dllm_overload_incidents_total",
-            "Rising-edge overload incidents (tier goodput under the "
-            "floor); each lands in the flight recorder with a timeline "
-            "slice", ("tier",))
-        # Tick-forensics family (ISSUE 11, obs/profiler.py): per-request
-        # device-time / KV-residency attribution aggregated at the
-        # router's exactly-once completion exit, plus sampled per-phase
-        # tick breakdown gauges — the accounting substrate per-tenant
-        # quotas and goodput-per-replica-second economics bill against.
-        self.device_time = registry.counter(
-            "dllm_device_time_ms_total",
-            "Attributed decode device time (each tick's device ms "
-            "divided across the slots it served), per serving tier, "
-            "strategy and session ('-' = sessionless)",
-            ("tier", "strategy", "session"))
-        self.kv_block_ticks = registry.counter(
-            "dllm_kv_block_ticks_total",
-            "Attributed KV residency: pool blocks held x decode ticks, "
-            "shared prefix blocks charged 1/refcount to each holder",
-            ("tier", "strategy", "session"))
-        self.tick_phase_p50_g = registry.gauge(
-            "dllm_tick_phase_p50_ms",
-            "p50 per-tick SELF time of one scheduler phase (admit|"
-            "prefill|cow_copy|table_upload|decode|emit|chunk_prefill) "
-            "over the profiler ring's recent tail (sampled)",
-            ("tier", "phase"))
-        self.profile_coverage_g = registry.gauge(
-            "dllm_profile_coverage",
-            "Fraction of tick wall time covered by stamped phase self-"
-            "times (sampled; the bench profile leg pins >= 0.95)",
-            ("tier",))
-        # Replicated-tier family (ISSUE 12, serving/replicas.py): how
-        # dispatch chose among a tier's engine replicas, and how much of
-        # the tier's replica capacity is currently healthy.
-        self.replica_routed = registry.counter(
-            "dllm_replica_routed_total",
-            "Requests dispatched to a tier replica, by how the replica "
-            "was chosen (affinity|affinity_overridden|least_loaded|"
-            "random|single|breaker_fallback)",
-            ("tier", "policy"))
-        self.replica_healthy_g = registry.gauge(
-            "dllm_replica_healthy",
-            "Replicas of the tier currently serving (running, not "
-            "wedged, breaker not open) out of TierConfig.replicas "
-            "(sampled)", ("tier",))
-        # Elastic-capacity family (ISSUE 18, serving/autoscaler.py):
-        # live membership and the autoscaler's actuation decisions.
-        self.replica_count_g = registry.gauge(
-            "dllm_replica_count",
-            "Live replica membership of the tier — static it equals "
-            "TierConfig.replicas; under the autoscaler it moves between "
-            "autoscale_min_replicas and autoscale_max_replicas "
-            "(sampled)", ("tier",))
-        self.autoscale_events = registry.counter(
-            "dllm_autoscale_events_total",
-            "Autoscaler membership transitions, by direction (up|down) "
-            "and the signal that fired them (goodput_floor|queue_growth"
-            "|shed|idle|manual)", ("tier", "direction", "reason"))
-        # Per-tenant isolation family (ISSUE 17, serving/tenants.py):
-        # the measured bill and enforcement decisions per tenant.  Every
-        # ``tenant`` label value MUST pass through a BoundedLabels set
-        # (64-char truncation, 256 distinct then '~overflow') — metric
-        # children are permanent, so an unbounded tenant flood would
-        # otherwise grow /metrics without bound.
-        self.tenant_device_time = registry.counter(
-            "dllm_tenant_device_time_ms_total",
-            "Attributed decode device time billed to the tenant "
-            "(PR 11 per-request attribution, '-' = tenantless direct "
-            "engine use)", ("tier", "tenant"))
-        self.tenant_kv_block_ticks = registry.counter(
-            "dllm_tenant_kv_block_ticks_total",
-            "Attributed KV residency billed to the tenant (blocks held "
-            "x decode ticks at 1/refcount)", ("tier", "tenant"))
-        self.tenant_rejected = registry.counter(
-            "dllm_tenant_rejected_total",
-            "Requests shed by per-tenant quota enforcement (in-flight/"
-            "queue caps, device-time token bucket, or KV budget)",
-            ("tier", "tenant"))
-        self.tenant_inflight_g = registry.gauge(
-            "dllm_tenant_inflight",
-            "Requests a tenant currently has admitted against its "
-            "quota (in flight or waiting)", ("tier", "tenant"))
-        self.tenant_goodput_g = registry.gauge(
-            "dllm_tenant_goodput",
-            "Sliding-window fraction of the tenant's requests meeting "
-            "their SLO (obs/slo.py per-tenant windows)", ("tenant",))
+        for attr, kind, name, labels, help_ in METRIC_REGISTRY:
+            setattr(self, attr, getattr(registry, kind)(
+                name, help_, labels))
+
+
+# -- METRICS.md generation ----------------------------------------------------
+
+def render_markdown() -> str:
+    """The METRICS.md body (pinned in sync by tests/test_lint.py)."""
+    lines = [
+        "# Metrics registry",
+        "",
+        "Generated from `distributed_llm_tpu/obs/metrics.py` "
+        "(`python -m distributed_llm_tpu.obs.metrics > METRICS.md`).",
+        "The `metrics_discipline` lint checker fails tier-1 when an "
+        "emission site and this registry disagree in either direction.",
+        "",
+        "## Metric families (`dllm_*`)",
+        "",
+        "| Name | Kind | Labels | Semantics |",
+        "|---|---|---|---|",
+    ]
+
+    def cell(text: str) -> str:
+        return text.replace("|", "\\|")     # keep table cells intact
+
+    for _attr, kind, name, labels, help_ in sorted(
+            METRIC_REGISTRY, key=lambda r: r[2]):
+        lab = ", ".join(f"`{x}`" for x in labels) if labels else "(none)"
+        lines.append(f"| `{name}` | {kind} | {lab} | {cell(help_)} |")
+    lines += [
+        "",
+        "## Label cardinality bounds",
+        "",
+        "Metric children are permanent; every label name above rides "
+        "one of these bounds.",
+        "",
+        "| Label | Bound |",
+        "|---|---|",
+    ]
+    for label in sorted(BOUNDED_LABELS):
+        lines.append(f"| `{label}` | {cell(BOUNDED_LABELS[label])} |")
+    return "\n".join(lines) + "\n"
 
 
 class BoundedLabels:
@@ -628,3 +662,8 @@ _BREAKER_STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}
 
 def breaker_state_value(state: str) -> int:
     return _BREAKER_STATE_VALUE.get(state, 0)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.stdout.write(render_markdown())

@@ -696,14 +696,17 @@ class ReplicatedTierClient:
             r = self._standby.pop(0)
             try:
                 r.mgr.start_server()     # idempotent; no-op when warm
+                # ensure() is inside the handler's reach: the handle is
+                # neither standby nor member here, so any raise before
+                # the append below must stop the server or it leaks.
+                self.breaker.ensure(r.name)
             except BaseException as exc:
-                summary["errors"].append(f"{r.name}: {exc}")
                 try:
                     r.mgr.stop_server()
                 except Exception:
                     pass
+                summary["errors"].append(f"{r.name}: {exc}")
                 continue
-            self.breaker.ensure(r.name)
             self._members.append(r)
             summary["added"].append(r.name)
             logger.info(
@@ -798,6 +801,25 @@ class ReplicatedTierClient:
         if victim is None:
             return None
         self._members.remove(victim)          # atomic: dispatch stops here
+        try:
+            return self._retire(victim, timeout_s)
+        except BaseException:
+            # The handle left membership above and was never re-homed
+            # (standby parks and drain-stop both return normally), so
+            # this unwind is the last reference to a live server.
+            if victim not in self._standby:
+                try:
+                    victim.mgr.stop_server()
+                except Exception:
+                    pass
+                self.breaker.forget(victim.name)
+            raise
+
+    def _retire(
+            self, victim: _Replica,
+            timeout_s: Optional[float]) -> Optional[Dict[str, Any]]:
+        """Quiesce → demote/handoff → park-or-drain one removed member
+        (the body of ``_scale_down_one``; the caller owns the unwind)."""
         timeout = (timeout_s if timeout_s is not None
                    else self.tier.drain_timeout_s)
         deadline = time.monotonic() + max(0.5, float(timeout))
@@ -860,6 +882,13 @@ class ReplicatedTierClient:
                 drain = victim.mgr.drain(
                     timeout_s=max(0.5, deadline - time.monotonic()))
             except Exception as exc:
+                # A failed drain still retires the replica: without the
+                # stop the server would outlive its membership with no
+                # reference left to ever shut it down.
+                try:
+                    victim.mgr.stop_server()
+                except Exception:
+                    pass
                 drain = {"error": f"Request failed: {exc}"}
         self.breaker.forget(victim.name)
         logger.info("tier %s: replica %s %s (scale-down; "

@@ -9,6 +9,10 @@
 #                                   # config_drift) auto-widen — the
 #                                   # analysis always loads everything
 #   scripts/lint.sh --list-rules    # checker/rule inventory
+#   scripts/lint.sh --json          # machine-readable finding set
+#                                   # (stable schema: rule, path, line,
+#                                   # message, suppressed) for CI and
+#                                   # bench tooling to diff across rounds
 #   scripts/lint.sh distributed_llm_tpu/serving --rule lock-blocking-call
 #
 # Pure AST passes: no jax import, CPU-only, a few seconds on the full

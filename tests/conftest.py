@@ -47,6 +47,12 @@ else:
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
+# Dynamic twin of the lint's ownership rules (lint/checkers/ownership.py):
+# every engine stop() in the suite asserts zero leaked pool blocks and
+# zero live spill pins.  setdefault so a debugging run can disarm it
+# (DLLM_KV_LEAK_CHECK=0 or empty).
+os.environ.setdefault("DLLM_KV_LEAK_CHECK", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -457,6 +457,65 @@ def test_scale_down_byte_identity_and_handoff():
         client.server_manager.stop_server()
 
 
+def test_scale_up_go_live_failure_stops_standby_server(monkeypatch):
+    """Regression (ISSUE 19 fix): a raise between the standby pop and
+    the membership append — here breaker.ensure — used to leak a live
+    server with no handle left anywhere (neither standby nor member).
+    The unwind now stops the server, records the error, and the loop
+    publishes the NEXT standby instead."""
+    cl = _cluster(autoscale=True, autoscale_min_replicas=1,
+                  autoscale_max_replicas=3)
+    client = ReplicatedTierClient(cl.nano, cl, warmup_on_start=False)
+    try:
+        client.server_manager.start_server()
+        r1 = client._standby[0]
+        orig = client.breaker.ensure
+
+        def ensure(name):
+            if name == r1.name:
+                raise RuntimeError("breaker boom")
+            return orig(name)
+
+        monkeypatch.setattr(client.breaker, "ensure", ensure)
+        up = client.scale_to(2, reason="test")
+        assert up["added"] == ["r2"]
+        assert any("r1" in e and "breaker boom" in e
+                   for e in up["errors"])
+        # The failed handle's server was STOPPED — not orphaned live.
+        assert not r1.mgr.is_server_running()
+        assert r1 not in client._members and r1 not in client._standby
+        out = client.process("q rivers?")
+        assert isinstance(out, dict) and "response" in out
+    finally:
+        client.server_manager.stop_server()
+
+
+def test_scale_down_drain_failure_still_stops_the_server(monkeypatch):
+    """Regression (ISSUE 19 fix): a drain that raises used to leave the
+    victim's server running forever — it had already left membership,
+    so no reference remained to ever shut it down.  The retire path now
+    stops the server best-effort and still retires the replica."""
+    cl = _cluster(autoscale=True, autoscale_min_replicas=1,
+                  autoscale_max_replicas=2, autoscale_warm_pool=False)
+    client = ReplicatedTierClient(cl.nano, cl, warmup_on_start=False)
+    try:
+        client.server_manager.start_server()
+        client.scale_to(2, reason="test")
+        victim = client._members[1]
+
+        def drain(*a, **k):
+            raise RuntimeError("drain boom")
+
+        monkeypatch.setattr(victim.mgr, "drain", drain)
+        down = client.scale_to(1, reason="test")
+        assert [i["replica"] for i in down["removed"]] == [victim.name]
+        assert not down["removed"][0]["parked"]
+        assert not victim.mgr.is_server_running()
+        assert client.replica_count() == 1
+    finally:
+        client.server_manager.stop_server()
+
+
 def test_scaled_up_replica_one_decode_program():
     """Per-replica one-decode-program invariant survives elasticity: a
     replica minted by scale_to warms against the process compile cache

@@ -324,6 +324,30 @@ def test_stop_fails_half_prefilled_request_with_shape():
     assert eng.allocator.available == eng.paged.num_blocks - 1
 
 
+def test_stop_mid_prefill_leaves_zero_live_blocks():
+    """Regression (ISSUE 19 fix): stop() cancels the in-flight chunked
+    prefill — freeing its blocks and unpinning its prefix entry — and,
+    with DLLM_KV_LEAK_CHECK armed (conftest arms it suite-wide),
+    asserts zero live pool blocks before returning.  A reintroduced
+    leak therefore fails INSIDE stop(), not as collateral damage in
+    whatever test runs next."""
+    eng = _engine(prefill_chunk_tokens=16, prefill_chunk_budget=16,
+                  max_new_tokens=24, enable_prefix_cache=True,
+                  prefix_cache_entries=4)
+    req = None
+    try:
+        eng.generate("warm", max_new_tokens=2)
+        req = eng.submit(LONG_Q)
+        deadline = time.time() + 30
+        while (eng.prefill_stats()["inflight"] == 0
+               and not req.done.is_set() and time.time() < deadline):
+            time.sleep(0.0005)
+    finally:
+        eng.stop()          # leak-check assert lives in here
+    assert eng.allocator.ref_stats()["allocated_blocks"] == 0
+    assert req.done.wait(timeout=10)
+
+
 # -- observability -----------------------------------------------------------
 
 def test_prefill_chunk_metrics_and_trace_split():

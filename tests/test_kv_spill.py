@@ -145,6 +145,38 @@ def test_budget_too_small_skips_demotion():
         eng.stop()
 
 
+def test_failed_reservation_destroys_nothing():
+    """Regression (ISSUE 19 fix): the store used to kill the resident
+    twin (and evict LRU victims) BEFORE discovering the newcomer could
+    not fit — a refused demotion that destroyed promotable state.
+    Reservation now plans both kill sets first and commits all or
+    nothing, so a False offer() leaves every resident entry claimable."""
+    tiles = {"k": np.zeros((1, 1, 2, 4, 2), np.float32),
+             "v": np.zeros((1, 1, 2, 4, 2), np.float32)}
+    nbytes = sum(a.nbytes for a in tiles.values())
+    spill = HostKVSpill(budget_bytes=nbytes * 2, block_bytes=nbytes // 2,
+                        min_prefix=4, tier="t")
+    try:
+        assert spill.offer(tuple(range(8)), tiles, nbytes, nb=2)
+        assert spill.offer(tuple(range(100, 108)), tiles, nbytes, nb=2)
+        assert spill.flush(10)
+        pinned = spill.claim(tuple(range(100, 110)))
+        assert pinned is not None
+        # A longer twin of the first entry, too big to fit: its twin
+        # kill alone frees nbytes, and the only other entry is pinned —
+        # the offer must be refused with NOTHING destroyed.
+        assert not spill.offer(tuple(range(12)), tiles, nbytes * 2, nb=4)
+        st = spill.stats()
+        assert st["entries"] == 2 and st["demotions_dropped"] == 1
+        assert st["evictions_total"] == 0
+        still = spill.claim(tuple(range(10)))
+        assert still is not None and still[1] == 8
+        spill.release(still[0], promoted=True)
+        spill.release(pinned[0], promoted=True)
+    finally:
+        spill.stop()
+
+
 # -- the race matrix ---------------------------------------------------------
 
 def test_hit_during_demotion_waits_out_the_copier():
