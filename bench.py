@@ -460,6 +460,25 @@ def compact(result: dict) -> dict:
         }.items() if v is not None}
         if cm:
             out["elastic"] = cm
+    c2 = result.get("chaos2")
+    if isinstance(c2, dict) and not c2.get("skipped"):
+        # One number each (BENCHMARKS.md r21): availability under
+        # replica kills, rescue MTTR (kill -> victim serving again),
+        # the cross-tier-failover count (~0 bound), rescue outcomes,
+        # and the byte-identity + warm-hit sub-check verdicts.
+        cm = {k: v for k, v in {
+            "avail": c2.get("availability"),
+            "mttr": c2.get("rescue_mttr_ms"),
+            "failovers": c2.get("failovers"),
+            "rescued": ((c2.get("rescues") or {}).get("sibling", 0)
+                        + (c2.get("rescues") or {}).get("requeue", 0)
+                        if c2.get("rescues") is not None else None),
+            "ident": c2.get("outputs_identical"),
+            "warm": c2.get("warm_hit"),
+            "err": (c2.get("error") or "")[:80] or None,
+        }.items() if v is not None}
+        if cm:
+            out["chaos2"] = cm
     mc = result.get("multichip")
     if isinstance(mc, dict) and not mc.get("skipped"):
         # One number each (BENCHMARKS.md r18): the judged tp=2/tp=1
@@ -2929,6 +2948,322 @@ def elastic_phase(period_s: float = 20.0, beat=lambda: None) -> dict:
     return out
 
 
+def _chaos2_rescue_subcheck(base_cl, tier, beat=lambda: None) -> dict:
+    """Deterministic crash-rescue byte-identity sub-check (ISSUE 20):
+    a 2-replica client crashes r0 mid-decode with a request in flight;
+    restart_replica captures it and the SIBLING resumes it — the full
+    emitted stream must be byte-identical to an uninterrupted greedy
+    run (the stream stalls through the rescue, never errors, never
+    re-emits).  Rides the host spill tier too: a prefix demoted to r0's
+    host LRU before the kill must survive the restart attached to the
+    NEW engine and serve a warm promotion (``warm_hit``), not a cold
+    prefill."""
+    import dataclasses
+    import queue as queue_mod
+
+    from distributed_llm_tpu.engine.paged_kv import pool_block_bytes
+    from distributed_llm_tpu.serving.replicas import ReplicatedTierClient
+    from distributed_llm_tpu.utils.faults import crash_replica_engine
+
+    import jax
+
+    blk = pool_block_bytes(tier.model(), tier.kv_block_size,
+                           tier.kv_quantize)
+    s_tier = dataclasses.replace(
+        tier, replicas=2, enable_prefix_cache=True,
+        prefix_cache_entries=8, prefill_chunk_tokens=16,
+        host_kv_bytes=blk * 64, max_new_tokens=32)
+    warm_prompt = "session warm tell me about rivers in one sentence"
+    live_prompt = "session live tell me about mountains in one sentence"
+    out: dict = {}
+    client = ReplicatedTierClient(
+        s_tier, dataclasses.replace(base_cl, nano=s_tier),
+        devices=list(jax.devices()[:2]), seed=base_cl.seed,
+        warmup_on_start=False)
+    try:
+        client.server_manager.start_server(beat=beat)
+        beat()
+        victim = next(r for r in client._members if r.rid == 0)
+        sibling = next(r for r in client._members if r.rid == 1)
+        eng = victim.mgr._engine
+        ref = sibling.mgr._engine.generate(live_prompt, temperature=0.0)
+        beat()
+        # Park + demote every parked prefix on the victim (just the
+        # warm prompt's — warmup is off) so the kill also tests
+        # spill-state survival.
+        first = eng.generate(warm_prompt, temperature=0.0)
+        while eng.prefix_cache.pop_oldest() is not None:
+            pass
+        eng.kv_spill.flush(10.0)
+        spill = eng.kv_spill
+        promos_before = spill.stats()["promotions_total"]
+        # In-flight crash: wait for the first emitted token (the slot
+        # is live mid-decode), then kill the scheduler loop.
+        q = queue_mod.Queue()
+        req = eng.submit(live_prompt, temperature=0.0, token_queue=q)
+        got = [q.get(timeout=60.0)]
+        crash_replica_engine(eng)
+        t0 = time.monotonic()
+        summary = client.restart_replica(0, reason="chaos2 subcheck")
+        out["rescue_ms"] = round((time.monotonic() - t0) * 1000.0, 1)
+        beat()
+        out["outcome"] = summary.get("outcome")
+        out["rescued"] = summary.get("rescued")
+        out["spill_reattached"] = bool(summary.get("spill_reattached"))
+        if not req.done.wait(timeout=120.0):
+            out["error"] = "rescued request never completed"
+            return out
+        if req.error is not None:
+            out["error"] = f"rescued request errored: {req.error!r}"[:200]
+            return out
+        full = list(got)
+        while True:
+            tok = q.get(timeout=30.0)
+            if tok is None:
+                break
+            full.append(tok)
+        out["identical"] = (full == list(ref.token_ids)
+                            and list(req.result.token_ids)
+                            == list(ref.token_ids))
+        if not out["identical"]:
+            out["error"] = ("rescued stream diverged from the "
+                            "uninterrupted greedy reference")
+            return out
+        # Warm promotion on the REBUILT engine through the survived
+        # store: same object, new engine, host hit — not cold prefill.
+        new_eng = victim.mgr._engine
+        out["spill_survived"] = new_eng.kv_spill is spill
+        second = new_eng.generate(warm_prompt, temperature=0.0)
+        beat()
+        out["warm_identical"] = (list(second.token_ids)
+                                 == list(first.token_ids))
+        out["warm_hit"] = (spill.stats()["promotions_total"]
+                           > promos_before)
+        if not out["warm_hit"] and "error" not in out:
+            out["error"] = ("restart cost a cold prefill: no host "
+                            "promotion after spill re-attach")
+        elif not out["warm_identical"]:
+            out["error"] = "warm promotion changed the answer"
+    finally:
+        client.server_manager.stop_server()
+    return out
+
+
+def chaos2_phase(period_s: float = 16.0, beat=lambda: None) -> dict:
+    """Crash-rescue chaos leg (ISSUE 20): the seeded diurnal-ramp
+    schedule replayed against a 2-replica nano tier with the autoscaler
+    armed and the HealthMonitor in the loop, while a scripted fault
+    actor KILLS a replica's scheduler loop mid-peak (utils/faults.py
+    ``crash_replica_engine`` — dead thread, stranded slots, exactly
+    what a segfaulted replica leaves).  The watchdog flips the member
+    wedged, the monitor routes the restart through
+    ``restart_replica``, and the captured in-flight work resumes on the
+    sibling — so the kill must be INVISIBLE at the tier boundary.
+
+    Headline: **availability** (answered ok-or-degraded over all
+    arrivals — rescued requests stall, they do not error),
+    **rescue_mttr_ms** (kill → the victim serving again with a fresh
+    engine, monitor detection latency included), and the
+    **cross-tier failover count**, which must stay ~0: tier-level
+    failover is for a DEAD TIER, and a tier with a live sibling is not
+    dead.  HARD sub-check (``_chaos2_rescue_subcheck``): rescued greedy
+    streams byte-identical + spill re-attach serves a warm promotion
+    after the kill."""
+    import dataclasses
+    import sys
+
+    from distributed_llm_tpu.bench.scenarios import (
+        diurnal_ramp, run_schedule, schedule, total_duration_s)
+    from distributed_llm_tpu.config import tiny_batched_cluster
+    from distributed_llm_tpu.obs import Observability, get_observability
+    from distributed_llm_tpu.serving.health import HealthMonitor
+    from distributed_llm_tpu.serving.router import Router
+    from distributed_llm_tpu.utils.faults import crash_replica_engine
+
+    print("[bench] chaos2 crash-rescue leg", file=sys.stderr, flush=True)
+    base_cl = tiny_batched_cluster(nano_slots=2)
+    # 2 replicas, autoscaler armed inside [1, 2] (the kill must compose
+    # with live scale events — the busy flag is under test, not just
+    # the happy path), and a watchdog deadline small enough that wedge
+    # detection fits the compressed "day" but far above any healthy
+    # inter-progress gap at these rates.
+    tier = dataclasses.replace(
+        base_cl.nano, replicas=2, decode_steps_per_tick=8,
+        admission_max_queue=64, watchdog_stall_s=1.0,
+        autoscale=True, autoscale_min_replicas=1,
+        autoscale_max_replicas=2, autoscale_interval_s=0.2,
+        autoscale_breach_window_s=0.4, autoscale_idle_window_s=1.5,
+        autoscale_up_cooldown_s=1.5, autoscale_down_cooldown_s=4.0,
+        autoscale_queue_high=2.0, autoscale_goodput_floor=0.5)
+    cl = dataclasses.replace(base_cl, nano=tier)
+    obs = Observability(slow_ms=None)
+    # Failover stays ENABLED — the leg's claim is that it does not
+    # FIRE: replica rescue absorbs the kill below the tier boundary.
+    router = Router(strategy="heuristic", benchmark_mode=True,
+                    cluster=cl, observability=obs)
+    mon = HealthMonitor(router, interval_s=0.3, auto_restart=True)
+    # Modest fixed rates well under 2-replica capacity: the leg
+    # measures fault-masking, not throughput — base idles one replica
+    # (the autoscaler may legitimately shrink), peak keeps both busy
+    # so a kill always strands in-flight work.
+    segs = diurnal_ramp(base_rate=1.5, peak_rate=6.0,
+                        period_s=period_s, steps=6)
+    arrivals = schedule(segs, label="chaos2-diurnal", seed=20,
+                        max_arrivals=400)
+    sched_s = total_duration_s(segs)
+    out: dict = {"period_s": period_s, "arrivals": len(arrivals),
+                 "scheduled_s": round(sched_s, 2)}
+    prompts = [f"q{i} rivers?" for i in range(32)]
+    records: list = []
+    rec_lock = threading.Lock()
+    kills: list = []
+    kill_err: list = []
+
+    def fire(a):
+        try:
+            resp, _, _dev = router.route_query(
+                [{"role": "user",
+                  "content": prompts[a.index % len(prompts)]}])
+            ok = bool(resp.get("ok")) or bool(resp.get("degraded"))
+            raw = resp.get("raw")
+            ttft = raw.get("ttft_ms") if isinstance(raw, dict) else None
+            with rec_lock:
+                records.append((time.monotonic(), ok, ttft))
+        except Exception:
+            with rec_lock:
+                records.append((time.monotonic(), False, None))
+
+    def killer(t_start):
+        """Kill a live replica at ~35% and ~65% of the schedule (both
+        inside traffic), then time kill → fresh serving engine."""
+        nano = router.tiers["nano"]
+        for frac in (0.35, 0.65):
+            wait = t_start + frac * sched_s - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            victim = next((r for r in list(nano._members)
+                           if r.mgr.is_server_running()), None)
+            if victim is None:
+                kill_err.append("no live replica to kill")
+                continue
+            old_eng = victim.mgr._engine
+            if not crash_replica_engine(old_eng):
+                kill_err.append(f"{victim.name}: loop already dead")
+                continue
+            t_kill = time.monotonic()
+            restored = None
+            while time.monotonic() - t_kill < 30.0:
+                cur = getattr(victim.mgr, "_engine", None)
+                if (cur is not None and cur is not old_eng
+                        and victim.mgr.is_server_running()):
+                    restored = time.monotonic()
+                    break
+                if victim not in list(nano._members):
+                    # Scale-down retired the victim mid-rescue: its
+                    # work was captured/handed off — membership change
+                    # IS the recovery.
+                    restored = time.monotonic()
+                    break
+                time.sleep(0.02)
+            kills.append({
+                "replica": victim.name,
+                "t_s": round(t_kill - t_start, 2),
+                "mttr_ms": (round((restored - t_kill) * 1000.0, 1)
+                            if restored is not None else None),
+            })
+            if restored is None:
+                kill_err.append(f"{victim.name}: never restored")
+
+    # Tier-client metrics (rescue counters, spill re-attach) land in
+    # the PROCESS-GLOBAL registry — the clients resolve observability
+    # lazily and the Router does not inject its bundle into them — so
+    # the leg reads before/after deltas there; only router-side
+    # families (failovers) live in this run's private registry.
+    gm = get_observability().m
+    _rescue_outcomes = ("sibling", "requeue", "failed")
+    rescues0 = {o: gm.replica_rescues.labels("nano", o).value
+                for o in _rescue_outcomes}
+    reattach0 = gm.spill_reattach.labels("nano").value
+    try:
+        for tc in router.tiers.values():
+            tc.server_manager.start_server(beat=beat)
+            beat()
+        # Untimed warmup through the full pipeline (prefill-bucket
+        # compiles), then arm the monitor and the kill actor.
+        for i in range(2):
+            router.route_query([{"role": "user",
+                                 "content": prompts[i]}])
+            beat()
+        mon.start()
+        t_start = time.monotonic()
+        kthread = threading.Thread(target=killer, args=(t_start,),
+                                   name="chaos2-killer", daemon=True)
+        kthread.start()
+        rep = run_schedule(fire, arrivals, beat=beat,
+                           join_grace_s=30.0, label="chaos2")
+        kthread.join(timeout=45.0)
+        beat()
+        out["hung_clients"] = rep["hung_clients"]
+        n = len(records)
+        out["requests"] = n
+        out["availability"] = (round(sum(1 for _, a, _ in records
+                                         if a) / n, 4) if n else 0.0)
+        out["mttr_s"] = _mttr_s([(t, a) for t, a, _ in records])
+        ttfts = [x for _, _, x in records if x]
+        out["p50_ttft_ms_under_kills"] = (
+            round(statistics.median(ttfts), 2) if ttfts else None)
+        out["kills"] = kills
+        mttrs = [k["mttr_ms"] for k in kills if k["mttr_ms"] is not None]
+        out["rescue_mttr_ms"] = (round(statistics.mean(mttrs), 1)
+                                 if mttrs else None)
+        # Cross-tier failovers observed by THIS run's registry — the
+        # tier never died (a sibling lived or the rebuild was in
+        # flight), so tier-level failover firing means the boundary
+        # leaked.
+        out["failovers"] = int(sum(
+            c.value for c in obs.m.failovers.children().values()))
+        out["rescues"] = {
+            o: int(gm.replica_rescues.labels("nano", o).value
+                   - rescues0[o])
+            for o in _rescue_outcomes}
+        out["spill_reattached_total"] = int(
+            gm.spill_reattach.labels("nano").value - reattach0)
+        out["monitor_restarts"] = dict(mon._restarts)
+        out["kill_errors"] = kill_err
+        if kill_err:
+            out["error"] = f"kill/restore: {kill_err[0]}"
+        elif out["availability"] < 0.99:
+            out["error"] = (f"availability {out['availability']} < "
+                            f"0.99 under replica kills")
+        elif out["failovers"] > 0:
+            out["error"] = (f"{out['failovers']} cross-tier failovers "
+                            f"fired with a live sibling — the replica "
+                            f"boundary leaked into tier failover")
+        elif out["rescues"]["failed"] > 0:
+            out["error"] = (f"{out['rescues']['failed']} captured "
+                            f"requests failed instead of resuming")
+    finally:
+        try:
+            mon.stop()
+        except Exception:
+            pass
+        for tc in router.tiers.values():
+            tc.server_manager.stop_server()
+    beat()
+
+    # Deterministic byte-identity + spill-survival sub-check (HARD).
+    try:
+        sub = _chaos2_rescue_subcheck(base_cl, base_cl.nano, beat=beat)
+    except Exception as exc:
+        sub = {"error": str(exc)[:200]}
+    out["subcheck"] = sub
+    out["outputs_identical"] = bool(sub.get("identical"))
+    out["warm_hit"] = bool(sub.get("warm_hit"))
+    if sub.get("error") and "error" not in out:
+        out["error"] = f"rescue sub-check: {sub['error']}"
+    return out
+
+
 def multichip_phase(n_requests: int = 8, beat=lambda: None) -> dict:
     """Tensor-parallel serving leg (ISSUE 16): tp=2 vs tp=1 on the
     multi-device carve, three parts.
@@ -4258,6 +4593,21 @@ def run(progress: "Progress" = None, budget: "Budget" = None) -> dict:
     progress.section("elastic", elastic)
     progress.flush_compact()
 
+    # Crash-rescue chaos leg (ISSUE 20): replica kills in the diurnal
+    # scenario with the autoscaler armed and the HealthMonitor in the
+    # loop — availability, rescue MTTR, the ~0 cross-tier-failover
+    # bound, and the hard byte-identity + spill-survival sub-check on
+    # rescued streams (BENCHMARKS.md r21).
+    if budget.allows(120):
+        try:
+            chaos2 = chaos2_phase(beat=progress.beat)
+        except Exception as exc:          # never lose the headline line
+            chaos2 = {"error": str(exc)[:200]}
+    else:
+        chaos2 = {"skipped": budget.skip_stamp()}
+    progress.section("chaos2", chaos2)
+    progress.flush_compact()
+
     # Multichip tensor-parallel leg (ISSUE 16): tp=2 vs tp=1 parity +
     # decode-rate ratio on the DLLM_TP-forced carve, the capacity
     # demonstration (a per-chip HBM budget only tp=2 fits — refusal at
@@ -4561,6 +4911,7 @@ def run(progress: "Progress" = None, budget: "Budget" = None) -> dict:
         "trend": trend,
         "trend_req_per_s": trend.get("trend_req_per_s"),
         "chaos": chaos,
+        "chaos2": chaos2,
         "pressure": pressure,
         "noisy": noisy,
         "skew": skew,

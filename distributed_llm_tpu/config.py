@@ -667,6 +667,27 @@ class TierConfig:
     # constructed and warmed inside scale_to, and destroyed on
     # scale-down).  Only consulted when ``autoscale`` arms the tier.
     autoscale_warm_pool: bool = True
+    # Crash rescue (serving/replicas.py restart_replica, ISSUE 20): when
+    # a replica is restarted (HealthMonitor wedge verdict or an explicit
+    # restart_replica call), its queued + in-flight requests are CAPTURED
+    # (prompt + tokens already emitted, the PR 5 replay machinery) and
+    # re-dispatched to a live sibling — or re-queued on the restarted
+    # engine when the tier has one replica — resuming byte-identically
+    # under greedy from the last emitted token.  Streams stall through
+    # the rescue instead of erroring, so Router tier-level failover only
+    # fires when the whole tier is dead.  False = pre-rescue behavior:
+    # a restart fails every in-flight request with the engine-stopped
+    # error shape.
+    replica_rescue: bool = True
+    # Spill-state survival (ISSUE 20): detach the host KV spill store
+    # from the engine's lifetime across a replica restart — the host LRU
+    # outlives stop_server and re-attaches to the rebuilt engine (or is
+    # handed to a survivor replica through the scale-down handoff path
+    # when the restart fails), so a restart costs warm-TTFT promotion
+    # for revisited prefixes instead of a cold prefill.  False = the
+    # spill store stops (and empties) with the engine, the pre-survival
+    # behavior.
+    spill_survive_restart: bool = True
 
     def model(self) -> ModelConfig:
         return MODEL_PRESETS[self.model_preset]

@@ -395,6 +395,21 @@ CONFIG_FIELDS: Dict[str, str] = {
                                       "standby in milliseconds; False "
                                       "builds/destroys engines at "
                                       "actuation time.",
+    "TierConfig.replica_rescue": "Crash rescue: a replica restart "
+                                 "captures its queued + in-flight "
+                                 "requests and re-dispatches them to a "
+                                 "sibling (or requeues on the restarted "
+                                 "engine), resuming byte-identically "
+                                 "under greedy; False fails them with "
+                                 "the engine-stopped shape.",
+    "TierConfig.spill_survive_restart": "Host KV spill store outlives a "
+                                        "replica restart and re-attaches "
+                                        "to the rebuilt engine (or hands "
+                                        "entries to a survivor), so "
+                                        "restart cost is warm-TTFT "
+                                        "promotion, not cold prefill; "
+                                        "False stops the store with the "
+                                        "engine.",
     # -- ClusterConfig -----------------------------------------------------
     "ClusterConfig.nano": "The weak/cheap tier's TierConfig.",
     "ClusterConfig.orin": "The strong/costly tier's TierConfig.",

@@ -2866,6 +2866,105 @@ class ContinuousBatchingEngine:
                         f"DLLM_KV_LEAK_CHECK: {pinned} spill entry "
                         f"pin(s) still held after engine stop()")
 
+    # -- crash rescue (ISSUE 20) -------------------------------------------
+
+    def capture_requests(self) -> List[_Request]:
+        """Harvest every queued + in-flight request for a crash rescue:
+        join the scheduler loop, park each decoding slot's generated
+        prefix on its request (the ``_preempt`` capture — ``_admit_replay``
+        later resumes it byte-identically under greedy), unwind the
+        in-flight chunked prefill, and drain the head lane, tenant lanes
+        and submission queue.  The SAME ``_Request`` objects come back —
+        ``done`` events, token queues, traces and tenant identity intact,
+        so blocked callers and streams STALL through the rescue instead
+        of erroring — and the engine is left empty: a following
+        ``stop()`` finds nothing to fail."""
+        captured: List[_Request] = []
+        with self._lifecycle:
+            if self._thread is not None:
+                self._stop.set()
+                self._wake.set()
+                self._thread.join(timeout=5)
+                self._thread = None
+            # In-flight chunked prefill: the cancel-and-requeue unwind
+            # (blocks freed, promote pin dropped into the live spill)
+            # parks the request back at the scheduler head, where the
+            # drain below collects it.
+            self._cancel_prefill("rescue_capture")
+            for ix, slot in enumerate(self._slots):
+                if slot is None:
+                    continue
+                req = slot.request
+                req.replay_tokens = list(slot.tokens)
+                req.replay_ttft_ms = slot.ttft_ms
+                req.preempt_count += 1
+                obs_spans.event(req.trace, "rescue_capture",
+                                tier=self.tier.name,
+                                generated=len(slot.tokens))
+                self._release(ix)            # free ALL blocks, no parking
+                captured.append(req)
+            while True:
+                req = self._next_request()   # head lane + tenant lanes
+                if req is None:
+                    break
+                captured.append(req)
+        return captured
+
+    def adopt_requests(self, reqs: Sequence[_Request]) -> int:
+        """Enqueue requests captured off a crashed/wedged sibling.  Each
+        re-enters through the normal submission queue (tenant lanes and
+        quota billing see the original ``req.tenant``) and a request
+        carrying ``replay_tokens`` routes to ``_admit_replay`` on
+        admission — identical params + greedy sampling means the
+        continuation is byte-identical to the uninterrupted stream.
+        Returns the number adopted."""
+        self.start()
+        n = 0
+        for req in reqs:
+            # The chunk bookmark belongs to the dead engine's prefill
+            # lane; this engine's admission re-derives it.
+            req.needs_chunk = False
+            self._queue.put(req)
+            n += 1
+        if n:
+            self._wake.set()
+        return n
+
+    def detach_spill(self) -> Optional["HostKVSpill"]:
+        """Hand the host spill store out of the engine's lifetime
+        (spill-state survival): flush in-flight demote copies so the
+        host tier is consistent, then unhook the instance so a following
+        ``stop()`` leaves it RUNNING.  Returns the live store (or None
+        when the engine never had one)."""
+        spill = self.kv_spill
+        if spill is None:
+            return None
+        try:
+            spill.flush(timeout_s=5.0)
+        except Exception:
+            pass
+        self.kv_spill = None
+        return spill
+
+    def adopt_spill(self, spill: Optional["HostKVSpill"]) -> bool:
+        """Install a surviving host spill store into this (freshly
+        rebuilt) engine.  Geometry must match — same per-block host
+        bytes and the same min-prefix floor — or the orphan is refused
+        and the caller hands it to a sibling instead.  The fresh
+        engine's own store, if it built one, is stopped and replaced:
+        the survivor holds the warm entries."""
+        if (spill is None or self.prefix_cache is None
+                or not self.chunk_tokens):
+            return False
+        if (spill.block_bytes != self._spill_block_bytes
+                or spill.min_prefix != self.prefix_cache.min_prefix):
+            return False
+        old = self.kv_spill
+        if old is not None and old is not spill:
+            old.stop()
+        self.kv_spill = spill
+        return True
+
     def submit(self, history: History,
                max_new_tokens: Optional[int] = None,
                temperature: Optional[float] = None,

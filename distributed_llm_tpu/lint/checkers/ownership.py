@@ -176,6 +176,21 @@ PROTOCOLS: Tuple[Protocol, ...] = (
         transfers=(Sig("append", recv=("_members",)),),
         release_hint="self._standby.append(r) or r.mgr.stop_server()",
     ),
+    Protocol(
+        # ISSUE 20 crash rescue: a capture_requests() result is the
+        # victim replica's in-flight work — live _Request objects with
+        # callers blocked on done.wait().  It must reach exactly one
+        # home: adopted by a sibling/restarted engine (transfer) or
+        # failed with the engine-stopped shape (release).  A path that
+        # drops the list strands callers forever; adopting twice would
+        # decode the same stream on two engines.
+        name="rescue-capture",
+        acquires=(Sig("capture_requests"),),
+        releases=(Sig("fail_captured", arg="arg0"),),
+        transfers=(Sig("adopt_requests", arg="arg0"),),
+        release_hint="engine.adopt_requests(captured) or "
+                     "fail_captured(captured, tier_name)",
+    ),
 )
 
 _LEAK_RULE = {"resource": OWN_LEAK, "permit": OWN_LEAK, "pin": OWN_PIN}

@@ -503,6 +503,20 @@ METRIC_REGISTRY: Tuple[Tuple[str, str, str, Tuple[str, ...], str], ...] = (
      "Replicas of the tier currently serving (running, not "
      "wedged, breaker not open) out of TierConfig.replicas "
      "(sampled)"),
+    # Crash-rescue family (ISSUE 20, serving/replicas.py
+    # restart_replica): what happened to a restarted replica's
+    # in-flight work and its host spill store.
+    ("replica_rescues", "counter", "dllm_replica_rescues_total",
+     ("tier", "outcome"),
+     "Requests captured off a crashed/wedged replica at restart, "
+     "by where they resumed (sibling = adopted by a live sibling "
+     "replica, requeue = re-queued on the restarted engine, "
+     "failed = no home — failed with the engine-stopped shape)"),
+    ("spill_reattach", "counter", "dllm_spill_reattach_total",
+     ("tier",),
+     "Host KV spill stores that survived an engine restart and "
+     "re-attached to the rebuilt engine (spill-state survival — "
+     "restart cost is warm-TTFT promotion, not cold prefill)"),
     # Elastic-capacity family (ISSUE 18, serving/autoscaler.py):
     # live membership and the autoscaler's actuation decisions.
     ("replica_count_g", "gauge", "dllm_replica_count", ("tier",),
@@ -554,7 +568,8 @@ BOUNDED_LABELS: Dict[str, str] = {
     "strategy": "closed set: the router's routing strategies "
                 "(serving/router.py STRATEGIES)",
     "tier": "closed set: config-enumerated tier names (TierConfig)",
-    "outcome": "closed set: ok|error|degraded",
+    "outcome": "closed per-family enums (request outcomes ok|error|"
+               "degraded; rescue outcomes sibling|requeue|failed)",
     "kind": "closed per-family enums (failover / dispatch / SLO-violation"
             " / prefix-hit kinds; see each family's help)",
     "to": "closed set: breaker states closed|half_open|open",

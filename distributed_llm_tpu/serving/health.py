@@ -180,7 +180,29 @@ class HealthMonitor:
                     fn = getattr(tc, "reset_replica", None)
                     if callable(fn):
                         fn(rid)
-                to_restart.append((rkey, sub, _on_restarted))
+                # Crash-rescue restart (ISSUE 20): route through the
+                # tier client's restart_replica — in-flight rescue,
+                # spill survival, and the scale busy flag (a restart
+                # racing a scale-down is REFUSED; the raise below
+                # keeps the failure streak so the next probe retries,
+                # same as a refused autoscaler actuation).  Only when
+                # the probed manager IS the member the client would
+                # restart — duck-typed manager sets swapped under the
+                # tier (tests) fall back to the direct stop/start.
+                restart_fn = None
+                rescue = getattr(tier, "restart_replica", None)
+                member_of = getattr(tier, "member_manager", None)
+                if (callable(rescue) and callable(member_of)
+                        and member_of(rid) is sub):
+                    def restart_fn(tc=tier, rid=rid):
+                        summary = tc.restart_replica(
+                            rid, reason="health probe")
+                        if not summary.get("restarted"):
+                            errs = (summary.get("errors")
+                                    or ["restart failed"])
+                            raise RuntimeError(str(errs[0]))
+                to_restart.append((rkey, sub, _on_restarted,
+                                   restart_fn))
             reps[rkey] = entry
             states.append(state)
         # Retired replicas (scale-down) leave the per-key bookkeeping:
@@ -226,8 +248,10 @@ class HealthMonitor:
         failed ``max_consecutive_failures`` probes in a row; replicated
         tiers probe and restart per replica."""
         snapshot: Dict[str, Dict[str, Any]] = {}
-        # (key, manager, on-restarted callback or None)
-        to_restart: List[Tuple[str, Any, Any]] = []
+        # (key, manager, on-restarted callback or None, rescue restart
+        # fn or None — replicated tiers route restarts through
+        # ReplicatedTierClient.restart_replica when set)
+        to_restart: List[Tuple[str, Any, Any, Any]] = []
 
         breaker = getattr(self.router, "breaker", None)
         for name, tier in self.router.tiers.items():
@@ -257,7 +281,7 @@ class HealthMonitor:
                             b.reset(n)
                         except Exception:
                             pass
-                to_restart.append((name, mgr, _on_restarted))
+                to_restart.append((name, mgr, _on_restarted, None))
             snapshot[name] = entry
             # Half-open probing rides the liveness cadence: a healthy
             # probe of an OPEN tier past its cooldown advances the
@@ -271,7 +295,7 @@ class HealthMonitor:
                 except Exception:
                     pass
 
-        for name, mgr, on_restarted in to_restart:
+        for name, mgr, on_restarted, restart_fn in to_restart:
             prev = self._restarting.get(name)
             if prev is not None and prev.is_alive():
                 logger.warning("tier %s restart still in flight — not "
@@ -280,10 +304,18 @@ class HealthMonitor:
             logger.warning("tier %s unhealthy after %d probes — restarting",
                            name, self.max_failures)
 
-            def _restart(name=name, mgr=mgr, on_restarted=on_restarted):
+            def _restart(name=name, mgr=mgr, on_restarted=on_restarted,
+                         restart_fn=restart_fn):
                 try:
-                    mgr.stop_server()
-                    mgr.start_server()
+                    if restart_fn is not None:
+                        # Rescue-capable path: a busy refusal (restart
+                        # racing a scale) raises, landing in the except
+                        # below — fail counts KEEP the streak, so the
+                        # next probe retries the restart.
+                        restart_fn()
+                    else:
+                        mgr.stop_server()
+                        mgr.start_server()
                     with self._lock:
                         self._restarts[name] = self._restarts.get(name, 0) + 1
                         self._fail_counts[name] = 0

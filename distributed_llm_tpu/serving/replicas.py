@@ -85,7 +85,7 @@ from ..obs import spans as obs_spans
 from ..obs.spans import current_trace
 from ..utils.faults import FaultInjector
 from .breaker import CircuitBreaker, OPEN
-from .errors import is_error_shape
+from .errors import error_dict, is_error_shape
 from .tiers import TierClient
 
 logger = logging.getLogger(__name__)
@@ -491,6 +491,26 @@ class _ReplicaStream:
         return self._handle.result
 
 
+def fail_captured(reqs: Sequence[Any], tier_name: str) -> int:
+    """Last-resort release of a rescue capture (ISSUE 20): no sibling
+    adopted the requests and the restarted engine cannot take them, so
+    each fails with the engine-stopped error shape — the pre-rescue
+    outcome.  Blocked callers unblock, streams see the end-of-stream
+    sentinel.  Returns the number failed."""
+    from ..engine.batching import EngineStoppedError
+    n = 0
+    for req in reqs:
+        req.error = EngineStoppedError(error_dict(
+            f"Request failed: tier {tier_name} engine stopped "
+            f"mid-flight"))
+        tq = getattr(req, "token_queue", None)
+        if tq is not None:
+            tq.put(None)
+        req.done.set()
+        n += 1
+    return n
+
+
 class ReplicatedTierClient:
     """The tier client over N replica TierClients — same surface as
     TierClient (``process`` / ``process_stream`` / ``load_snapshot`` /
@@ -695,6 +715,15 @@ class ReplicatedTierClient:
         while len(self._members) < n and self._standby:
             r = self._standby.pop(0)
             try:
+                if self.faults is not None:
+                    # Injected warm-standby publish failure (ISSUE 20
+                    # fault matrix): the parked engine's device went
+                    # away — the publish raises, the handler below
+                    # retires the handle, and the loop falls through
+                    # to building fresh capacity.
+                    fail = self.faults.standby_publish_fail(self.name)
+                    if fail is not None:
+                        raise RuntimeError(fail)
                 r.mgr.start_server()     # idempotent; no-op when warm
                 # ensure() is inside the handler's reach: the handle is
                 # neither standby nor member here, so any raise before
@@ -914,6 +943,150 @@ class ReplicatedTierClient:
                 return spill
         return None
 
+    # -- crash rescue (ISSUE 20) --------------------------------------------
+
+    def restart_replica(self, rid: int,
+                        reason: str = "wedged") -> Dict[str, Any]:
+        """Restart ONE replica's engine with crash rescue: the victim's
+        queued + in-flight requests are captured (prompt + generated
+        prefix, the PR 5 replay machinery) and re-dispatched to a live
+        sibling — or re-queued on the restarted engine when the tier
+        has one replica — resuming byte-identically under greedy, and
+        the host spill store survives the restart (detached before
+        ``stop_server``, re-attached after, or handed to a survivor).
+
+        Serialized through the SAME busy flag as ``scale_to``: a restart
+        racing a scale-down would strand a freshly rebuilt engine
+        outside the membership, so an overlapping call returns a
+        ``busy`` error instead — the HealthMonitor keeps the replica's
+        failure streak and retries next probe, the same contract as a
+        refused autoscaler actuation."""
+        summary: Dict[str, Any] = {
+            "replica": replica_name(rid), "reason": reason,
+            "restarted": False, "rescued": 0, "outcome": None,
+            "spill_reattached": False, "errors": []}
+        with self._scale_lock:
+            if self._scaling:
+                summary["errors"].append("busy: scale in progress")
+                return summary
+            self._scaling = True
+        try:
+            victim = next(
+                (r for r in list(self._members) if r.rid == rid), None)
+            if victim is None:
+                summary["errors"].append(
+                    f"{replica_name(rid)}: not a member")
+                return summary
+            engine = getattr(victim.mgr, "_engine", None)
+            spill = None
+            if getattr(self.tier, "spill_survive_restart", True) \
+                    and hasattr(engine, "detach_spill"):
+                spill = engine.detach_spill()
+            captured: List[Any] = []
+            if getattr(self.tier, "replica_rescue", True) \
+                    and hasattr(engine, "capture_requests"):
+                captured = engine.capture_requests()
+            self._rescue_and_restart(victim, captured, spill, summary)
+            return summary
+        finally:
+            with self._scale_lock:
+                self._scaling = False
+
+    def _rescue_and_restart(self, victim: _Replica, captured: List[Any],
+                            spill: Any,
+                            summary: Dict[str, Any]) -> None:
+        """Restart ``victim``'s engine and re-home its captured work
+        (busy flag claimed).  Rescue runs FIRST when a sibling lives —
+        MTTR is then one capture + adopt, not an engine rebuild — so
+        the restart's minutes never sit between a stalled stream and
+        its resumption."""
+        sibling = None
+        if captured:
+            for rec in list(self._members):
+                if rec is victim or not rec.mgr.is_server_running():
+                    continue
+                eng = getattr(rec.mgr, "_engine", None)
+                if callable(getattr(eng, "adopt_requests", None)):
+                    sibling = rec
+                    break
+            if sibling is not None:
+                adopted = sibling.mgr._engine.adopt_requests(captured)
+                self._note_rescue(captured, "sibling", sibling.name)
+                summary["rescued"] = adopted
+                summary["outcome"] = "sibling"
+        try:
+            victim.mgr.stop_server()
+            victim.mgr.start_server()
+            summary["restarted"] = True
+        except Exception as exc:
+            summary["errors"].append(f"{victim.name}: restart: {exc}")
+        new_engine = (getattr(victim.mgr, "_engine", None)
+                      if summary["restarted"] else None)
+        if spill is not None:
+            adopt = getattr(new_engine, "adopt_spill", None)
+            if callable(adopt) and adopt(spill):
+                summary["spill_reattached"] = True
+                try:
+                    m = (self.obs or get_observability()).m
+                    m.spill_reattach.labels(self.name).inc()
+                except Exception:
+                    pass
+            else:
+                # The rebuilt engine refused (restart failed, or the
+                # geometry changed): hand the warm entries to a
+                # survivor through the scale-down handoff path, then
+                # stop the orphan store.
+                target = self._spill_target(exclude=victim)
+                handed = 0
+                if target is not None:
+                    try:
+                        for ids, tiles, nbytes, nb in \
+                                spill.export_resident():
+                            if target.admit_resident(ids, tiles,
+                                                     nbytes, nb):
+                                handed += 1
+                    except Exception:
+                        logger.exception(
+                            "tier %s: spill handoff from %s failed",
+                            self.name, victim.name)
+                summary["spill_handed_off"] = handed
+                try:
+                    spill.stop()
+                except Exception:
+                    pass
+        if captured and sibling is None:
+            adopt_reqs = getattr(new_engine, "adopt_requests", None)
+            if callable(adopt_reqs):
+                summary["rescued"] = adopt_reqs(captured)
+                summary["outcome"] = "requeue"
+                self._note_rescue(captured, "requeue", victim.name)
+            else:
+                fail_captured(captured, self.name)
+                summary["outcome"] = "failed"
+                self._note_rescue(captured, "failed", victim.name)
+        if summary["restarted"]:
+            self.breaker.reset(replica_name(victim.rid))
+        logger.info(
+            "tier %s: replica %s restarted=%s rescued=%d (%s) "
+            "spill_reattached=%s (%s)", self.name, victim.name,
+            summary["restarted"], summary["rescued"], summary["outcome"],
+            summary["spill_reattached"], summary["reason"])
+
+    def _note_rescue(self, captured: List[Any], outcome: str,
+                     by: str) -> None:
+        """Rescue observability: one counter bump per request plus a
+        ``rescue`` span event so flight-recorder entries show who saved
+        the request."""
+        try:
+            m = (self.obs or get_observability()).m
+            m.replica_rescues.labels(self.name, outcome).inc(
+                len(captured))
+        except Exception:
+            pass
+        for req in captured:
+            obs_spans.event(getattr(req, "trace", None), "rescue",
+                            tier=self.name, outcome=outcome, by=by)
+
     # -- dispatch policy ----------------------------------------------------
 
     def _policy(self) -> str:
@@ -1050,6 +1223,18 @@ class ReplicatedTierClient:
         """Force-close one replica's circuit (the HealthMonitor calls
         this after successfully restarting that replica's engine)."""
         self.breaker.reset(replica_name(rid))
+
+    def member_manager(self, rid: int) -> Optional[EngineManager]:
+        """The EngineManager behind member ``rid``, or None when the rid
+        left membership.  The HealthMonitor compares this against its
+        probe snapshot by IDENTITY before routing a restart through
+        ``restart_replica`` — a probe of one manager must never trigger
+        a rescue-restart of a different one (tests swap duck-typed
+        manager sets under the same tier client)."""
+        for r in list(self._members):
+            if r.rid == rid:
+                return r.mgr
+        return None
 
     def healthy_replicas(self) -> int:
         """Replicas currently able to serve: running, not draining, not

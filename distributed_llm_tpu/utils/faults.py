@@ -36,6 +36,7 @@ class FaultInjector:
         self._down: Dict[str, Optional[Dict[str, Any]]] = {}
         self._delay_s: Dict[str, float] = {}
         self._stream_kills: Dict[str, deque] = defaultdict(deque)
+        self._publish_fails: Dict[str, deque] = defaultdict(deque)
 
     # -- scripting ---------------------------------------------------------
 
@@ -70,6 +71,7 @@ class FaultInjector:
             self._one_shot.pop(tier, None)
             self._delay_s.pop(tier, None)
             self._stream_kills.pop(tier, None)
+            self._publish_fails.pop(tier, None)
 
     def add_latency(self, tier: str, seconds: float) -> None:
         """Artificial per-request latency (perf-strategy steering tests)."""
@@ -84,6 +86,18 @@ class FaultInjector:
         failover can never catch.  ``restore`` clears pending kills."""
         with self._lock:
             self._stream_kills[tier].append((max(0, int(n_chunks)), error))
+
+    def fail_standby_publish(self, tier: str,
+                             error: str = "injected standby publish "
+                                          "failure") -> None:
+        """Queue a one-shot warm-standby PUBLISH failure: the next
+        scale-up that tries to promote a parked standby on ``tier``
+        loses it (the publish raises; the scale path records the error
+        and falls through to building fresh capacity) — what a standby
+        whose device went away mid-park looks like.  ``restore``
+        clears pending failures."""
+        with self._lock:
+            self._publish_fails[tier].append(error)
 
     # -- hooks called by TierClient ----------------------------------------
 
@@ -108,6 +122,34 @@ class FaultInjector:
         with self._lock:
             kills = self._stream_kills.get(tier)
             return kills.popleft() if kills else None
+
+    def standby_publish_fail(self, tier: str) -> Optional[str]:
+        """Pop the next scheduled standby-publish failure for ``tier``
+        (one-shot): the error message, or None.  Consulted by
+        ``ReplicatedTierClient._scale_up`` before promoting a parked
+        warm standby to membership."""
+        with self._lock:
+            fails = self._publish_fails.get(tier)
+            return fails.popleft() if fails else None
+
+
+def crash_replica_engine(engine) -> bool:
+    """Kill a continuous-batching engine's scheduler loop mid-decode
+    with NO cleanup — the replica-crash fault (ISSUE 20).  The loop
+    thread exits; its decoding slots and queued requests strand (callers
+    block on ``done.wait()``, streams stall), the progress heartbeat
+    goes stale, so the decode watchdog reads WEDGED and the
+    HealthMonitor's next probe routes the replica into
+    ``restart_replica`` — the rescue path's entry point.  Returns False
+    when there is no running loop to kill."""
+    stop = getattr(engine, "_stop", None)
+    if stop is None or getattr(engine, "_thread", None) is None:
+        return False
+    stop.set()
+    wake = getattr(engine, "_wake", None)
+    if wake is not None:
+        wake.set()
+    return True
 
 
 def maybe_break_stream(faults: Optional["FaultInjector"], tier: str,
@@ -217,6 +259,7 @@ class FaultSchedule:
         self._events: List[Tuple[float, str, Callable[[], None]]] = []
         self._tiers: set = set()
         self._starvers: List[BlockStarver] = []
+        self._paused_spills: List[Any] = []
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.applied: List[Tuple[float, str]] = []   # (offset_s, label)
@@ -282,6 +325,55 @@ class FaultSchedule:
                     error="scheduled mid-stream kill"), tier)
         return self
 
+    def kill_replica(self, engine_getter: Callable[[], Any], at_s: float,
+                     tier: Optional[str] = None) -> "FaultSchedule":
+        """Crash one replica's scheduler loop mid-decode at ``at_s``
+        (``crash_replica_engine``).  ``engine_getter`` resolves the
+        victim at FIRE time, not build time — engines are rebuilt
+        across restarts, so a handle captured now could point at a
+        corpse by then."""
+        def _kill():
+            crash_replica_engine(engine_getter())
+        self.at(at_s, f"replicakill:{tier or 'replica'}", _kill, tier)
+        return self
+
+    def wedge_spill_copier(self, spill_getter: Callable[[], Any],
+                           start_s: float, end_s: float,
+                           tier: Optional[str] = None) -> "FaultSchedule":
+        """Wedge the host-KV spill copier thread from ``start_s`` to
+        ``end_s`` (``HostKVSpill.pause``/``resume``): demote copies park
+        in COPYING, promotion claims find nothing RESIDENT, and the
+        promote-stall race-fallback path runs — what a host memcpy
+        stall under memory-bandwidth pressure looks like."""
+        def _hold(fn_name):
+            def _apply():
+                spill = spill_getter()
+                fn = getattr(spill, fn_name, None)
+                if callable(fn):
+                    fn()
+                if fn_name == "pause" and spill is not None:
+                    self._paused_spills.append(spill)
+                elif fn_name == "resume":
+                    try:
+                        self._paused_spills.remove(spill)
+                    except ValueError:
+                        pass
+            return _apply
+        self.at(start_s, f"spillwedge:{tier or 'spill'}",
+                _hold("pause"), tier)
+        self.at(end_s, f"spillunwedge:{tier or 'spill'}",
+                _hold("resume"), tier)
+        return self
+
+    def fail_standby_publish(self, tier: str, at_s: float
+                             ) -> "FaultSchedule":
+        """Queue a one-shot warm-standby publish failure at ``at_s`` —
+        the next scale-up on ``tier`` loses its first parked standby
+        and must build fresh capacity instead."""
+        self.at(at_s, f"publishfail:{tier}",
+                lambda: self.injector.fail_standby_publish(tier), tier)
+        return self
+
     # -- driver -------------------------------------------------------------
 
     def duration_s(self) -> float:
@@ -330,3 +422,11 @@ class FaultSchedule:
             self.injector.restore(tier)
         for starver in self._starvers:
             starver.release()
+        for spill in list(self._paused_spills):
+            # A schedule may never leave a copier wedged past its run
+            # (same contract as sticky outages and confiscated blocks).
+            try:
+                spill.resume()
+            except Exception:
+                pass
+        self._paused_spills = []

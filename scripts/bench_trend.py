@@ -77,6 +77,13 @@ from typing import Any, Dict, List, Optional, Tuple
 # elastic.flap_count (effective scale-event reversal pairs inside one
 # cooldown window — 0 by construction, ANY positive value is the
 # control loop oscillating) joined in r20.
+# chaos2.availability (ISSUE 20's answered fraction under scripted
+# replica kills with the HealthMonitor + crash rescue in the loop —
+# rescued requests stall, they do not error, so a drift below ~1.0
+# means a kill leaked through the tier boundary) and
+# chaos2.rescue_mttr_ms (kill -> the victim serving again on a fresh
+# engine, monitor detection latency included — drifting up means the
+# capture/adopt/rebuild path got slower) joined in r21.
 PINNED: Tuple[Tuple[str, bool], ...] = (
     ("trend_req_per_s", True),
     ("skew_tick_ratio", False),
@@ -91,15 +98,19 @@ PINNED: Tuple[Tuple[str, bool], ...] = (
     ("noisy.flood_shed_precision", True),
     ("elastic.goodput_per_replica_s", True),
     ("elastic.flap_count", False),
+    ("chaos2.availability", True),
+    ("chaos2.rescue_mttr_ms", False),
 )
 
 # Context rows printed (no flags): the headline and accuracy travel
 # with the pinned numbers so a trend break can be read in context.
 # elastic.scale_events rides as context — the event count sizes the
 # flap/gprs rows (2 is the diurnal ideal) but is not itself a verdict.
+# chaos2.failovers rides as context with a hard meaning recorded in
+# BENCHMARKS.md: ~0 cross-tier failovers while a sibling lives.
 CONTEXT = ("value", "routing_accuracy", "mixed.tbt95_ratio",
            "replica.aff_ret", "profile.coverage",
-           "elastic.scale_events")
+           "elastic.scale_events", "chaos2.failovers")
 
 
 def _get(doc: Any, *path: str) -> Optional[Any]:
@@ -146,6 +157,11 @@ _PATHS: Dict[str, Tuple[Tuple[str, ...], ...]] = {
                            ("elastic", "flap_count"),),
     "elastic.scale_events": (("elastic", "events"),
                              ("elastic", "scale_events"),),
+    "chaos2.availability": (("chaos2", "avail"),
+                            ("chaos2", "availability"),),
+    "chaos2.rescue_mttr_ms": (("chaos2", "mttr"),
+                              ("chaos2", "rescue_mttr_ms"),),
+    "chaos2.failovers": (("chaos2", "failovers"),),
 }
 
 

@@ -2473,6 +2473,72 @@ def test_ownership_silent_when_standby_unwind_stops_server():
     assert _own({SERVING: REPLICA_LEAK_GUARDED}).findings == []
 
 
+# ISSUE 20 rescue-capture protocol: a capture_requests() result is the
+# victim's in-flight work (callers blocked on done.wait()) and must
+# reach exactly one home — adopted by a sibling (transfer) or failed
+# with the engine-stopped shape (release).
+
+RESCUE_UAT_BAD = """
+    class Tier:
+        def rescue(self, victim, sibling):
+            captured = victim.capture_requests()
+            sibling.adopt_requests(captured)
+            fail_captured(captured, self.name)
+"""
+
+RESCUE_UAT_NEAR = """
+    class Tier:
+        def rescue(self, victim, sibling):
+            captured = victim.capture_requests()
+            sibling.adopt_requests(captured)
+            rescued = len(captured)
+"""
+
+RESCUE_LEAK_BAD = """
+    class Tier:
+        def rescue(self, victim, sibling):
+            captured = victim.capture_requests()
+            victim.mgr.start_server()    # can raise: captures strand
+            sibling.adopt_requests(captured)
+"""
+
+RESCUE_LEAK_GUARDED = """
+    class Tier:
+        def rescue(self, victim, sibling):
+            captured = victim.capture_requests()
+            try:
+                victim.mgr.start_server()
+            except BaseException:
+                fail_captured(captured, self.name)
+                raise
+            sibling.adopt_requests(captured)
+"""
+
+
+def test_ownership_flags_release_after_rescue_adoption():
+    """adopt_requests() hands the captured requests to the sibling's
+    queue — failing them afterwards would complete streams another
+    engine is actively decoding."""
+    result = _own({SERVING: RESCUE_UAT_BAD})
+    assert _rules(result) == ["own-use-after-transfer"], result.findings
+
+
+def test_ownership_silent_on_rescue_count_after_adoption():
+    assert _own({SERVING: RESCUE_UAT_NEAR}).findings == []
+
+
+def test_ownership_flags_captured_requests_leak_on_restart_raise():
+    """A raise between capture and adoption strands every captured
+    request — callers block on done.wait() forever (the dynamic twin
+    is the stalled-stream symptom, invisible until a client hangs)."""
+    result = _own({SERVING: RESCUE_LEAK_BAD})
+    assert _rules(result) == ["own-leak-on-path"], result.findings
+
+
+def test_ownership_silent_when_rescue_unwind_fails_captured():
+    assert _own({SERVING: RESCUE_LEAK_GUARDED}).findings == []
+
+
 def test_ownership_flags_rebind_while_owned():
     """Overwriting the only binding of live blocks leaks them on every
     path — reported at the acquire sites, not the dataflow frontier."""

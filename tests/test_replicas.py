@@ -322,6 +322,28 @@ def test_health_monitor_restarts_only_the_wedged_replica():
     assert subs[1].started == 1
 
 
+def test_restart_replica_refused_while_scale_in_progress():
+    """Race regression (ISSUE 20): a HealthMonitor-driven restart
+    landing mid-scale is REFUSED through the same busy flag scale_to
+    uses — it must not stop/start a member whose membership record a
+    concurrent scale event is about to replace.  The refusal is an
+    error summary (the monitor keeps the failure streak and retries
+    next probe), never a queued restart."""
+    client = _client()
+    victim = client._members[0]
+    client._scaling = True
+    try:
+        s = client.restart_replica(0, reason="race test")
+    finally:
+        client._scaling = False
+    assert s["restarted"] is False
+    assert s["rescued"] == 0
+    assert any("busy" in e for e in s["errors"])
+    # The victim was never touched: no engine was built or torn down.
+    assert client._members[0] is victim
+    assert victim.mgr.is_server_running() is False
+
+
 def test_traffic_drains_to_survivor_when_replica_breaker_open():
     """Satellite: with one replica's circuit open, every dispatch lands
     on the survivor."""
